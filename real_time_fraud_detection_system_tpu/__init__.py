@@ -1,0 +1,34 @@
+"""TPU-native real-time fraud detection framework.
+
+A brand-new JAX/XLA/Pallas framework with the capabilities of the reference
+``sauravtanwar786/Real-time_fraud_detection_system`` (a Spark Structured
+Streaming + sklearn pipeline): CDC envelope decoding, stateful rolling-window
+velocity features, micro-batch classification, online model updates and
+lakehouse-compatible sinks — rebuilt TPU-first:
+
+- the per-transaction hot path (reference ``pyspark/scripts/fraud_detection.py``)
+  is a single jitted ``step(state, batch) -> (state, preds)``;
+- rolling 1/7/30-day per-customer / per-terminal features (reference
+  ``fraud_detection_model/feature_transformation.ipynb``) live in HBM as
+  day-bucket ring buffers + count-min sketch, updated by scatter kernels;
+- scoring is ``vmap``-batched and ``shard_map``-sharded across a TPU mesh,
+  one Kafka partition per device (reference: Spark ``local[*]`` executors);
+- the CPU (sklearn) path is retained as a parity oracle behind
+  ``--scorer {cpu,tpu}``.
+
+Import as::
+
+    import real_time_fraud_detection_system_tpu as rtfds
+"""
+
+__version__ = "0.1.0"
+
+from real_time_fraud_detection_system_tpu.config import (  # noqa: F401
+    Config,
+    DataConfig,
+    FeatureConfig,
+    MeshConfig,
+    ModelConfig,
+    RuntimeConfig,
+    TrainConfig,
+)

@@ -1,0 +1,19 @@
+from real_time_fraud_detection_system_tpu.core.schema import (  # noqa: F401
+    ANALYZED_TRANSACTIONS_FIELDS,
+    CUSTOMERS,
+    TERMINALS,
+    TRANSACTIONS,
+    TableSchema,
+)
+from real_time_fraud_detection_system_tpu.core.envelope import (  # noqa: F401
+    decode_decimal_bytes,
+    decode_transaction_envelopes,
+    encode_decimal_cents,
+    encode_transaction_envelope,
+)
+from real_time_fraud_detection_system_tpu.core.batch import (  # noqa: F401
+    TxBatch,
+    bucket_size,
+    make_batch,
+    pad_batch,
+)

@@ -1,0 +1,10 @@
+from real_time_fraud_detection_system_tpu.data.generator import (  # noqa: F401
+    CustomerProfiles,
+    TerminalProfiles,
+    Transactions,
+    add_frauds,
+    generate_customer_profiles,
+    generate_dataset,
+    generate_terminal_profiles,
+    generate_transactions,
+)

@@ -1,0 +1,13 @@
+from real_time_fraud_detection_system_tpu.features.spec import (  # noqa: F401
+    FEATURE_NAMES,
+    N_FEATURES,
+)
+from real_time_fraud_detection_system_tpu.features.online import (  # noqa: F401
+    FeatureState,
+    init_feature_state,
+    update_and_featurize,
+)
+from real_time_fraud_detection_system_tpu.features.offline import (  # noqa: F401
+    compute_features_replay,
+    pandas_rolling_features,
+)

@@ -1,0 +1,8 @@
+from real_time_fraud_detection_system_tpu.io.sink import (  # noqa: F401
+    ConsoleSink,
+    MemorySink,
+    ParquetSink,
+)
+from real_time_fraud_detection_system_tpu.io.checkpoint import (  # noqa: F401
+    Checkpointer,
+)

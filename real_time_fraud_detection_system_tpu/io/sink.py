@@ -1,0 +1,129 @@
+"""Output sinks — the ``analyzed_transactions`` append path.
+
+The reference appends scored rows to an Iceberg table that Trino/Superset
+read (``fraud_detection.py:204-211``). The framework writes the same
+column layout (``core/schema.py::ANALYZED_TRANSACTIONS_FIELDS``):
+
+- :class:`ParquetSink` — one Parquet part-file per micro-batch under a
+  directory; any Iceberg/Trino/DuckDB reader can mount it. Columns are
+  byte-compatible with the reference table (µs timestamps, f64 amounts).
+- :class:`MemorySink` — accumulates in RAM (tests, metrics).
+- :class:`ConsoleSink` — the reference's ``.show()`` debugging analogue.
+
+An ``IcebergSink`` (pyiceberg catalog append) belongs here too; pyiceberg is
+not in this image, so it is import-gated the same way KafkaSource is.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from real_time_fraud_detection_system_tpu.features.spec import FEATURE_NAMES
+
+
+def _result_to_columns(res) -> dict:
+    """BatchResult → analyzed_transactions column dict."""
+    now_us = int(time.time() * 1e6)
+    n = len(res.tx_id)
+    cols = {
+        "tx_id": res.tx_id.astype(np.int64),
+        "tx_datetime_us": res.tx_datetime_us.astype(np.int64),
+        "customer_id": res.customer_id.astype(np.int64),
+        "terminal_id": res.terminal_id.astype(np.int64),
+        "tx_amount": res.amount_cents.astype(np.float64) / 100.0,
+    }
+    # feature columns, lower-cased like the reference table DDL
+    for i, name in enumerate(FEATURE_NAMES):
+        if name == "TX_AMOUNT":
+            continue
+        dt = np.int32 if ("NB_TX" in name or "DURING" in name) else np.float64
+        cols[name.lower()] = res.features[:, i].astype(dt)
+    cols["processed_at_us"] = np.full(n, now_us, dtype=np.int64)
+    cols["prediction"] = res.probs.astype(np.float64)
+    return cols
+
+
+class MemorySink:
+    def __init__(self):
+        self.batches: List[dict] = []
+
+    def append(self, res) -> None:
+        self.batches.append(_result_to_columns(res))
+
+    def concat(self) -> dict:
+        if not self.batches:
+            return {}
+        keys = self.batches[0].keys()
+        return {k: np.concatenate([b[k] for b in self.batches]) for k in keys}
+
+
+class ConsoleSink:
+    def __init__(self, every: int = 1, limit: int = 5):
+        self.every = every
+        self.limit = limit
+        self._n = 0
+
+    def append(self, res) -> None:
+        self._n += 1
+        if self._n % self.every:
+            return
+        n = len(res.tx_id)
+        print(f"[batch {self._n}] rows={n} p(fraud): "
+              f"mean={res.probs.mean():.4f} max={res.probs.max():.4f}")
+        for i in range(min(self.limit, n)):
+            print(
+                f"  tx {res.tx_id[i]} cust {res.customer_id[i]} "
+                f"amt {res.amount_cents[i] / 100:.2f} -> {res.probs[i]:.4f}"
+            )
+
+
+class ParquetSink:
+    """One part file per batch: ``<dir>/part-<epoch_ms>-<seq>.parquet``."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._seq = 0
+
+    def append(self, res) -> None:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        cols = _result_to_columns(res)
+        table = pa.table({k: pa.array(v) for k, v in cols.items()})
+        path = os.path.join(
+            self.directory, f"part-{int(time.time() * 1e3)}-{self._seq:06d}.parquet"
+        )
+        pq.write_table(table, path)
+        self._seq += 1
+
+    def read_all(self) -> dict:
+        import pyarrow.parquet as pq
+        import pyarrow as pa
+
+        files = sorted(
+            os.path.join(self.directory, f)
+            for f in os.listdir(self.directory)
+            if f.endswith(".parquet")
+        )
+        if not files:
+            return {}
+        table = pa.concat_tables([pq.read_table(f) for f in files])
+        return {c: table[c].to_numpy() for c in table.column_names}
+
+
+def make_iceberg_sink(*args, **kwargs):  # pragma: no cover - gated
+    """Iceberg catalog append (pyiceberg not present in this image)."""
+    try:
+        import pyiceberg  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "pyiceberg is not installed; ParquetSink output is Iceberg-"
+            "compatible (add files to a table via any catalog), or install "
+            "pyiceberg in production images."
+        ) from e
+    raise NotImplementedError

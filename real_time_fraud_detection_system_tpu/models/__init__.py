@@ -1,0 +1,34 @@
+from real_time_fraud_detection_system_tpu.models.scaler import (  # noqa: F401
+    Scaler,
+    fit_scaler,
+    transform,
+)
+from real_time_fraud_detection_system_tpu.models.logreg import (  # noqa: F401
+    LogRegParams,
+    init_logreg,
+    logreg_predict_proba,
+    train_logreg,
+)
+from real_time_fraud_detection_system_tpu.models.mlp import (  # noqa: F401
+    init_mlp,
+    mlp_predict_proba,
+    train_mlp,
+)
+from real_time_fraud_detection_system_tpu.models.forest import (  # noqa: F401
+    TreeEnsemble,
+    ensemble_from_sklearn,
+    ensemble_predict_proba,
+    fit_forest,
+)
+from real_time_fraud_detection_system_tpu.models.metrics import (  # noqa: F401
+    average_precision,
+    card_precision_top_k,
+    performance_assessment,
+    roc_auc,
+    threshold_based_metrics,
+)
+from real_time_fraud_detection_system_tpu.models.train import (  # noqa: F401
+    TrainedModel,
+    train_delay_test_split,
+    train_model,
+)

@@ -1,0 +1,50 @@
+"""CPU parity oracle — the reference's exact serving pipeline, kept as truth.
+
+Mirrors ``fraud_detection.py:183-195``: sklearn ``StandardScaler.transform``
+followed by ``predict_proba(...)[:, 1]`` of a sklearn classifier. The
+``--scorer cpu`` switch routes scoring here; parity tests assert the TPU
+path matches (probability-level for logreg/forest, AUC-level for the
+approximated features).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class CpuScorer:
+    def __init__(self, scaler, model):
+        self.scaler = scaler  # sklearn StandardScaler
+        self.model = model  # sklearn classifier with predict_proba
+
+    def predict_proba(self, features: np.ndarray) -> np.ndarray:
+        scaled = self.scaler.transform(features)
+        return self.model.predict_proba(scaled)[:, 1]
+
+
+def fit_cpu_scorer(
+    features: np.ndarray,
+    labels: np.ndarray,
+    kind: str = "forest",
+    n_trees: int = 100,
+    max_depth: int | None = 8,
+    seed: int = 0,
+) -> CpuScorer:
+    """Train the reference-style sklearn pipeline on host."""
+    from sklearn.ensemble import RandomForestClassifier
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.preprocessing import StandardScaler
+    from sklearn.tree import DecisionTreeClassifier
+
+    scaler = StandardScaler().fit(features)
+    scaled = scaler.transform(features)
+    if kind == "logreg":
+        model = LogisticRegression(max_iter=1000, random_state=seed)
+    elif kind == "tree":
+        model = DecisionTreeClassifier(max_depth=2, random_state=seed)
+    else:
+        model = RandomForestClassifier(
+            n_estimators=n_trees, max_depth=max_depth, random_state=seed, n_jobs=-1
+        )
+    model.fit(scaled, labels)
+    return CpuScorer(scaler, model)

@@ -1,0 +1,102 @@
+"""Logistic-regression scorer — the first TPU model (BASELINE.json config 2).
+
+Weights are a tiny pytree kept HBM-resident next to the feature state;
+scoring is one fused matvec per batch under jit, and the same loss/grad pair
+drives both offline training (optax minibatch Adam) and the online-SGD
+update from the labeled-feedback stream (config 4).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+class LogRegParams(NamedTuple):
+    w: jnp.ndarray  # float32 [F]
+    b: jnp.ndarray  # float32 []
+
+
+def init_logreg(n_features: int, seed: int = 0) -> LogRegParams:
+    k = jax.random.PRNGKey(seed)
+    return LogRegParams(
+        w=0.01 * jax.random.normal(k, (n_features,), dtype=jnp.float32),
+        b=jnp.zeros((), dtype=jnp.float32),
+    )
+
+
+def logreg_logits(params: LogRegParams, x: jnp.ndarray) -> jnp.ndarray:
+    return x @ params.w + params.b
+
+
+def logreg_predict_proba(params: LogRegParams, x: jnp.ndarray) -> jnp.ndarray:
+    return jax.nn.sigmoid(logreg_logits(params, x))
+
+
+def logreg_loss(
+    params: LogRegParams,
+    x: jnp.ndarray,
+    y: jnp.ndarray,
+    valid: jnp.ndarray | None = None,
+    pos_weight: float = 1.0,
+) -> jnp.ndarray:
+    """Weighted BCE-with-logits; padded rows masked out."""
+    logits = logreg_logits(params, x)
+    per = optax.sigmoid_binary_cross_entropy(logits, y.astype(jnp.float32))
+    w = jnp.where(y > 0, pos_weight, 1.0)
+    if valid is not None:
+        w = w * valid.astype(jnp.float32)
+    return jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def sgd_update(
+    params: LogRegParams,
+    x: jnp.ndarray,
+    y: jnp.ndarray,
+    valid: jnp.ndarray,
+    lr: float,
+    pos_weight: float = 1.0,
+) -> LogRegParams:
+    """One plain-SGD step — the online-update path (runs inside the
+    streaming step function; gradient is psum-reduced across the mesh by the
+    caller when sharded)."""
+    g = jax.grad(logreg_loss)(params, x, y, valid, pos_weight)
+    return jax.tree.map(lambda p, gi: p - lr * gi, params, g)
+
+
+def train_logreg(
+    x: np.ndarray,
+    y: np.ndarray,
+    learning_rate: float = 1e-2,
+    batch_size: int = 4096,
+    epochs: int = 5,
+    pos_weight: float = 1.0,
+    seed: int = 0,
+) -> LogRegParams:
+    """Offline minibatch-Adam training on (already scaled) features."""
+    n, f = x.shape
+    params = init_logreg(f, seed)
+    opt = optax.adam(learning_rate)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, xb, yb):
+        loss, g = jax.value_and_grad(logreg_loss)(
+            params, xb, yb, None, pos_weight
+        )
+        updates, opt_state = opt.update(g, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    rng = np.random.default_rng(seed)
+    xj = jnp.asarray(x, dtype=jnp.float32)
+    yj = jnp.asarray(y, dtype=jnp.float32)
+    for _ in range(epochs):
+        perm = rng.permutation(n)
+        for s in range(0, n - batch_size + 1, batch_size):
+            idx = perm[s : s + batch_size]
+            params, opt_state, _ = step(params, opt_state, xj[idx], yj[idx])
+    return params

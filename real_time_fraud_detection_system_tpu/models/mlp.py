@@ -1,0 +1,95 @@
+"""MLP classifier — successor to the reference's dormant deep-learning code.
+
+The reference ships a commented-out PyTorch MLP/autoencoder section
+(``shared_functions.py:1312-1707``) that was never invoked. This is its live
+TPU-native equivalent: a plain pytree of (W, b) layers, bf16-friendly
+matmuls on the MXU, trained with optax.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+MLPParams = List[Tuple[jnp.ndarray, jnp.ndarray]]
+
+
+def init_mlp(
+    n_features: int, hidden: Sequence[int] = (64, 32), seed: int = 0
+) -> MLPParams:
+    key = jax.random.PRNGKey(seed)
+    dims = [n_features, *hidden, 1]
+    params: MLPParams = []
+    for i in range(len(dims) - 1):
+        key, k = jax.random.split(key)
+        scale = np.sqrt(2.0 / dims[i])
+        params.append(
+            (
+                scale * jax.random.normal(k, (dims[i], dims[i + 1]), dtype=jnp.float32),
+                jnp.zeros((dims[i + 1],), dtype=jnp.float32),
+            )
+        )
+    return params
+
+
+def mlp_logits(params: MLPParams, x: jnp.ndarray) -> jnp.ndarray:
+    h = x
+    for w, b in params[:-1]:
+        h = jax.nn.relu(h @ w + b)
+    w, b = params[-1]
+    return (h @ w + b)[..., 0]
+
+
+def mlp_predict_proba(params: MLPParams, x: jnp.ndarray) -> jnp.ndarray:
+    return jax.nn.sigmoid(mlp_logits(params, x))
+
+
+def mlp_loss(
+    params: MLPParams,
+    x: jnp.ndarray,
+    y: jnp.ndarray,
+    valid: jnp.ndarray | None = None,
+    pos_weight: float = 1.0,
+) -> jnp.ndarray:
+    logits = mlp_logits(params, x)
+    per = optax.sigmoid_binary_cross_entropy(logits, y.astype(jnp.float32))
+    w = jnp.where(y > 0, pos_weight, 1.0)
+    if valid is not None:
+        w = w * valid.astype(jnp.float32)
+    return jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def train_mlp(
+    x: np.ndarray,
+    y: np.ndarray,
+    hidden: Sequence[int] = (64, 32),
+    learning_rate: float = 1e-3,
+    batch_size: int = 4096,
+    epochs: int = 5,
+    pos_weight: float = 1.0,
+    seed: int = 0,
+) -> MLPParams:
+    n, f = x.shape
+    params = init_mlp(f, hidden, seed)
+    opt = optax.adam(learning_rate)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, xb, yb):
+        loss, g = jax.value_and_grad(mlp_loss)(params, xb, yb, None, pos_weight)
+        updates, opt_state = opt.update(g, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    rng = np.random.default_rng(seed)
+    xj = jnp.asarray(x, dtype=jnp.float32)
+    yj = jnp.asarray(y, dtype=jnp.float32)
+    for _ in range(epochs):
+        perm = rng.permutation(n)
+        for s in range(0, n - batch_size + 1, batch_size):
+            idx = perm[s : s + batch_size]
+            params, opt_state, _ = step(params, opt_state, xj[idx], yj[idx])
+    return params

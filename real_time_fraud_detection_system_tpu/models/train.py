@@ -1,0 +1,169 @@
+"""Offline training pipeline: split → scale → fit → assess.
+
+Re-implements the reference's training protocol
+(``model_training.ipynb · cells 8,26,50``; ``shared_functions.py:133-188``):
+a time-based train/delay/test split (153/30/30 days by default) where test
+days drop transactions of customers already known compromised — known =
+defrauded in the train window, plus frauds discovered up to each test day
+minus the delay. Features come from :func:`..features.offline
+.compute_features_replay` so the model trains on exactly the serving
+semantics.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+from real_time_fraud_detection_system_tpu.config import Config
+from real_time_fraud_detection_system_tpu.data.generator import Transactions
+from real_time_fraud_detection_system_tpu.features.offline import (
+    compute_features_replay,
+)
+from real_time_fraud_detection_system_tpu.models.forest import (
+    TreeEnsemble,
+    ensemble_predict_proba,
+    fit_forest,
+)
+from real_time_fraud_detection_system_tpu.models.logreg import (
+    LogRegParams,
+    logreg_predict_proba,
+    train_logreg,
+)
+from real_time_fraud_detection_system_tpu.models.mlp import (
+    mlp_predict_proba,
+    train_mlp,
+)
+from real_time_fraud_detection_system_tpu.models.metrics import (
+    performance_assessment,
+)
+from real_time_fraud_detection_system_tpu.models.scaler import (
+    Scaler,
+    fit_scaler,
+    transform,
+)
+
+
+def train_delay_test_split(
+    txs: Transactions,
+    start_day: int = 0,
+    delta_train: int = 153,
+    delta_delay: int = 30,
+    delta_test: int = 30,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Returns (train_mask, test_mask) over txs rows.
+
+    Test-day filtering matches ``shared_functions.py:146-171``: a customer
+    enters the known-compromised pool if they have a fraud in the train
+    window, or a fraud on day (test_day - delay) as days advance; their
+    transactions are excluded from the test set.
+    """
+    days = txs.tx_time_days
+    train_mask = (days >= start_day) & (days < start_day + delta_train)
+
+    known = set(np.unique(txs.customer_id[train_mask & (txs.tx_fraud == 1)]).tolist())
+    test_mask = np.zeros(txs.n, dtype=bool)
+    test_start = start_day + delta_train + delta_delay
+    for d in range(delta_test):
+        # Frauds discovered by this test day (delay days after they happened).
+        disc_day = start_day + delta_train + d - 1
+        disc = (days == disc_day) & (txs.tx_fraud == 1)
+        known.update(np.unique(txs.customer_id[disc]).tolist())
+        day_mask = days == test_start + d
+        if known:
+            known_arr = np.fromiter(known, dtype=np.int64)
+            day_mask &= ~np.isin(txs.customer_id, known_arr)
+        test_mask |= day_mask
+    return train_mask, test_mask
+
+
+@dataclass
+class TrainedModel:
+    """Scaler + fitted classifier params, ready for the serving step."""
+
+    kind: str
+    scaler: Scaler
+    params: object  # LogRegParams | MLPParams | TreeEnsemble
+
+    def predict_proba(self, features: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+
+        x = transform(self.scaler, jnp.asarray(features, dtype=jnp.float32))
+        if self.kind == "logreg":
+            return np.asarray(logreg_predict_proba(self.params, x))
+        if self.kind == "mlp":
+            return np.asarray(mlp_predict_proba(self.params, x))
+        if self.kind in ("tree", "forest"):
+            return np.asarray(ensemble_predict_proba(self.params, x))
+        raise ValueError(f"unknown model kind {self.kind}")
+
+
+def train_model(
+    txs: Transactions,
+    cfg: Config,
+    features: Optional[np.ndarray] = None,
+    kind: Optional[str] = None,
+) -> Tuple[TrainedModel, dict]:
+    """End-to-end offline training; returns (model, test metrics)."""
+    kind = kind or cfg.model.kind
+    if features is None:
+        features = compute_features_replay(
+            txs, cfg.features, start_date=cfg.data.start_date
+        )
+    train_mask, test_mask = train_delay_test_split(
+        txs,
+        delta_train=cfg.train.delta_train_days,
+        delta_delay=cfg.train.delta_delay_days,
+        delta_test=cfg.train.delta_test_days,
+    )
+    x_train = features[train_mask]
+    y_train = txs.tx_fraud[train_mask].astype(np.float32)
+    scaler = fit_scaler(x_train)
+    import jax.numpy as jnp
+
+    xs = np.asarray(transform(scaler, jnp.asarray(x_train, dtype=jnp.float32)))
+
+    n_pos = max(float(y_train.sum()), 1.0)
+    pos_weight = float((len(y_train) - n_pos) / n_pos) ** 0.5  # soft rebalance
+
+    if kind == "logreg":
+        params = train_logreg(
+            xs, y_train,
+            learning_rate=cfg.train.learning_rate,
+            batch_size=cfg.train.batch_size,
+            epochs=cfg.train.epochs,
+            pos_weight=pos_weight,
+            seed=cfg.model.seed,
+        )
+    elif kind == "mlp":
+        params = train_mlp(
+            xs, y_train,
+            hidden=tuple(cfg.model.mlp_hidden),
+            batch_size=cfg.train.batch_size,
+            epochs=cfg.train.epochs,
+            pos_weight=pos_weight,
+            seed=cfg.model.seed,
+        )
+    elif kind in ("tree", "forest"):
+        params = fit_forest(
+            xs, y_train,
+            n_trees=cfg.model.forest_n_trees,
+            max_depth=(cfg.model.tree_max_depth if kind == "tree"
+                       else cfg.model.forest_max_depth),
+            seed=cfg.model.seed,
+            kind=kind,
+        )
+    else:
+        raise ValueError(f"unknown model kind {kind}")
+
+    model = TrainedModel(kind=kind, scaler=scaler, params=params)
+    probs = model.predict_proba(features[test_mask])
+    metrics = performance_assessment(
+        txs.tx_fraud[test_mask],
+        probs,
+        days=txs.tx_time_days[test_mask],
+        customer_ids=txs.customer_id[test_mask],
+    )
+    return model, metrics

@@ -1,0 +1,21 @@
+from real_time_fraud_detection_system_tpu.ops.hashing import (  # noqa: F401
+    hash_u32,
+    multi_hash,
+    slot_of,
+)
+from real_time_fraud_detection_system_tpu.ops.windows import (  # noqa: F401
+    WindowState,
+    init_window_state,
+    query_windows,
+    update_windows,
+)
+from real_time_fraud_detection_system_tpu.ops.cms import (  # noqa: F401
+    CountMinSketch,
+    cms_init,
+    cms_query,
+    cms_update,
+)
+from real_time_fraud_detection_system_tpu.ops.dedup import (  # noqa: F401
+    latest_wins_mask,
+    latest_wins_mask_np,
+)

@@ -1,0 +1,61 @@
+"""Latest-wins dedup — the MERGE/ROW_NUMBER upsert semantics on device.
+
+The reference dedups every micro-batch with
+``ROW_NUMBER() OVER (PARTITION BY tx_id ORDER BY timestamp DESC)`` and keeps
+rank 1 before a MERGE (``kafka_s3_sink_transactions.py:173-190``). Here the
+same semantics are a mask op: keep, for each key, the row with the greatest
+timestamp — ties broken by latest batch position (Kafka log order), exactly
+like a descending sort on (timestamp, offset).
+
+Two implementations:
+- ``latest_wins_mask``: jnp, static-shape, jit/shard_map-safe (sort-based,
+  O(B log B)) — for fully on-device pipelines;
+- ``latest_wins_mask_np``: NumPy int64 host-side — used by the ingest path
+  before device_put (tx_ids are 64-bit there).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def latest_wins_mask(
+    key: jnp.ndarray,  # int32/uint32 [B]
+    ts: jnp.ndarray,  # int32 [B] — ordering timestamp
+    valid: jnp.ndarray,  # bool [B]
+) -> jnp.ndarray:
+    """bool [B]: True where the row is the latest version of its key.
+
+    Invalid rows are never selected. Static shapes only.
+    """
+    b = key.shape[0]
+    pos = jnp.arange(b, dtype=jnp.int32)
+    k = key.astype(jnp.uint32)
+    # Invalid rows sort to the front of their key group (minimal ts) so a
+    # valid row, if any, is always the group's last element.
+    ts_eff = jnp.where(valid, ts, jnp.iinfo(jnp.int32).min)
+    order = jnp.lexsort((pos, ts_eff, k))  # ascending; last of key group wins
+    k_sorted = k[order]
+    is_last = jnp.concatenate([k_sorted[1:] != k_sorted[:-1], jnp.ones(1, bool)])
+    win_sorted = is_last & valid[order]
+    mask = jnp.zeros(b, dtype=bool).at[order].set(win_sorted)
+    return mask
+
+
+def latest_wins_mask_np(
+    key: np.ndarray, ts: np.ndarray, valid: np.ndarray | None = None
+) -> np.ndarray:
+    """NumPy version for host-side ingest (int64 keys)."""
+    b = len(key)
+    pos = np.arange(b)
+    if valid is None:
+        valid = np.ones(b, dtype=bool)
+    k = np.where(valid, key, np.int64(np.iinfo(np.int64).min))
+    order = np.lexsort((pos, ts, k))
+    k_sorted = k[order]
+    is_last = np.concatenate([k_sorted[1:] != k_sorted[:-1], [True]])
+    win_sorted = is_last & (k_sorted != np.iinfo(np.int64).min)
+    mask = np.zeros(b, dtype=bool)
+    mask[order] = win_sorted
+    return mask

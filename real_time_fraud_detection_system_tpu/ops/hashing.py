@@ -1,0 +1,42 @@
+"""Integer hashing ops in pure jnp uint32 arithmetic.
+
+Used for key→slot placement in the HBM-resident feature tables and for the
+count-min sketch's row hashes. TPU has no native 64-bit int path worth using
+here; a finalizer-style 32-bit mixer (splitmix/murmur-finale family) gives
+good avalanche with 6 VPU ops per key.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+_M1 = np.uint32(0x7FEB352D)
+_M2 = np.uint32(0x846CA68B)
+
+
+def hash_u32(x: jnp.ndarray, seed: int = 0) -> jnp.ndarray:
+    """Mix uint32 keys (vectorized). Distinct seeds give independent hashes."""
+    h = x.astype(jnp.uint32) ^ jnp.uint32(0x9E3779B9 * (seed + 1) & 0xFFFFFFFF)
+    h = h ^ (h >> 16)
+    h = h * _M1
+    h = h ^ (h >> 15)
+    h = h * _M2
+    h = h ^ (h >> 16)
+    return h
+
+
+def slot_of(key: jnp.ndarray, capacity: int, seed: int = 0) -> jnp.ndarray:
+    """Key → table slot in [0, capacity). capacity must be a power of two."""
+    assert capacity & (capacity - 1) == 0, "capacity must be a power of 2"
+    return (hash_u32(key, seed) & jnp.uint32(capacity - 1)).astype(jnp.int32)
+
+
+def multi_hash(key: jnp.ndarray, depth: int, width: int) -> jnp.ndarray:
+    """[B] keys → [depth, B] independent column indices in [0, width)."""
+    assert width & (width - 1) == 0, "width must be a power of 2"
+    cols = [
+        (hash_u32(key, seed=d) & jnp.uint32(width - 1)).astype(jnp.int32)
+        for d in range(depth)
+    ]
+    return jnp.stack(cols, axis=0)

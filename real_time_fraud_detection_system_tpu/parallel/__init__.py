@@ -1,0 +1,8 @@
+from real_time_fraud_detection_system_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    shard_feature_state,
+)
+from real_time_fraud_detection_system_tpu.parallel.step import (  # noqa: F401
+    make_sharded_step,
+    partition_batch_by_customer,
+)

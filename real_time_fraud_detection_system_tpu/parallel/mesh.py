@@ -1,0 +1,64 @@
+"""Device mesh + sharding layout.
+
+The reference's only scale-out axes are Kafka topic partitions and Spark
+``local[*]`` cores (SURVEY §2.3). Here the axis is a 1-D ``jax.sharding.Mesh``
+over TPU chips: Kafka partition p maps to mesh position p (DCN carries the
+consumer traffic to hosts; ICI carries the in-step collectives).
+
+Sharding layout:
+- batch rows: sharded along axis 0 ("data") — each device scores the rows
+  of its partitions;
+- customer window state: sharded along the slot axis — rows arrive
+  partitioned by customer key, so a device's rows only touch its own shard
+  (no collective needed);
+- terminal window state: sharded along the slot axis by terminal-key
+  ownership — rows reference terminals owned by other devices, so the step
+  exchanges (key, day, amount, fraud) quadruples via ``all_to_all`` on ICI,
+  updates/queries on the owner, and returns features by the inverse
+  exchange (see :mod:`.step`);
+- model params + scaler: replicated (tiny), gradients ``psum``-reduced for
+  the online-SGD path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from real_time_fraud_detection_system_tpu.features.online import FeatureState
+
+
+def make_mesh(n_devices: int = 0, axis: str = "data") -> Mesh:
+    devs = jax.devices()
+    if n_devices == 0:
+        n_devices = len(devs)
+    if n_devices > len(devs):
+        raise ValueError(
+            f"requested {n_devices} devices, only {len(devs)} visible "
+            f"(set XLA_FLAGS=--xla_force_host_platform_device_count=N for "
+            f"virtual CPU devices)"
+        )
+    return Mesh(np.asarray(devs[:n_devices]), (axis,))
+
+
+def shard_feature_state(
+    state: FeatureState, mesh: Mesh, axis: str = "data"
+) -> FeatureState:
+    """Place window tables sharded along the slot axis, CMS replicated."""
+    row_sharded = NamedSharding(mesh, P(axis, None))
+    repl = NamedSharding(mesh, P())
+
+    def place_windows(ws):
+        return jax.tree.map(lambda a: jax.device_put(a, row_sharded), ws)
+
+    cms = state.cms
+    if cms is not None:
+        cms = jax.tree.map(lambda a: jax.device_put(a, repl), cms)
+    return FeatureState(
+        customer=place_windows(state.customer),
+        terminal=place_windows(state.terminal),
+        cms=cms,
+    )

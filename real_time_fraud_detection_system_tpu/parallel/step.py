@@ -1,0 +1,246 @@
+"""Sharded micro-batch step: shard_map over the mesh, all_to_all on ICI.
+
+Distribution contract (SURVEY §2.3 mapping):
+
+- rows arrive partitioned by **customer** (Kafka partition = customer key
+  mod P, one partition per device), so customer window state is updated and
+  queried purely device-locally;
+- **terminal** windows are owned by ``terminal_key mod n_dev``; since a
+  device's rows reference foreign terminals, the step routes
+  (key, day, amount, fraud) records to owners with one ``all_to_all``,
+  updates/queries the owner's shard, and routes the window aggregates back
+  with a second ``all_to_all`` — the ICI exchange that replaces the
+  reference's shared Iceberg feature tables (``fraud_detection.py:100-123``);
+- params/scaler are replicated; online-SGD gradients are ``psum``-reduced,
+  so every device applies the identical update (data-parallel training,
+  BASELINE.json config 4).
+
+Everything is static-shape: the exchange buffer is [n_dev × B_local] per
+field (worst case: every local row targets one owner).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from real_time_fraud_detection_system_tpu.config import Config
+from real_time_fraud_detection_system_tpu.core.batch import TxBatch
+from real_time_fraud_detection_system_tpu.features.online import (
+    FeatureState,
+    _flags,
+)
+from real_time_fraud_detection_system_tpu.features.spec import N_FEATURES
+from real_time_fraud_detection_system_tpu.models.scaler import Scaler, transform
+from real_time_fraud_detection_system_tpu.ops.windows import (
+    query_windows,
+    update_windows,
+)
+
+
+def partition_batch_by_customer(
+    cols: dict, n_dev: int, rows_per_shard: int
+) -> Tuple[dict, np.ndarray]:
+    """Host-side partitioner: layout rows as [n_dev × rows_per_shard].
+
+    Returns (columns dict with every array length n_dev*rows_per_shard,
+    gather_index) where ``gather_index[i]`` is the output position of input
+    row i (for re-assembling results in input order). Partition of a row is
+    ``customer_id % n_dev`` — the broker's key-hash analogue, sticky per
+    customer.
+    """
+    cust = cols["customer_id"]
+    n = len(cust)
+    part = (cust % n_dev).astype(np.int64)
+    order = np.argsort(part, kind="stable")
+    part_sorted = part[order]
+    rank_sorted = np.arange(n) - np.searchsorted(part_sorted, part_sorted, "left")
+    if n and rank_sorted.max() >= rows_per_shard:
+        raise ValueError(
+            f"partition overflow: >{rows_per_shard} rows on one shard; "
+            f"raise rows_per_shard or poll smaller batches"
+        )
+    pos = np.empty(n, dtype=np.int64)
+    pos[order] = part_sorted * rows_per_shard + rank_sorted
+    total = n_dev * rows_per_shard
+    out = {}
+    for k, v in cols.items():
+        buf = np.zeros(total, dtype=v.dtype)
+        buf[pos] = v
+        out[k] = buf
+    valid = np.zeros(total, dtype=bool)
+    valid[pos] = True
+    out["__valid__"] = valid
+    return out, pos
+
+
+def _route(
+    dest: jnp.ndarray,  # int32 [B] in [0, n_dev)
+    valid: jnp.ndarray,  # bool [B]
+    n_dev: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Compute (send_pos [B], recv layout capacity) for bucketed all_to_all.
+
+    send_pos[i] = dest[i] * B + rank-of-i-within-its-dest-bucket. Invalid
+    rows route to bucket slots but are masked by the caller.
+    """
+    b = dest.shape[0]
+    order = jnp.argsort(dest, stable=True)
+    sorted_dest = dest[order]
+    rank_sorted = jnp.arange(b, dtype=jnp.int32) - jnp.searchsorted(
+        sorted_dest, sorted_dest, side="left"
+    ).astype(jnp.int32)
+    rank = jnp.zeros(b, dtype=jnp.int32).at[order].set(rank_sorted)
+    return dest * b + rank, rank
+
+
+def make_sharded_step(
+    cfg: Config,
+    predict_fn: Callable,
+    loss_fn: Optional[Callable] = None,
+    online_lr: float = 0.0,
+    mesh: Optional[Mesh] = None,
+    axis: str = "data",
+):
+    """Build the jitted multi-chip step.
+
+    step(feature_state, params, scaler, batch) -> (feature_state, params,
+    probs, features); batch leaves are [n_dev*B_local] sharded on axis 0.
+    """
+    assert mesh is not None
+    n_dev = mesh.devices.size
+    fcfg = cfg.features
+    windows = tuple(fcfg.windows)
+    nw = len(windows)
+    c_cap_local = fcfg.customer_capacity // n_dev
+    t_cap_local = fcfg.terminal_capacity // n_dev
+
+    def local_step(fstate: FeatureState, params, scaler: Scaler, batch: TxBatch):
+        bl = batch.customer_key.shape[0]
+        fraud = jnp.maximum(batch.label, 0).astype(jnp.float32)
+
+        # ---- customer windows: purely local (rows partitioned by customer)
+        c_slot = ((batch.customer_key // jnp.uint32(n_dev))
+                  & jnp.uint32(c_cap_local - 1)).astype(jnp.int32)
+        customer = update_windows(
+            fstate.customer, c_slot, batch.day, batch.amount, fraud, batch.valid
+        )
+        c_count, c_amount, _ = query_windows(customer, c_slot, batch.day, windows)
+
+        # ---- terminal windows: route to owner over ICI
+        dest = (batch.terminal_key % jnp.uint32(n_dev)).astype(jnp.int32)
+        send_pos, _rank = _route(dest, batch.valid, n_dev)
+
+        def scatter(x, fill=0):
+            buf = jnp.full((n_dev * bl,), fill, dtype=x.dtype)
+            return buf.at[send_pos].set(x)
+
+        s_key = scatter(batch.terminal_key)
+        s_day = scatter(batch.day)
+        s_amount = scatter(batch.amount)
+        s_fraud = scatter(fraud)
+        s_valid = scatter(batch.valid, fill=False)
+
+        def xchg(x):
+            return jax.lax.all_to_all(
+                x.reshape(n_dev, bl), axis, split_axis=0, concat_axis=0,
+                tiled=False,
+            ).reshape(n_dev * bl)
+
+        r_key = xchg(s_key)
+        r_day = xchg(s_day)
+        r_amount = xchg(s_amount)
+        r_fraud = xchg(s_fraud)
+        r_valid = xchg(s_valid)
+
+        t_slot = ((r_key // jnp.uint32(n_dev))
+                  & jnp.uint32(t_cap_local - 1)).astype(jnp.int32)
+        terminal = update_windows(
+            fstate.terminal, t_slot, r_day, r_amount, r_fraud, r_valid
+        )
+        t_count, _, t_fraud = query_windows(
+            terminal, t_slot, r_day, windows, delay=fcfg.delay_days
+        )
+        # route aggregates back (inverse = same all_to_all on the buffers)
+        t_count_b = jnp.stack([xchg(t_count[:, i]) for i in range(nw)], axis=1)
+        t_fraud_b = jnp.stack([xchg(t_fraud[:, i]) for i in range(nw)], axis=1)
+        t_count_l = t_count_b[send_pos]
+        t_fraud_l = t_fraud_b[send_pos]
+
+        # ---- assemble the 15-feature matrix (order = features/spec.py)
+        c_avg = jnp.where(c_count > 0, c_amount / jnp.maximum(c_count, 1.0), 0.0)
+        t_risk = jnp.where(
+            t_count_l > 0, t_fraud_l / jnp.maximum(t_count_l, 1.0), 0.0
+        )
+        is_weekend, is_night = _flags(batch, fcfg)
+        cols = [batch.amount, is_weekend, is_night]
+        for i in range(nw):
+            cols.append(c_count[:, i])
+            cols.append(c_avg[:, i])
+        for i in range(nw):
+            cols.append(t_count_l[:, i])
+            cols.append(t_risk[:, i])
+        feats = jnp.stack(cols, axis=1)
+
+        # ---- score (+ optional online SGD with psum'd grads)
+        x = transform(scaler, feats)
+        probs = jnp.where(batch.valid, predict_fn(params, x), 0.0)
+        if online_lr > 0.0 and loss_fn is not None:
+            labeled = batch.valid & (batch.label >= 0)
+            y = jnp.maximum(batch.label, 0)
+            g = jax.grad(loss_fn)(params, x, y, labeled)
+            g = jax.tree.map(lambda gi: jax.lax.psum(gi, axis) / n_dev, g)
+            has = jnp.any(
+                jax.lax.psum(labeled.astype(jnp.int32), axis) > 0
+            ).astype(jnp.float32)
+            params = jax.tree.map(lambda p, gi: p - online_lr * has * gi,
+                                  params, g)
+
+        new_state = FeatureState(customer=customer, terminal=terminal,
+                                 cms=fstate.cms)
+        return new_state, params, probs, feats
+
+    try:
+        from jax import shard_map as _sm  # jax >= 0.8
+
+        def _shard_map(f, in_specs, out_specs):
+            return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                       check_vma=False)
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map as _sm
+
+        def _shard_map(f, in_specs, out_specs):
+            return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                       check_rep=False)
+
+    def spec_like(tree, spec):
+        return jax.tree.map(lambda _: spec, tree)
+
+    def build(fstate_template, params_template, scaler_template, batch_template):
+        in_specs = (
+            FeatureState(
+                customer=spec_like(fstate_template.customer, P(axis, None)),
+                terminal=spec_like(fstate_template.terminal, P(axis, None)),
+                cms=spec_like(fstate_template.cms, P())
+                if fstate_template.cms is not None
+                else None,
+            ),
+            spec_like(params_template, P()),
+            spec_like(scaler_template, P()),
+            spec_like(batch_template, P(axis)),
+        )
+        out_specs = (
+            in_specs[0],
+            in_specs[1],
+            P(axis),
+            P(axis, None),
+        )
+        fn = _shard_map(local_step, in_specs, out_specs)
+        return jax.jit(fn, donate_argnums=(0,))
+
+    return build

@@ -1,0 +1,9 @@
+from real_time_fraud_detection_system_tpu.runtime.sources import (  # noqa: F401
+    InProcBroker,
+    ReplaySource,
+    SyntheticSource,
+)
+from real_time_fraud_detection_system_tpu.runtime.engine import (  # noqa: F401
+    EngineState,
+    ScoringEngine,
+)

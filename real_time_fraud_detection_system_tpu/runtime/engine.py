@@ -1,0 +1,237 @@
+"""Micro-batch scoring engine — the Spark Structured Streaming replacement.
+
+The reference's hot loop (``fraud_detection.py:204-211`` + SURVEY §3.1) is:
+Iceberg snapshot scan → SQL join → Arrow → Python UDF → sklearn → Iceberg
+append, crossing four process boundaries per batch. Here the loop is: source
+poll → host dedup/pad → ``device_put`` → ONE jitted ``step`` (feature state
+scatter/gather + scale + classify [+ online SGD]) → sink append. The
+feature state and weights never leave HBM; the jit cache is keyed by bucket
+size only.
+
+``--scorer {cpu,tpu}`` (reference north star): ``tpu`` runs the jitted
+classifier; ``cpu`` runs the sklearn oracle on the same features, for parity
+and baseline measurement.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from real_time_fraud_detection_system_tpu.config import Config
+from real_time_fraud_detection_system_tpu.core.batch import (
+    TxBatch,
+    bucket_size,
+    make_batch,
+)
+from real_time_fraud_detection_system_tpu.features.online import (
+    FeatureState,
+    init_feature_state,
+    update_and_featurize,
+)
+from real_time_fraud_detection_system_tpu.features.spec import N_FEATURES
+from real_time_fraud_detection_system_tpu.models.forest import (
+    TreeEnsemble,
+    ensemble_predict_proba,
+)
+from real_time_fraud_detection_system_tpu.models.logreg import (
+    LogRegParams,
+    logreg_loss,
+    logreg_predict_proba,
+)
+from real_time_fraud_detection_system_tpu.models.mlp import (
+    mlp_loss,
+    mlp_predict_proba,
+)
+from real_time_fraud_detection_system_tpu.models.scaler import Scaler, transform
+from real_time_fraud_detection_system_tpu.ops.dedup import latest_wins_mask_np
+
+
+def predict_fn_for(kind: str) -> Callable:
+    if kind == "logreg":
+        return logreg_predict_proba
+    if kind == "mlp":
+        return mlp_predict_proba
+    if kind in ("tree", "forest"):
+        return ensemble_predict_proba
+    raise ValueError(f"unknown model kind {kind}")
+
+
+def loss_fn_for(kind: str) -> Optional[Callable]:
+    if kind == "logreg":
+        return logreg_loss
+    if kind == "mlp":
+        return mlp_loss
+    return None  # tree ensembles have no gradient path
+
+
+@dataclass
+class EngineState:
+    """Host-visible engine state (device pytrees + offsets + counters)."""
+
+    feature_state: FeatureState
+    params: object
+    scaler: Scaler
+    offsets: List[int] = field(default_factory=list)
+    batches_done: int = 0
+    rows_done: int = 0
+
+
+@dataclass
+class BatchResult:
+    tx_id: np.ndarray
+    tx_datetime_us: np.ndarray
+    customer_id: np.ndarray
+    terminal_id: np.ndarray
+    amount_cents: np.ndarray
+    features: np.ndarray  # [n, 15]
+    probs: np.ndarray  # [n]
+    latency_s: float
+
+
+class ScoringEngine:
+    """Drives source → jitted step → sink.
+
+    ``online_lr > 0`` enables in-step online SGD from labeled rows
+    (BASELINE.json config 4) for differentiable model kinds.
+    """
+
+    def __init__(
+        self,
+        cfg: Config,
+        kind: str,
+        params,
+        scaler: Scaler,
+        feature_state: Optional[FeatureState] = None,
+        scorer: Optional[str] = None,
+        cpu_model=None,
+        online_lr: float = 0.0,
+    ):
+        self.cfg = cfg
+        self.kind = kind
+        self.scorer = scorer or cfg.runtime.scorer
+        self.cpu_model = cpu_model
+        self.online_lr = online_lr
+        self.state = EngineState(
+            feature_state=feature_state or init_feature_state(cfg.features),
+            params=params,
+            scaler=scaler,
+        )
+        self._predict = predict_fn_for(kind)
+        self._loss = loss_fn_for(kind)
+        fcfg = cfg.features
+
+        def step(fstate: FeatureState, params, scaler: Scaler, batch: TxBatch):
+            fstate, feats = update_and_featurize(fstate, batch, fcfg)
+            x = transform(scaler, feats)
+            probs = self._predict(params, x)
+            probs = jnp.where(batch.valid, probs, 0.0)
+            if self.online_lr > 0.0 and self._loss is not None:
+                labeled = batch.valid & (batch.label >= 0)
+                y = jnp.maximum(batch.label, 0)
+                g = jax.grad(self._loss)(params, x, y, labeled)
+                has = jnp.any(labeled).astype(jnp.float32)
+                params = jax.tree.map(
+                    lambda p, gi: p - self.online_lr * has * gi, params, g
+                )
+            return fstate, params, probs, feats
+
+        self._step = jax.jit(step, donate_argnums=(0,))
+
+    def process_batch(self, cols: dict) -> BatchResult:
+        """One micro-batch: dedup → pad → device step → host result."""
+        t0 = time.perf_counter()
+        # Latest-wins dedup by tx_id (reference ROW_NUMBER/MERGE semantics,
+        # kafka_s3_sink_transactions.py:173-222) on host — tx_ids are int64.
+        keep = latest_wins_mask_np(cols["tx_id"], cols["kafka_ts_ms"])
+        cols = {k: v[keep] for k, v in cols.items()}
+        n = len(cols["tx_id"])
+        pad = bucket_size(n, self.cfg.runtime.batch_buckets)
+        batch = make_batch(
+            customer_id=cols["customer_id"],
+            terminal_id=cols["terminal_id"],
+            tx_datetime_us=cols["tx_datetime_us"],
+            amount_cents=cols["tx_amount_cents"],
+            label=cols.get("label"),
+            pad_to=pad,
+        )
+        jbatch = jax.tree.map(jnp.asarray, batch)
+        fstate, params, probs, feats = self._step(
+            self.state.feature_state, self.state.params, self.state.scaler, jbatch
+        )
+        self.state.feature_state = fstate
+        self.state.params = params
+
+        feats_np = np.asarray(feats)[:n]
+        if self.scorer == "cpu":
+            # parity oracle: sklearn pipeline on the same features
+            probs_np = self.cpu_model.predict_proba(feats_np.astype(np.float64))
+        else:
+            probs_np = np.asarray(probs)[:n]
+        self.state.batches_done += 1
+        self.state.rows_done += n
+        return BatchResult(
+            tx_id=cols["tx_id"],
+            tx_datetime_us=cols["tx_datetime_us"],
+            customer_id=cols["customer_id"],
+            terminal_id=cols["terminal_id"],
+            amount_cents=cols["tx_amount_cents"],
+            features=feats_np,
+            probs=probs_np,
+            latency_s=time.perf_counter() - t0,
+        )
+
+    def run(
+        self,
+        source,
+        sink=None,
+        max_batches: int = 0,
+        checkpointer=None,
+        trigger_seconds: Optional[float] = None,
+    ) -> dict:
+        """Stream until the source is exhausted (or max_batches).
+
+        Returns run stats (rows, batches, throughput, latency percentiles).
+        """
+        trigger = (
+            self.cfg.runtime.trigger_seconds
+            if trigger_seconds is None
+            else trigger_seconds
+        )
+        latencies: List[float] = []
+        t_start = time.perf_counter()
+        while True:
+            if max_batches and self.state.batches_done >= max_batches:
+                break
+            cols = source.poll_batch()
+            if cols is None:
+                break
+            res = self.process_batch(cols)
+            self.state.offsets = list(source.offsets)
+            latencies.append(res.latency_s)
+            if sink is not None:
+                sink.append(res)
+            if (
+                checkpointer is not None
+                and self.state.batches_done
+                % self.cfg.runtime.checkpoint_every_batches
+                == 0
+            ):
+                checkpointer.save(self.state)
+            if trigger > 0:
+                time.sleep(max(0.0, trigger - res.latency_s))
+        wall = time.perf_counter() - t_start
+        lat = np.asarray(latencies) if latencies else np.zeros(1)
+        return {
+            "rows": self.state.rows_done,
+            "batches": self.state.batches_done,
+            "wall_s": wall,
+            "rows_per_s": self.state.rows_done / wall if wall > 0 else 0.0,
+            "latency_p50_ms": float(np.percentile(lat, 50) * 1e3),
+            "latency_p99_ms": float(np.percentile(lat, 99) * 1e3),
+        }

@@ -1,0 +1,235 @@
+"""Stream sources: partitioned in-process broker, replay, live synthesis.
+
+The reference's transport is Kafka topics fed by Debezium
+(``docker-compose.yml:14-51``); partitioning is its data-parallel unit
+(SURVEY §2.3). For dev/test/bench without Docker the framework provides:
+
+- :class:`InProcBroker` — a Kafka-semantics in-process log: topics ×
+  partitions, append-only, offset-addressed, key-hash partition assignment.
+  Producers/consumers share it; consumers poll (partition, offset) ranges.
+- :class:`ReplaySource` — replays a generated :class:`Transactions` table
+  through the broker as Debezium envelopes (exercising the codec) or as
+  raw columnar slices (the zero-parse benchmark path).
+- :class:`SyntheticSource` — paced live generator, the ``datagen`` container
+  analogue (``datagen/data_gen.py:116-135``, one tx/10 s demo rate, here
+  configurable up to line rate).
+
+A real ``KafkaSource`` (confluent-kafka/kafka-python) plugs in behind the
+same ``poll_batch`` interface; the client libraries are not present in this
+image, so it is import-gated.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from real_time_fraud_detection_system_tpu.core.envelope import (
+    decode_transaction_envelopes,
+    encode_transaction_envelopes,
+)
+from real_time_fraud_detection_system_tpu.data.generator import (
+    Transactions,
+)
+
+
+@dataclass
+class _Record:
+    offset: int
+    ts_ms: int
+    key: bytes
+    value: bytes
+
+
+class InProcBroker:
+    """Partitioned append-only log with Kafka offset semantics."""
+
+    def __init__(self, n_partitions: int = 8):
+        self.n_partitions = n_partitions
+        self._topics: Dict[str, List[List[_Record]]] = {}
+        self._lock = threading.Lock()
+
+    def _topic(self, name: str) -> List[List[_Record]]:
+        with self._lock:
+            if name not in self._topics:
+                self._topics[name] = [[] for _ in range(self.n_partitions)]
+            return self._topics[name]
+
+    def partition_of(self, key: bytes) -> int:
+        # FNV-1a over the key bytes — stable across runs/processes.
+        h = 2166136261
+        for byte in key:
+            h = ((h ^ byte) * 16777619) & 0xFFFFFFFF
+        return h % self.n_partitions
+
+    def produce(
+        self, topic: str, key: bytes, value: bytes, ts_ms: int = 0,
+        partition: Optional[int] = None,
+    ) -> Tuple[int, int]:
+        part = self.partition_of(key) if partition is None else partition
+        log = self._topic(topic)[part]
+        with self._lock:
+            off = len(log)
+            log.append(_Record(off, ts_ms, key, value))
+        return part, off
+
+    def produce_many(
+        self, topic: str, keys: Sequence[bytes], values: Sequence[bytes],
+        ts_ms: Optional[Sequence[int]] = None,
+    ) -> None:
+        for i, (k, v) in enumerate(zip(keys, values)):
+            self.produce(topic, k, v, ts_ms[i] if ts_ms is not None else 0)
+
+    def poll(
+        self, topic: str, partition: int, offset: int, max_records: int
+    ) -> List[_Record]:
+        log = self._topic(topic)[partition]
+        with self._lock:
+            return log[offset : offset + max_records]
+
+    def end_offsets(self, topic: str) -> List[int]:
+        t = self._topic(topic)
+        with self._lock:
+            return [len(p) for p in t]
+
+
+class ReplaySource:
+    """Serves micro-batches from a transactions table.
+
+    ``mode='columnar'`` returns numpy column dicts directly (zero-parse
+    benchmark path); ``mode='envelope'`` round-trips rows through Debezium
+    JSON envelopes in an :class:`InProcBroker`, exercising decode exactly as
+    a Kafka deployment would.
+    """
+
+    def __init__(
+        self,
+        txs: Transactions,
+        start_epoch_s: int,
+        batch_rows: int = 4096,
+        mode: str = "columnar",
+        n_partitions: int = 8,
+        with_labels: bool = False,
+    ):
+        self.txs = txs
+        self.start_epoch_s = start_epoch_s
+        self.batch_rows = batch_rows
+        self.mode = mode
+        self.with_labels = with_labels
+        self.n_partitions = n_partitions
+        self._pos = 0
+        if mode == "envelope":
+            self.broker = InProcBroker(n_partitions)
+            t_us = txs.epoch_us(start_epoch_s)
+            msgs = encode_transaction_envelopes(
+                txs.tx_id, t_us, txs.customer_id, txs.terminal_id,
+                txs.amount_cents,
+            )
+            keys = [str(int(c)).encode() for c in txs.customer_id]
+            self.broker.produce_many(
+                "debezium.payment.transactions", keys, msgs,
+                ts_ms=(t_us // 1000).tolist(),
+            )
+            self._offsets = [0] * n_partitions
+
+    def poll_batch(self) -> Optional[dict]:
+        """Next micro-batch as a column dict (None when exhausted)."""
+        if self.mode == "columnar":
+            n = self.txs.n
+            if self._pos >= n:
+                return None
+            s, e = self._pos, min(self._pos + self.batch_rows, self.txs.n)
+            self._pos = e
+            part = self.txs.slice(slice(s, e))
+            cols = {
+                "tx_id": part.tx_id,
+                "tx_datetime_us": part.epoch_us(self.start_epoch_s),
+                "customer_id": part.customer_id,
+                "terminal_id": part.terminal_id,
+                "tx_amount_cents": part.amount_cents,
+                "kafka_ts_ms": part.epoch_us(self.start_epoch_s) // 1000,
+            }
+            if self.with_labels:
+                cols["label"] = part.tx_fraud.astype(np.int32)
+            return cols
+
+        # envelope mode: round-robin partition polling up to batch_rows
+        per = max(1, self.batch_rows // self.n_partitions)
+        msgs: List[bytes] = []
+        ts: List[int] = []
+        for p in range(self.n_partitions):
+            recs = self.broker.poll(
+                "debezium.payment.transactions", p, self._offsets[p], per
+            )
+            self._offsets[p] += len(recs)
+            msgs += [r.value for r in recs]
+            ts += [r.ts_ms for r in recs]
+        if not msgs:
+            return None
+        cols, invalid = decode_transaction_envelopes(msgs, ts)
+        if invalid.any():
+            keep = ~invalid
+            cols = {k: v[keep] for k, v in cols.items()}
+        return cols
+
+    @property
+    def offsets(self) -> List[int]:
+        if self.mode == "columnar":
+            return [self._pos]
+        return list(self._offsets)
+
+    def seek(self, offsets: Sequence[int]) -> None:
+        """Restore consumption position (checkpoint resume)."""
+        if self.mode == "columnar":
+            self._pos = int(offsets[0])
+        else:
+            self._offsets = list(offsets)
+
+
+class SyntheticSource:
+    """Paced live generator — the ``datagen`` container analogue.
+
+    Yields batches at ``rate_tps`` transactions/second of wall-clock (or as
+    fast as possible when 0), drawing from a pre-generated table.
+    """
+
+    def __init__(
+        self,
+        txs: Transactions,
+        start_epoch_s: int,
+        rate_tps: float = 0.0,
+        batch_rows: int = 4096,
+    ):
+        self._replay = ReplaySource(txs, start_epoch_s, batch_rows, "columnar")
+        self.rate_tps = rate_tps
+
+    def poll_batch(self) -> Optional[dict]:
+        import time
+
+        cols = self._replay.poll_batch()
+        if cols is not None and self.rate_tps > 0:
+            time.sleep(len(cols["tx_id"]) / self.rate_tps)
+        return cols
+
+    @property
+    def offsets(self) -> List[int]:
+        return self._replay.offsets
+
+    def seek(self, offsets: Sequence[int]) -> None:
+        self._replay.seek(offsets)
+
+
+def make_kafka_source(*args, **kwargs):  # pragma: no cover - gated
+    """Real Kafka consumer (not available in this image)."""
+    try:
+        import confluent_kafka  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "confluent-kafka is not installed in this environment; use "
+            "InProcBroker/ReplaySource for dev, or install a Kafka client "
+            "in production images."
+        ) from e
+    raise NotImplementedError

@@ -1,0 +1,39 @@
+"""Test harness: force an 8-device virtual CPU mesh before jax initializes.
+
+Multi-chip sharding tests run on CPU with
+``--xla_force_host_platform_device_count=8`` (SURVEY §4's implication:
+multi-chip tests must be runnable without TPU hardware).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# A TPU-proxy plugin (if any) may have force-set jax_platforms at interpreter
+# start (sitecustomize); tests must run on the virtual CPU mesh regardless.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def small_dataset():
+    from real_time_fraud_detection_system_tpu.config import DataConfig
+    from real_time_fraud_detection_system_tpu.data import generate_dataset
+
+    cfg = DataConfig(n_customers=120, n_terminals=240, n_days=45, seed=7)
+    customers, terminals, txs = generate_dataset(cfg)
+    return cfg, customers, terminals, txs
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,67 @@
+"""Golden tests for the Debezium envelope codec (SURVEY §7 layer 1)."""
+
+import base64
+
+import numpy as np
+
+from real_time_fraud_detection_system_tpu.core.envelope import (
+    decode_decimal_batch,
+    decode_decimal_bytes,
+    decode_transaction_envelopes,
+    encode_decimal_cents,
+    encode_transaction_envelope,
+    encode_transaction_envelopes,
+)
+
+
+def test_decimal_golden_values():
+    # Hand-computed big-endian signed encodings of DECIMAL(10,2) cents.
+    golden = {
+        0: b"\x00",
+        1: b"\x01",
+        127: b"\x7f",
+        128: b"\x00\x80",
+        256: b"\x01\x00",
+        12345: b"\x30\x39",
+        -1: b"\xff",
+        -128: b"\x80",
+        -129: b"\xff\x7f",
+        99999999999: b"\x17\x48\x76\xe7\xff",
+    }
+    for cents, raw in golden.items():
+        assert decode_decimal_bytes(raw) == cents
+        assert base64.b64decode(encode_decimal_cents(cents)) == raw
+
+
+def test_decimal_batch_matches_scalar(rng):
+    cents = rng.integers(-(10**10), 10**10, size=500)
+    raws = [base64.b64decode(encode_decimal_cents(c)) for c in cents]
+    out = decode_decimal_batch(raws)
+    assert np.array_equal(out, cents)
+
+
+def test_envelope_roundtrip(rng):
+    n = 200
+    tx_id = np.arange(n, dtype=np.int64)
+    t_us = rng.integers(1_700_000_000, 1_800_000_000, n) * 1_000_000
+    cust = rng.integers(0, 5000, n)
+    term = rng.integers(0, 10000, n)
+    cents = rng.integers(1, 10**7, n)
+    msgs = encode_transaction_envelopes(tx_id, t_us, cust, term, cents)
+    cols, invalid = decode_transaction_envelopes(msgs)
+    assert not invalid.any()
+    assert np.array_equal(cols["tx_id"], tx_id)
+    assert np.array_equal(cols["tx_datetime_us"], t_us)
+    assert np.array_equal(cols["customer_id"], cust)
+    assert np.array_equal(cols["terminal_id"], term)
+    assert np.array_equal(cols["tx_amount_cents"], cents)
+    assert np.all(cols["op"] == 0)
+
+
+def test_envelope_delete_and_tombstone():
+    m_del = encode_transaction_envelope(7, 1_000_000, 1, 2, 500, op="d")
+    tomb = b'{"schema": null, "payload": null}'
+    junk = b"not json"
+    cols, invalid = decode_transaction_envelopes([m_del, tomb, junk])
+    assert invalid.tolist() == [False, True, True]
+    assert cols["tx_id"][0] == 7 and cols["op"][0] == 2

@@ -1,0 +1,67 @@
+"""Synthetic generator: distributions, fraud scenarios, determinism."""
+
+import numpy as np
+
+from real_time_fraud_detection_system_tpu.config import DataConfig
+from real_time_fraud_detection_system_tpu.data import (
+    add_frauds,
+    generate_customer_profiles,
+    generate_dataset,
+    generate_terminal_profiles,
+)
+
+
+def test_profiles_distributions():
+    c = generate_customer_profiles(2000, seed=1)
+    assert c.x.min() >= 0 and c.x.max() <= 100
+    assert c.mean_amount.min() >= 5 and c.mean_amount.max() <= 100
+    assert np.allclose(c.std_amount, c.mean_amount / 2)
+    assert 1.7 < c.mean_nb_tx_per_day.mean() < 2.3  # U(0,4) mean ≈ 2
+    t = generate_terminal_profiles(1000, seed=1)
+    assert t.x.min() >= 0 and t.x.max() <= 100
+
+
+def test_dataset_deterministic(small_dataset):
+    cfg, _, _, txs = small_dataset
+    _, _, txs2 = generate_dataset(cfg)
+    assert np.array_equal(txs.tx_time_seconds, txs2.tx_time_seconds)
+    assert np.array_equal(txs.amount_cents, txs2.amount_cents)
+    assert np.array_equal(txs.tx_fraud, txs2.tx_fraud)
+
+
+def test_transactions_chronological_and_ids(small_dataset):
+    _, _, _, txs = small_dataset
+    assert np.all(np.diff(txs.tx_time_seconds) >= 0)
+    assert np.array_equal(txs.tx_id, np.arange(txs.n))
+    # times inside day bounds
+    tod = txs.tx_time_seconds - txs.tx_time_days.astype(np.int64) * 86400
+    assert tod.min() > 0 and tod.max() < 86400
+
+
+def test_fraud_scenarios_present(small_dataset):
+    _, _, _, txs = small_dataset
+    scen = set(np.unique(txs.tx_fraud_scenario).tolist())
+    assert {0, 2, 3}.issubset(scen)  # scenario 1 may be empty on tiny data
+    # scenario 1 semantics: amount > 220 ⇒ fraud (unless overwritten by 3)
+    over = txs.amount_cents > 22000
+    assert np.all(txs.tx_fraud[over] == 1)
+    # labels only in {0,1}
+    assert set(np.unique(txs.tx_fraud).tolist()).issubset({0, 1})
+
+
+def test_fraud_rate_realistic():
+    cfg = DataConfig(n_customers=500, n_terminals=1000, n_days=90)
+    _, _, txs = generate_dataset(cfg)
+    rate = txs.tx_fraud.mean()
+    assert 0.002 < rate < 0.2  # reference implied ~0.9% at full scale
+
+
+def test_terminal_in_radius(small_dataset):
+    cfg, customers, terminals, txs = small_dataset
+    # every tx terminal must be within radius of its customer
+    cx = customers.x[txs.customer_id]
+    cy = customers.y[txs.customer_id]
+    tx = terminals.x[txs.terminal_id]
+    ty = terminals.y[txs.terminal_id]
+    d = np.sqrt((cx - tx) ** 2 + (cy - ty) ** 2)
+    assert d.max() < cfg.radius

@@ -1,0 +1,125 @@
+"""Model-layer tests: sklearn parity for scaler/forest/metrics; training."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from real_time_fraud_detection_system_tpu.models import (
+    average_precision,
+    ensemble_from_sklearn,
+    ensemble_predict_proba,
+    fit_scaler,
+    roc_auc,
+    threshold_based_metrics,
+    train_logreg,
+    transform,
+)
+from real_time_fraud_detection_system_tpu.models.logreg import (
+    logreg_predict_proba,
+)
+
+
+@pytest.fixture(scope="module")
+def xy(rng):
+    n, f = 3000, 15
+    x = rng.normal(0, 1, (n, f))
+    w = rng.normal(0, 1, f)
+    logits = x @ w - 2.0
+    y = (rng.random(n) < 1 / (1 + np.exp(-logits))).astype(np.float64)
+    return x, y
+
+
+def test_scaler_matches_sklearn(xy):
+    from sklearn.preprocessing import StandardScaler
+
+    x, _ = xy
+    ours = fit_scaler(x)
+    theirs = StandardScaler().fit(x)
+    np.testing.assert_allclose(np.asarray(ours.mean), theirs.mean_, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(ours.scale), theirs.scale_, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(transform(ours, jnp.asarray(x, jnp.float32))),
+        theirs.transform(x),
+        atol=1e-3,
+    )
+
+
+def test_forest_gemm_exactly_matches_sklearn(xy):
+    """The tensorized traversal must reproduce sklearn predict_proba."""
+    from sklearn.ensemble import RandomForestClassifier
+
+    x, y = xy
+    clf = RandomForestClassifier(n_estimators=20, max_depth=6, random_state=0)
+    clf.fit(x, y)
+    ens = ensemble_from_sklearn(clf, x.shape[1])
+    # Production inputs are f32; the oracle sees the same f32-quantized rows.
+    x32 = x.astype(np.float32)
+    ours = np.asarray(ensemble_predict_proba(ens, jnp.asarray(x32)))
+    theirs = clf.predict_proba(x32.astype(np.float64))[:, 1]
+    np.testing.assert_allclose(ours, theirs, atol=1e-5)
+    # ranking must be essentially identical
+    assert abs(roc_auc(y, ours) - roc_auc(y, theirs)) < 1e-3
+    # the GEMM formulation must agree with the gather traversal
+    from real_time_fraud_detection_system_tpu.models.forest import (
+        gemm_predict_proba,
+        to_gemm,
+    )
+
+    g = to_gemm(ens, x.shape[1])
+    ours_gemm = np.asarray(gemm_predict_proba(g, jnp.asarray(x32)))
+    np.testing.assert_allclose(ours_gemm, ours, atol=1e-5)
+
+
+def test_decision_tree_depth2(xy):
+    """The reference's DT-2 baseline model family."""
+    from sklearn.tree import DecisionTreeClassifier
+
+    x, y = xy
+    clf = DecisionTreeClassifier(max_depth=2, random_state=0).fit(x, y)
+    ens = ensemble_from_sklearn(clf, x.shape[1])
+    ours = np.asarray(ensemble_predict_proba(ens, jnp.asarray(x, jnp.float32)))
+    np.testing.assert_allclose(ours, clf.predict_proba(x)[:, 1], atol=1e-4)
+
+
+def test_metrics_match_sklearn(xy, rng):
+    from sklearn.metrics import average_precision_score, roc_auc_score
+
+    x, y = xy
+    score = rng.random(len(y))
+    assert abs(roc_auc(y, score) - roc_auc_score(y, score)) < 1e-9
+    assert (
+        abs(average_precision(y, score) - average_precision_score(y, score)) < 1e-9
+    )
+    # with heavy ties
+    score_t = np.round(score, 1)
+    assert abs(roc_auc(y, score_t) - roc_auc_score(y, score_t)) < 1e-9
+    assert (
+        abs(average_precision(y, score_t) - average_precision_score(y, score_t))
+        < 1e-9
+    )
+
+
+def test_threshold_metrics_consistency(xy, rng):
+    _, y = xy
+    score = rng.random(len(y))
+    m = threshold_based_metrics(y, score, thresholds=(0.5,))[0.5]
+    assert 0 <= m["TPR"] <= 1 and 0 <= m["FPR"] <= 1
+    assert abs(m["G-mean"] - np.sqrt(m["TPR"] * m["TNR"])) < 1e-9
+
+
+def test_logreg_learns(xy):
+    x, y = xy
+    params = train_logreg(x.astype(np.float32), y, epochs=10, batch_size=512)
+    p = np.asarray(logreg_predict_proba(params, jnp.asarray(x, jnp.float32)))
+    assert roc_auc(y, p) > 0.85
+
+
+def test_card_precision_top_k():
+    from real_time_fraud_detection_system_tpu.models import card_precision_top_k
+
+    # 1 day, 5 customers; top-2 by max score are customers 4 (fraud) and 3 (not)
+    days = np.zeros(6)
+    cust = np.asarray([0, 1, 2, 3, 4, 4])
+    score = np.asarray([0.1, 0.2, 0.3, 0.8, 0.5, 0.9])
+    fraud = np.asarray([0, 0, 0, 0, 1, 1])
+    assert card_precision_top_k(fraud, score, days, cust, k=2) == 0.5

@@ -1,0 +1,171 @@
+"""Op-level tests: window ring buffers vs brute force, CMS bounds, dedup."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from real_time_fraud_detection_system_tpu.ops import (
+    cms_init,
+    cms_query,
+    cms_update,
+    hash_u32,
+    init_window_state,
+    latest_wins_mask,
+    latest_wins_mask_np,
+    multi_hash,
+    query_windows,
+    slot_of,
+    update_windows,
+)
+
+
+def _brute_windows(events, key, day, windows, delay=0):
+    """events: list of (key, day, amount, fraud). Sums over [day-delay-w+1, day-delay]."""
+    out = []
+    for w in windows:
+        lo, hi = day - delay - w + 1, day - delay
+        sel = [(a, f) for k, d, a, f in events if k == key and lo <= d <= hi]
+        out.append(
+            (len(sel), sum(a for a, _ in sel), sum(f for _, f in sel))
+        )
+    return out
+
+
+def test_windows_match_brute_force(rng):
+    windows = (1, 7, 30)
+    state = init_window_state(64, 40)
+    events = []
+    day0 = 20000
+    for step in range(6):
+        b = 32
+        keys = rng.integers(0, 8, b).astype(np.uint32)
+        days = (day0 + step * 2 + rng.integers(0, 2, b)).astype(np.int32)
+        amts = rng.uniform(1, 100, b).astype(np.float32)
+        frauds = (rng.random(b) < 0.2).astype(np.float32)
+        valid = np.ones(b, bool)
+        slot = slot_of(jnp.asarray(keys), 64)
+        state = update_windows(
+            state, slot, jnp.asarray(days), jnp.asarray(amts),
+            jnp.asarray(frauds), jnp.asarray(valid),
+        )
+        events += [
+            (int(k), int(d), float(a), float(f))
+            for k, d, a, f in zip(keys, days, amts, frauds)
+        ]
+    # distinct keys 0..7 hash to distinct slots in a 64-slot table? verify:
+    slots = np.asarray(slot_of(jnp.arange(8, dtype=jnp.uint32), 64))
+    assert len(set(slots.tolist())) == 8, "collision in test setup; adjust capacity"
+
+    qday = day0 + 11
+    for key in range(8):
+        s = slot_of(jnp.asarray([key], dtype=jnp.uint32), 64)
+        c, a, f = query_windows(state, s, jnp.asarray([qday], dtype=jnp.int32), windows)
+        for i, w in enumerate(windows):
+            bc, ba, bf = _brute_windows(events, key, qday, [w])[0]
+            assert int(c[0, i]) == bc
+            assert abs(float(a[0, i]) - ba) < 1e-2
+            assert int(f[0, i]) == bf
+    # delayed query
+    for key in range(8):
+        s = slot_of(jnp.asarray([key], dtype=jnp.uint32), 64)
+        c, a, f = query_windows(
+            state, s, jnp.asarray([qday], dtype=jnp.int32), windows, delay=7
+        )
+        for i, w in enumerate(windows):
+            bc, ba, bf = _brute_windows(events, key, qday, [w], delay=7)[0]
+            assert int(c[0, i]) == bc
+            assert int(f[0, i]) == bf
+
+
+def test_windows_ring_eviction():
+    """Buckets wrap after n_buckets days; old days must vanish, not alias."""
+    nb = 8
+    state = init_window_state(16, nb)
+    one = jnp.ones(1, jnp.float32)
+    v = jnp.ones(1, bool)
+    s0 = jnp.zeros(1, jnp.int32)
+    d = lambda x: jnp.asarray([x], jnp.int32)
+    state = update_windows(state, s0, d(100), one, one * 0, v)
+    c, _, _ = query_windows(state, s0, d(100), (1,))
+    assert int(c[0, 0]) == 1
+    # day 108 maps to the same bucket (108 % 8 == 100 % 8): evicts day 100
+    state = update_windows(state, s0, d(108), one, one * 0, v)
+    c, _, _ = query_windows(state, s0, d(108), (1,))
+    assert int(c[0, 0]) == 1  # only the new day
+    # stale late event for day 100 must be dropped, not corrupt day 108
+    state = update_windows(state, s0, d(100), one, one * 0, v)
+    c, _, _ = query_windows(state, s0, d(108), (1,))
+    assert int(c[0, 0]) == 1
+    c, _, _ = query_windows(state, s0, d(100), (1,))
+    assert int(c[0, 0]) == 0
+
+
+def test_windows_invalid_rows_ignored():
+    state = init_window_state(16, 8)
+    s0 = jnp.zeros(4, jnp.int32)
+    days = jnp.full(4, 50, jnp.int32)
+    amts = jnp.ones(4, jnp.float32)
+    valid = jnp.asarray([True, False, True, False])
+    state = update_windows(state, s0, days, amts, amts * 0, valid)
+    c, a, _ = query_windows(state, jnp.zeros(1, jnp.int32), jnp.asarray([50], jnp.int32), (1,))
+    assert int(c[0, 0]) == 2
+    assert abs(float(a[0, 0]) - 2.0) < 1e-6
+
+
+def test_cms_overestimates_and_windows(rng):
+    sk = cms_init(depth=4, width=1 << 10, n_days=8)
+    keys = rng.integers(0, 50, 400).astype(np.uint32)
+    days = rng.integers(100, 103, 400).astype(np.int32)
+    amts = np.ones(400, np.float32)
+    sk = cms_update(sk, jnp.asarray(keys), jnp.asarray(amts), jnp.asarray(days),
+                    jnp.ones(400, bool))
+    qc, qa = cms_query(sk, jnp.asarray(keys), jnp.asarray(days), (1, 7))
+    # exact per-(key,day) counts
+    for i in range(0, 400, 37):
+        true_1d = np.sum((keys == keys[i]) & (days == days[i]))
+        true_7d = np.sum((keys == keys[i]) & (days <= days[i]) & (days > days[i] - 7))
+        assert qc[i, 0] >= true_1d  # CMS never underestimates
+        assert qc[i, 1] >= true_7d
+        assert qc[i, 0] <= true_1d + 40  # loose collision bound
+    # amounts track counts here (unit amounts)
+    assert np.allclose(np.asarray(qc), np.asarray(qa), atol=1e-3)
+
+
+def test_dedup_matches_numpy(rng):
+    b = 256
+    keys = rng.integers(0, 40, b)
+    ts = rng.integers(0, 10, b)
+    valid = rng.random(b) < 0.9
+    m_np = latest_wins_mask_np(keys, ts, valid)
+    m_j = np.asarray(
+        latest_wins_mask(
+            jnp.asarray(keys.astype(np.uint32)), jnp.asarray(ts.astype(np.int32)),
+            jnp.asarray(valid),
+        )
+    )
+    assert np.array_equal(m_np, m_j)
+    # exactly one winner per valid key
+    for k in np.unique(keys[valid]):
+        sel = m_np & (keys == k)
+        assert sel.sum() == 1
+        i = np.nonzero(sel)[0][0]
+        group = (keys == k) & valid
+        assert ts[i] == ts[group].max()
+    # winner is the LAST occurrence among max-ts rows (Kafka log order)
+    keys2 = np.zeros(4, dtype=np.int64)
+    ts2 = np.asarray([5, 5, 3, 5])
+    m = latest_wins_mask_np(keys2, ts2)
+    assert m.tolist() == [False, False, False, True]
+
+
+def test_hashing_ranges_and_dispersion():
+    keys = jnp.arange(10000, dtype=jnp.uint32)
+    s = np.asarray(slot_of(keys, 1 << 10))
+    assert s.min() >= 0 and s.max() < (1 << 10)
+    counts = np.bincount(s, minlength=1 << 10)
+    assert counts.max() < 40  # ~9.8 expected; catastrophic clustering fails
+    h = np.asarray(multi_hash(keys, 4, 1 << 12))
+    assert h.shape == (4, 10000)
+    # rows must be (near-)independent
+    assert (h[0] == h[1]).mean() < 0.01
+    # determinism
+    assert np.array_equal(np.asarray(hash_u32(keys)), np.asarray(hash_u32(keys)))
