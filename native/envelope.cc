@@ -1,0 +1,221 @@
+// Native Debezium transaction-envelope decoder.
+//
+// Host-side ingest at benchmark rates bottlenecks on JSON parsing long
+// before the TPU (SURVEY §7 "hard parts": 1M txns/s of envelopes). This is
+// the C++ drop-in behind the same columnar interface as the Python decoder
+// (real_time_fraud_detection_system_tpu/core/envelope.py): a single-pass
+// field scanner specialized to the Debezium envelope layout produced by
+// Kafka's JSON converter (reference schema:
+// pyspark/scripts/kafka_s3_sink_transactions.py:77-126), including the
+// base64 big-endian signed DECIMAL(10,2) amounts
+// (kafka_s3_sink_transactions.py:63-73).
+//
+// Contract (mirrors the Python decoder):
+//   - take payload.after, falling back to payload.before (delete events);
+//   - null payload / missing row image / malformed JSON => valid=0;
+//   - op codes: c=0, u=1, d=2, r=3;
+//   - amounts decoded to int64 cents (never floats).
+//
+// Build: g++ -O3 -march=native -shared -fPIC -o libenvelope.so envelope.cc
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// base64 decode table: 0-63 valid, 255 invalid, 254 padding '='
+const uint8_t kB64[256] = {
+    255,255,255,255,255,255,255,255,255,255,255,255,255,255,255,255,
+    255,255,255,255,255,255,255,255,255,255,255,255,255,255,255,255,
+    255,255,255,255,255,255,255,255,255,255,255, 62,255,255,255, 63,
+     52, 53, 54, 55, 56, 57, 58, 59, 60, 61,255,255,255,254,255,255,
+    255,  0,  1,  2,  3,  4,  5,  6,  7,  8,  9, 10, 11, 12, 13, 14,
+     15, 16, 17, 18, 19, 20, 21, 22, 23, 24, 25,255,255,255,255,255,
+    255, 26, 27, 28, 29, 30, 31, 32, 33, 34, 35, 36, 37, 38, 39, 40,
+     41, 42, 43, 44, 45, 46, 47, 48, 49, 50, 51,255,255,255,255,255,
+    255,255,255,255,255,255,255,255,255,255,255,255,255,255,255,255,
+    255,255,255,255,255,255,255,255,255,255,255,255,255,255,255,255,
+    255,255,255,255,255,255,255,255,255,255,255,255,255,255,255,255,
+    255,255,255,255,255,255,255,255,255,255,255,255,255,255,255,255,
+    255,255,255,255,255,255,255,255,255,255,255,255,255,255,255,255,
+    255,255,255,255,255,255,255,255,255,255,255,255,255,255,255,255,
+    255,255,255,255,255,255,255,255,255,255,255,255,255,255,255,255,
+    255,255,255,255,255,255,255,255,255,255,255,255,255,255,255,255,
+};
+
+// Decode base64 text [s, e) as big-endian signed integer. Returns false on
+// invalid input or width > 8 bytes.
+bool b64_to_cents(const char* s, const char* e, int64_t* out) {
+  uint8_t raw[16];
+  int nraw = 0;
+  uint32_t acc = 0;
+  int nbits = 0;
+  for (const char* p = s; p < e; ++p) {
+    uint8_t v = kB64[(uint8_t)*p];
+    if (v == 254) break;  // padding
+    if (v == 255) return false;
+    acc = (acc << 6) | v;
+    nbits += 6;
+    if (nbits >= 8) {
+      nbits -= 8;
+      if (nraw >= 16) return false;
+      raw[nraw++] = (uint8_t)(acc >> nbits);
+    }
+  }
+  if (nraw == 0 || nraw > 8) return false;
+  int64_t val = (raw[0] & 0x80) ? -1 : 0;  // sign-extend
+  for (int i = 0; i < nraw; ++i) val = (val << 8) | raw[i];
+  *out = val;
+  return true;
+}
+
+// Skip whitespace.
+inline const char* ws(const char* p, const char* e) {
+  while (p < e && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) ++p;
+  return p;
+}
+
+// Find `"key"` at object top level starting from p (shallow scan: tracks
+// brace/bracket depth and strings). Returns pointer just past the ':' of
+// the match, or nullptr.
+const char* find_key(const char* p, const char* e, const char* key) {
+  size_t klen = strlen(key);
+  int depth = 0;
+  bool in_str = false;
+  const char* str_start = nullptr;
+  while (p < e) {
+    char c = *p;
+    if (in_str) {
+      if (c == '\\') { p += 2; continue; }
+      if (c == '"') {
+        in_str = false;
+        // at depth 1 inside the target object: check key match + ':'
+        if (depth == 1 && (size_t)(p - str_start) == klen &&
+            memcmp(str_start, key, klen) == 0) {
+          const char* q = ws(p + 1, e);
+          if (q < e && *q == ':') return q + 1;
+        }
+      }
+      ++p;
+      continue;
+    }
+    switch (c) {
+      case '"': in_str = true; str_start = p + 1; break;
+      case '{': case '[': ++depth; break;
+      case '}': case ']':
+        --depth;
+        if (depth <= 0) return nullptr;  // left the object
+        break;
+      default: break;
+    }
+    ++p;
+  }
+  return nullptr;
+}
+
+// Parse an integer (possibly negative) at p.
+bool parse_int(const char* p, const char* e, int64_t* out) {
+  p = ws(p, e);
+  bool neg = false;
+  if (p < e && *p == '-') { neg = true; ++p; }
+  if (p >= e || *p < '0' || *p > '9') return false;
+  int64_t v = 0;
+  while (p < e && *p >= '0' && *p <= '9') v = v * 10 + (*p++ - '0');
+  *out = neg ? -v : v;
+  return true;
+}
+
+// If p points at `null`, return true.
+bool is_null(const char* p, const char* e) {
+  p = ws(p, e);
+  return (e - p) >= 4 && memcmp(p, "null", 4) == 0;
+}
+
+// Parse a JSON string value at p; sets [s, e2) to content. No unescaping
+// (base64/op strings never contain escapes).
+bool parse_str(const char* p, const char* e, const char** s, const char** e2) {
+  p = ws(p, e);
+  if (p >= e || *p != '"') return false;
+  ++p;
+  *s = p;
+  while (p < e && *p != '"') {
+    if (*p == '\\') ++p;
+    ++p;
+  }
+  if (p >= e) return false;
+  *e2 = p;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode n envelopes from a packed buffer. offsets has n+1 entries.
+// Returns the number of valid rows.
+int64_t decode_envelopes(
+    const char* buf, const int64_t* offsets, int64_t n,
+    int64_t* tx_id, int64_t* t_us, int64_t* cust, int64_t* term,
+    int64_t* cents, int8_t* op, uint8_t* valid) {
+  int64_t nvalid = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const char* m = buf + offsets[i];
+    const char* e = buf + offsets[i + 1];
+    tx_id[i] = t_us[i] = cust[i] = term[i] = cents[i] = 0;
+    op[i] = 0;
+    valid[i] = 0;
+
+    const char* p = ws(m, e);
+    if (p >= e || *p != '{') continue;
+    const char* payload = find_key(p, e, "payload");
+    if (!payload || is_null(payload, e)) continue;
+    payload = ws(payload, e);
+    if (payload >= e || *payload != '{') continue;
+
+    // op code (optional; default 'c')
+    const char* opv = find_key(payload, e, "op");
+    if (opv) {
+      const char *s, *se;
+      if (parse_str(opv, e, &s, &se) && se > s) {
+        switch (*s) {
+          case 'c': op[i] = 0; break;
+          case 'u': op[i] = 1; break;
+          case 'd': op[i] = 2; break;
+          case 'r': op[i] = 3; break;
+          default: op[i] = 0; break;
+        }
+      }
+    }
+
+    const char* row = find_key(payload, e, "after");
+    if (!row || is_null(row, e)) row = find_key(payload, e, "before");
+    if (!row || is_null(row, e)) continue;
+    row = ws(row, e);
+    if (row >= e || *row != '{') continue;
+
+    const char* v;
+    if (!(v = find_key(row, e, "tx_id")) || !parse_int(v, e, &tx_id[i]))
+      continue;
+    if (!(v = find_key(row, e, "tx_datetime")) || !parse_int(v, e, &t_us[i]))
+      continue;
+    if (!(v = find_key(row, e, "customer_id")) || !parse_int(v, e, &cust[i]))
+      continue;
+    if (!(v = find_key(row, e, "terminal_id")) || !parse_int(v, e, &term[i]))
+      continue;
+    v = find_key(row, e, "tx_amount");
+    if (v) {
+      if (is_null(v, e)) {
+        cents[i] = 0;
+      } else {
+        const char *s, *se;
+        if (!parse_str(v, e, &s, &se) || !b64_to_cents(s, se, &cents[i]))
+          continue;
+      }
+    }
+    valid[i] = 1;
+    ++nvalid;
+  }
+  return nvalid;
+}
+
+}  // extern "C"

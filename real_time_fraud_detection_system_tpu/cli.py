@@ -1,0 +1,219 @@
+"""Command-line entry points — the reference Makefile UX, one binary.
+
+Reference targets (``Makefile:2-58``) → subcommands:
+
+- ``make load_initial_data`` / datagen container → ``datagen`` (generate a
+  synthetic table to .npz) and ``warmstart`` happens inside ``score``;
+- offline notebook chain → ``train`` (features via replay, model fit,
+  metrics, artifacts out);
+- ``make fraud_detection`` → ``score --scorer {cpu,tpu}`` (the north-star
+  switch): stream a table through the engine, Parquet out;
+- ``make job3`` (CDC ingestion incl. envelope decode) → ``score
+  --mode envelope`` replays through Debezium-format envelopes;
+- benchmarking → ``bench`` (delegates to the repo-root harness).
+
+Usage::
+
+    python -m real_time_fraud_detection_system_tpu.cli datagen --out txs.npz
+    python -m real_time_fraud_detection_system_tpu.cli train --data txs.npz \
+        --model forest --out-model model.npz
+    python -m real_time_fraud_detection_system_tpu.cli score --data txs.npz \
+        --model-file model.npz --scorer tpu --out analyzed/
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def _platform_setup(platform: str | None) -> None:
+    if platform:
+        os.environ["JAX_PLATFORMS"] = platform
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+
+        jax.config.update("jax_platforms", want)
+
+
+def _start_epoch_s(start_date: str) -> int:
+    import datetime as dt
+
+    d = dt.date.fromisoformat(start_date)
+    return int((d - dt.date(1970, 1, 1)).days) * 86400
+
+
+def cmd_datagen(args) -> int:
+    from real_time_fraud_detection_system_tpu.config import DataConfig
+    from real_time_fraud_detection_system_tpu.data import generate_dataset
+    from real_time_fraud_detection_system_tpu.io.artifacts import save_transactions
+    from real_time_fraud_detection_system_tpu.utils import get_logger
+
+    log = get_logger("datagen")
+    cfg = DataConfig(
+        n_customers=args.customers,
+        n_terminals=args.terminals,
+        n_days=args.days,
+        radius=args.radius,
+        seed=args.seed,
+        start_date=args.start_date,
+    )
+    customers, terminals, txs = generate_dataset(cfg)
+    save_transactions(args.out, txs)
+    log.info(
+        "generated %d txs (%d customers, %d terminals, %d days) "
+        "fraud_rate=%.4f -> %s",
+        txs.n, cfg.n_customers, cfg.n_terminals, cfg.n_days,
+        txs.tx_fraud.mean(), args.out,
+    )
+    return 0
+
+
+def cmd_train(args) -> int:
+    from real_time_fraud_detection_system_tpu.config import Config, TrainConfig
+    from real_time_fraud_detection_system_tpu.io.artifacts import (
+        load_transactions,
+        save_model,
+    )
+    from real_time_fraud_detection_system_tpu.models import train_model
+    from real_time_fraud_detection_system_tpu.utils import get_logger
+
+    log = get_logger("train")
+    txs = load_transactions(args.data)
+    cfg = Config(
+        train=TrainConfig(
+            delta_train_days=args.delta_train,
+            delta_delay_days=args.delta_delay,
+            delta_test_days=args.delta_test,
+            epochs=args.epochs,
+        )
+    )
+    model, metrics = train_model(txs, cfg, kind=args.model)
+    save_model(args.out_model, model)
+    log.info("model=%s metrics=%s -> %s", args.model,
+             {k: round(v, 4) for k, v in metrics.items()}, args.out_model)
+    print(json.dumps({"model": args.model, **metrics}))
+    return 0
+
+
+def cmd_score(args) -> int:
+    from real_time_fraud_detection_system_tpu.config import Config
+    from real_time_fraud_detection_system_tpu.io import ParquetSink
+    from real_time_fraud_detection_system_tpu.io.artifacts import (
+        load_model,
+        load_transactions,
+    )
+    from real_time_fraud_detection_system_tpu.io.checkpoint import Checkpointer
+    from real_time_fraud_detection_system_tpu.runtime import (
+        ReplaySource,
+        ScoringEngine,
+    )
+    from real_time_fraud_detection_system_tpu.utils import get_logger
+
+    log = get_logger("score")
+    txs = load_transactions(args.data)
+    model = load_model(args.model_file)
+    cfg = Config()
+    cpu_model = None
+    if args.scorer == "cpu":
+        cpu_model = model  # TrainedModel.predict_proba runs host-side numpy
+
+    engine = ScoringEngine(
+        cfg,
+        kind=model.kind,
+        params=model.params,
+        scaler=model.scaler,
+        scorer=args.scorer,
+        cpu_model=cpu_model,
+        online_lr=args.online_lr,
+    )
+    source = ReplaySource(
+        txs,
+        _start_epoch_s(args.start_date),
+        batch_rows=args.batch_rows,
+        mode=args.mode,
+        with_labels=args.online_lr > 0,
+    )
+    ckpt = Checkpointer(args.checkpoint_dir) if args.checkpoint_dir else None
+    if ckpt is not None and args.resume:
+        restored = ckpt.restore(engine.state)
+        if restored is not None:
+            source.seek(engine.state.offsets)
+            log.info("resumed from batch %d", engine.state.batches_done)
+    sink = ParquetSink(args.out) if args.out else None
+    stats = engine.run(source, sink=sink, checkpointer=ckpt,
+                       max_batches=args.max_batches)
+    log.info("done: %s", stats)
+    print(json.dumps({"scorer": args.scorer, **stats}))
+    return 0
+
+
+def cmd_bench(args) -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo_root)
+    import bench
+
+    sys.argv = ["bench.py"] + (["--quick"] if args.quick else [])
+    bench.main()
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="rtfds", description="TPU-native real-time fraud detection"
+    )
+    ap.add_argument("--platform", choices=["cpu", "tpu", "axon"], default=None,
+                    help="force a JAX platform (default: environment)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("datagen", help="generate a synthetic transaction table")
+    p.add_argument("--out", required=True)
+    p.add_argument("--customers", type=int, default=5000)
+    p.add_argument("--terminals", type=int, default=10000)
+    p.add_argument("--days", type=int, default=245)
+    p.add_argument("--radius", type=float, default=5.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--start-date", default="2025-04-01")
+    p.set_defaults(fn=cmd_datagen)
+
+    p = sub.add_parser("train", help="offline training on a generated table")
+    p.add_argument("--data", required=True)
+    p.add_argument("--model", default="forest",
+                   choices=["logreg", "mlp", "tree", "forest", "gbt"])
+    p.add_argument("--out-model", required=True)
+    p.add_argument("--delta-train", type=int, default=153)
+    p.add_argument("--delta-delay", type=int, default=30)
+    p.add_argument("--delta-test", type=int, default=30)
+    p.add_argument("--epochs", type=int, default=5)
+    p.set_defaults(fn=cmd_train)
+
+    p = sub.add_parser("score", help="stream-score a table through the engine")
+    p.add_argument("--data", required=True)
+    p.add_argument("--model-file", required=True)
+    p.add_argument("--scorer", default="tpu", choices=["cpu", "tpu"])
+    p.add_argument("--mode", default="columnar", choices=["columnar", "envelope"])
+    p.add_argument("--out", default="")
+    p.add_argument("--batch-rows", type=int, default=4096)
+    p.add_argument("--start-date", default="2025-04-01")
+    p.add_argument("--checkpoint-dir", default="")
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--max-batches", type=int, default=0)
+    p.add_argument("--online-lr", type=float, default=0.0)
+    p.set_defaults(fn=cmd_score)
+
+    p = sub.add_parser("bench", help="run the benchmark harness")
+    p.add_argument("--quick", action="store_true")
+    p.set_defaults(fn=cmd_bench)
+
+    args = ap.parse_args(argv)
+    _platform_setup(args.platform)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -42,6 +42,41 @@ def _epoch_day0(start_date: str) -> int:
     return (d - _dt.date(1970, 1, 1)).days
 
 
+def _replay(
+    txs: Transactions,
+    cfg: FeatureConfig,
+    start_date: str,
+    chunk: int,
+    with_cms: bool,
+    collect_features: bool,
+):
+    """Shared chronological replay loop. Returns (features|None, state)."""
+    assert np.all(np.diff(txs.tx_time_seconds) >= 0), "txs must be chronological"
+    day0 = _epoch_day0(start_date)
+    start_epoch_us = day0 * SECONDS_PER_DAY * 1_000_000
+
+    state = init_feature_state(cfg, with_cms=with_cms)
+    step = jax.jit(lambda s, b: update_and_featurize(s, b, cfg))
+
+    n = txs.n
+    out = np.zeros((n, N_FEATURES), dtype=np.float32) if collect_features else None
+    for s in range(0, n, chunk):
+        e = min(s + chunk, n)
+        part = txs.slice(slice(s, e))
+        batch = make_batch(
+            customer_id=part.customer_id,
+            terminal_id=part.terminal_id,
+            tx_datetime_us=start_epoch_us + part.tx_time_seconds * 1_000_000,
+            amount_cents=part.amount_cents,
+            label=part.tx_fraud.astype(np.int32),
+            pad_to=chunk,
+        )
+        state, feats = step(state, jax.tree.map(jax.numpy.asarray, batch))
+        if out is not None:
+            out[s:e] = np.asarray(feats)[: e - s]
+    return out, state
+
+
 def compute_features_replay(
     txs: Transactions,
     cfg: FeatureConfig,
@@ -56,29 +91,31 @@ def compute_features_replay(
     feedback arrives within ``cfg.delay_days`` (risk windows are delay-
     shifted, so earlier label arrival is unobservable to queries).
     """
-    assert np.all(np.diff(txs.tx_time_seconds) >= 0), "txs must be chronological"
-    day0 = _epoch_day0(start_date)
-    start_epoch_us = day0 * SECONDS_PER_DAY * 1_000_000
-
-    state = init_feature_state(cfg, with_cms=with_cms)
-    step = jax.jit(lambda s, b: update_and_featurize(s, b, cfg))
-
-    n = txs.n
-    out = np.zeros((n, N_FEATURES), dtype=np.float32)
-    for s in range(0, n, chunk):
-        e = min(s + chunk, n)
-        part = txs.slice(slice(s, e))
-        batch = make_batch(
-            customer_id=part.customer_id,
-            terminal_id=part.terminal_id,
-            tx_datetime_us=start_epoch_us + part.tx_time_seconds * 1_000_000,
-            amount_cents=part.amount_cents,
-            label=part.tx_fraud.astype(np.int32),
-            pad_to=chunk,
-        )
-        state, feats = step(state, jax.tree.map(jax.numpy.asarray, batch))
-        out[s:e] = np.asarray(feats)[: e - s]
+    out, _ = _replay(txs, cfg, start_date, chunk, with_cms,
+                     collect_features=True)
     return out
+
+
+def warm_start_state(
+    txs: Transactions,
+    cfg: FeatureConfig,
+    start_date: str = "2025-04-01",
+    chunk: int = 8192,
+    with_cms: bool = False,
+):
+    """Bootstrap the online feature state from a historical table.
+
+    The reference bootstraps serving by MERGE-loading precomputed
+    ``feature_customer``/``feature_terminal`` tables
+    (``load_initial_data.py:289-487``). Here the equivalent is a replay of
+    the history through the online kernel, returning the resulting
+    :class:`FeatureState` for the engine to continue from — the same code
+    path as serving (shared with :func:`compute_features_replay`), so the
+    warm state is exactly what streaming from day 0 would have produced.
+    """
+    _, state = _replay(txs, cfg, start_date, chunk, with_cms,
+                       collect_features=False)
+    return state
 
 
 def pandas_rolling_features(

@@ -1,0 +1,109 @@
+"""Model/dataset artifact persistence — pickle-free.
+
+The reference round-trips artifacts as pickles (``trained_model.pkl`` via
+boto3 upload in ``load_initial_data.py:269-287``, ``scaler.pkl`` via joblib,
+daily ``data/raw/transaction/*.pkl``). Pickle executes arbitrary code at
+load time; this framework stores plain ``.npz`` arrays plus a JSON header —
+loadable anywhere, no code execution, and directly mmap-friendly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from real_time_fraud_detection_system_tpu.data.generator import Transactions
+from real_time_fraud_detection_system_tpu.models.forest import TreeEnsemble
+from real_time_fraud_detection_system_tpu.models.logreg import LogRegParams
+from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+from real_time_fraud_detection_system_tpu.models.train import TrainedModel
+
+
+def save_model(path: str, model: TrainedModel) -> None:
+    arrays = {
+        "scaler_mean": np.asarray(model.scaler.mean),
+        "scaler_scale": np.asarray(model.scaler.scale),
+    }
+    meta = {"kind": model.kind}
+    p = model.params
+    if model.kind == "logreg":
+        arrays["w"] = np.asarray(p.w)
+        arrays["b"] = np.asarray(p.b)
+    elif model.kind == "mlp":
+        meta["n_layers"] = len(p)
+        for i, (w, b) in enumerate(p):
+            arrays[f"w{i}"] = np.asarray(w)
+            arrays[f"b{i}"] = np.asarray(b)
+    elif model.kind in ("tree", "forest", "gbt"):
+        trees = p.trees if model.kind == "gbt" else p
+        meta["max_depth"] = int(trees.max_depth)
+        if model.kind == "gbt":
+            arrays["base_score"] = np.asarray(p.base_score)
+        for f in ("feat", "thresh", "left", "right", "prob"):
+            arrays[f] = np.asarray(getattr(trees, f))
+    else:
+        raise ValueError(f"unknown model kind {model.kind}")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, __meta__=json.dumps(meta), **arrays)
+    os.replace(tmp, path)
+
+
+def load_model(path: str) -> TrainedModel:
+    with np.load(path, allow_pickle=False) as z:
+        meta = json.loads(str(z["__meta__"]))
+        kind = meta["kind"]
+        scaler = Scaler(
+            mean=jnp.asarray(z["scaler_mean"]), scale=jnp.asarray(z["scaler_scale"])
+        )
+        if kind == "logreg":
+            params = LogRegParams(w=jnp.asarray(z["w"]), b=jnp.asarray(z["b"]))
+        elif kind == "mlp":
+            params = [
+                (jnp.asarray(z[f"w{i}"]), jnp.asarray(z[f"b{i}"]))
+                for i in range(meta["n_layers"])
+            ]
+        elif kind in ("tree", "forest", "gbt"):
+            trees = TreeEnsemble(
+                feat=jnp.asarray(z["feat"]),
+                thresh=jnp.asarray(z["thresh"]),
+                left=jnp.asarray(z["left"]),
+                right=jnp.asarray(z["right"]),
+                prob=jnp.asarray(z["prob"]),
+                max_depth=int(meta["max_depth"]),
+            )
+            if kind == "gbt":
+                from real_time_fraud_detection_system_tpu.models.gbt import (
+                    GBTModel,
+                )
+
+                params = GBTModel(
+                    trees=trees, base_score=jnp.asarray(z["base_score"])
+                )
+            else:
+                params = trees
+        else:
+            raise ValueError(f"unknown model kind {kind}")
+    return TrainedModel(kind=kind, scaler=scaler, params=params)
+
+
+_TX_FIELDS = (
+    "tx_id", "tx_time_seconds", "tx_time_days", "customer_id",
+    "terminal_id", "amount_cents", "tx_fraud", "tx_fraud_scenario",
+)
+
+
+def save_transactions(path: str, txs: Transactions) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, **{k: getattr(txs, k) for k in _TX_FIELDS})
+    os.replace(tmp, path)
+
+
+def load_transactions(path: str) -> Transactions:
+    with np.load(path, allow_pickle=False) as z:
+        return Transactions(*[z[k] for k in _TX_FIELDS])

@@ -97,8 +97,8 @@ def ensemble_from_sklearn(model, n_features: int) -> TreeEnsemble:
     )
 
 
-def ensemble_predict_proba(ens: TreeEnsemble, x: jnp.ndarray) -> jnp.ndarray:
-    """[B, F] → fraud probability [B].
+def ensemble_leaf_values(ens: TreeEnsemble, x: jnp.ndarray) -> jnp.ndarray:
+    """[B, F] → per-tree leaf value [B, T].
 
     Level-synchronous descent: node[b,t] advances one level per iteration;
     leaves self-loop, so ``max_depth`` iterations land every lane on its
@@ -121,7 +121,12 @@ def ensemble_predict_proba(ens: TreeEnsemble, x: jnp.ndarray) -> jnp.ndarray:
 
     node0 = jnp.zeros((b, t), dtype=jnp.int32)
     node = jax.lax.fori_loop(0, ens.max_depth, body, node0)
-    return jnp.mean(ens.prob.reshape(-1)[tree_base + node], axis=1)
+    return ens.prob.reshape(-1)[tree_base + node]  # [B, T]
+
+
+def ensemble_predict_proba(ens: TreeEnsemble, x: jnp.ndarray) -> jnp.ndarray:
+    """[B, F] → fraud probability [B] (bagging: mean of per-tree probs)."""
+    return jnp.mean(ensemble_leaf_values(ens, x), axis=1)
 
 
 class GemmEnsemble(NamedTuple):

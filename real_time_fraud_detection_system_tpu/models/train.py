@@ -95,8 +95,82 @@ class TrainedModel:
             return np.asarray(logreg_predict_proba(self.params, x))
         if self.kind == "mlp":
             return np.asarray(mlp_predict_proba(self.params, x))
+        if self.kind == "gbt":
+            from real_time_fraud_detection_system_tpu.models.gbt import (
+                gbt_predict_proba,
+            )
+
+            return np.asarray(gbt_predict_proba(self.params, x))
         if self.kind in ("tree", "forest"):
             return np.asarray(ensemble_predict_proba(self.params, x))
+        raise ValueError(f"unknown model kind {self.kind}")
+
+    def _np_params(self):
+        """One-time device→host conversion of params for the NumPy path."""
+        cached = getattr(self, "_np_cache", None)
+        if cached is None:
+            if self.kind == "logreg":
+                cached = (np.asarray(self.params.w), float(self.params.b))
+            elif self.kind == "mlp":
+                cached = [(np.asarray(w), np.asarray(b)) for w, b in self.params]
+            elif self.kind in ("tree", "forest", "gbt"):
+                trees = self.params.trees if self.kind == "gbt" else self.params
+                cached = {
+                    "feat": np.asarray(trees.feat),
+                    "thresh": np.asarray(trees.thresh),
+                    "left": np.asarray(trees.left),
+                    "right": np.asarray(trees.right),
+                    "prob": np.asarray(trees.prob),
+                    "max_depth": int(trees.max_depth),
+                    "base": float(self.params.base_score)
+                    if self.kind == "gbt" else 0.0,
+                }
+            object.__setattr__(self, "_np_cache", cached)
+        scaler = getattr(self, "_np_scaler", None)
+        if scaler is None:
+            scaler = (np.asarray(self.scaler.mean), np.asarray(self.scaler.scale))
+            object.__setattr__(self, "_np_scaler", scaler)
+        return cached, scaler
+
+    def predict_proba_np(self, features: np.ndarray) -> np.ndarray:
+        """Pure-NumPy host scoring — the ``--scorer cpu`` baseline path
+        (reference semantics: scaler.transform + predict_proba on CPU,
+        ``fraud_detection.py:183-195``), no accelerator involved. Params are
+        converted device→host once and cached."""
+        params, (mean, scale) = self._np_params()
+        x = ((features.astype(np.float32) - mean) / scale).astype(np.float32)
+        if self.kind == "logreg":
+            w, b = params
+            z = x @ w + b
+            return 1.0 / (1.0 + np.exp(-z))
+        if self.kind == "mlp":
+            h = x
+            for w, b in params[:-1]:
+                h = np.maximum(h @ w + b, 0.0)
+            w, b = params[-1]
+            z = (h @ w + b)[:, 0]
+            return 1.0 / (1.0 + np.exp(-z))
+        if self.kind in ("tree", "forest", "gbt"):
+            feat = params["feat"]
+            thresh = params["thresh"]
+            left = params["left"]
+            right = params["right"]
+            prob = params["prob"]
+            t = feat.shape[0]
+            b_ = x.shape[0]
+            node = np.zeros((b_, t), dtype=np.int64)
+            tree_idx = np.arange(t)[None, :]
+            for _ in range(params["max_depth"]):
+                f = feat[tree_idx, node]
+                xv = np.take_along_axis(x, f.reshape(b_, -1), axis=1).reshape(b_, t)
+                go_left = xv <= thresh[tree_idx, node]
+                node = np.where(go_left, left[tree_idx, node],
+                                right[tree_idx, node])
+            leaves = prob[tree_idx, node]
+            if self.kind == "gbt":
+                z = params["base"] + leaves.sum(axis=1)
+                return 1.0 / (1.0 + np.exp(-z))
+            return leaves.mean(axis=1)
         raise ValueError(f"unknown model kind {self.kind}")
 
 
@@ -154,6 +228,14 @@ def train_model(
                        else cfg.model.forest_max_depth),
             seed=cfg.model.seed,
             kind=kind,
+        )
+    elif kind == "gbt":
+        from real_time_fraud_detection_system_tpu.models.gbt import train_gbt
+
+        params = train_gbt(
+            xs, y_train,
+            n_trees=cfg.model.forest_n_trees,
+            max_depth=cfg.model.forest_max_depth,
         )
     else:
         raise ValueError(f"unknown model kind {kind}")

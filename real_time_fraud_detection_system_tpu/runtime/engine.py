@@ -57,6 +57,12 @@ def predict_fn_for(kind: str) -> Callable:
         return logreg_predict_proba
     if kind == "mlp":
         return mlp_predict_proba
+    if kind == "gbt":
+        from real_time_fraud_detection_system_tpu.models.gbt import (
+            gbt_predict_proba,
+        )
+
+        return gbt_predict_proba
     if kind in ("tree", "forest"):
         return ensemble_predict_proba
     raise ValueError(f"unknown model kind {kind}")
@@ -169,8 +175,12 @@ class ScoringEngine:
 
         feats_np = np.asarray(feats)[:n]
         if self.scorer == "cpu":
-            # parity oracle: sklearn pipeline on the same features
-            probs_np = self.cpu_model.predict_proba(feats_np.astype(np.float64))
+            # parity/baseline oracle: host-side pipeline on the same features
+            # (sklearn pipeline, or a TrainedModel's pure-NumPy path)
+            fn = getattr(self.cpu_model, "predict_proba_np", None) or (
+                self.cpu_model.predict_proba
+            )
+            probs_np = fn(feats_np.astype(np.float64))
         else:
             probs_np = np.asarray(probs)[:n]
         self.state.batches_done += 1

@@ -28,7 +28,7 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from real_time_fraud_detection_system_tpu.core.envelope import (
-    decode_transaction_envelopes,
+    decode_transaction_envelopes_fast,
     encode_transaction_envelopes,
 )
 from real_time_fraud_detection_system_tpu.data.generator import (
@@ -169,7 +169,7 @@ class ReplaySource:
             ts += [r.ts_ms for r in recs]
         if not msgs:
             return None
-        cols, invalid = decode_transaction_envelopes(msgs, ts)
+        cols, invalid = decode_transaction_envelopes_fast(msgs, ts)
         if invalid.any():
             keep = ~invalid
             cols = {k: v[keep] for k, v in cols.items()}

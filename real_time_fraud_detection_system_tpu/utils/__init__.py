@@ -1,0 +1,11 @@
+from real_time_fraud_detection_system_tpu.utils.timing import (  # noqa: F401
+    LatencyTracker,
+    Timer,
+)
+from real_time_fraud_detection_system_tpu.utils.logging import (  # noqa: F401
+    get_logger,
+)
+from real_time_fraud_detection_system_tpu.utils.tracing import (  # noqa: F401
+    trace_span,
+    profile_to,
+)

@@ -1,0 +1,25 @@
+"""Structured logging — replaces the reference's bare ``print()``/``.show()``
+observability (SURVEY §5.5, e.g. ``fraud_detection.py:56``)."""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_FORMAT = "%(asctime)s %(levelname).1s %(name)s: %(message)s"
+_configured = False
+
+
+def get_logger(name: str = "rtfds") -> logging.Logger:
+    global _configured
+    if not _configured:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FORMAT, datefmt="%H:%M:%S"))
+        root = logging.getLogger("rtfds")
+        root.addHandler(handler)
+        root.setLevel(logging.INFO)
+        root.propagate = False
+        _configured = True
+    if name == "rtfds" or name.startswith("rtfds."):
+        return logging.getLogger(name)
+    return logging.getLogger(f"rtfds.{name}")

@@ -1,0 +1,40 @@
+"""Profiler integration — jax.profiler traces for the step loop.
+
+The reference had no tracing at all (SURVEY §5.1; Spark UI existed but was
+unconfigured). Here any run can capture an XLA/TensorBoard trace::
+
+    with profile_to("/tmp/trace"):
+        engine.run(...)
+
+and individual host-side phases can be annotated with ``trace_span`` so they
+show up on the profiler timeline next to device ops.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def profile_to(log_dir: Optional[str]) -> Iterator[None]:
+    """Capture a jax.profiler trace into ``log_dir`` (no-op when None)."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def trace_span(name: str) -> Iterator[None]:
+    """Named host-side span on the profiler timeline."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

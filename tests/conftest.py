@@ -7,7 +7,9 @@ multi-chip tests must be runnable without TPU hardware).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the ambient environment may pin JAX_PLATFORMS to a
+# TPU proxy ("axon"); tests always run on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

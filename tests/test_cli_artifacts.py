@@ -1,0 +1,155 @@
+"""CLI subcommands, artifact round-trips, utils."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from real_time_fraud_detection_system_tpu.cli import main as cli_main
+
+
+@pytest.fixture(scope="module")
+def workdir(tmp_path_factory):
+    return tmp_path_factory.mktemp("cli")
+
+
+def test_cli_datagen_train_score_roundtrip(workdir, capsys):
+    txs_path = str(workdir / "txs.npz")
+    model_path = str(workdir / "model.npz")
+    out_dir = str(workdir / "analyzed")
+
+    assert cli_main([
+        "datagen", "--out", txs_path, "--customers", "120", "--terminals",
+        "240", "--days", "40",
+    ]) == 0
+    assert os.path.exists(txs_path)
+
+    assert cli_main([
+        "train", "--data", txs_path, "--model", "forest", "--out-model",
+        model_path, "--delta-train", "20", "--delta-delay", "5",
+        "--delta-test", "10", "--epochs", "2",
+    ]) == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    metrics = json.loads(out)
+    assert metrics["auc_roc"] > 0.65
+
+    assert cli_main([
+        "score", "--data", txs_path, "--model-file", model_path,
+        "--scorer", "tpu", "--out", out_dir, "--batch-rows", "2048",
+    ]) == 0
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert stats["rows"] > 0
+    files = os.listdir(out_dir)
+    assert any(f.endswith(".parquet") for f in files)
+
+
+def test_cli_cpu_scorer_matches_tpu(workdir, capsys):
+    txs_path = str(workdir / "txs.npz")
+    model_path = str(workdir / "model.npz")
+    assert cli_main([
+        "score", "--data", txs_path, "--model-file", model_path,
+        "--scorer", "cpu", "--max-batches", "2", "--batch-rows", "1024",
+        "--out", str(workdir / "cpu_out"),
+    ]) == 0
+    capsys.readouterr()
+
+
+def test_model_artifact_roundtrip_all_kinds(small_dataset, workdir):
+    import jax.numpy as jnp
+
+    from real_time_fraud_detection_system_tpu.config import Config, FeatureConfig, TrainConfig
+    from real_time_fraud_detection_system_tpu.features import compute_features_replay
+    from real_time_fraud_detection_system_tpu.io.artifacts import (
+        load_model,
+        save_model,
+    )
+    from real_time_fraud_detection_system_tpu.models import train_model
+
+    _, _, _, txs = small_dataset
+    cfg = Config(
+        features=FeatureConfig(customer_capacity=256, terminal_capacity=512),
+        train=TrainConfig(delta_train_days=20, delta_delay_days=5,
+                          delta_test_days=10, epochs=1),
+    )
+    feats = compute_features_replay(txs, cfg.features)
+    probe = feats[:256]
+    for kind in ("logreg", "mlp", "tree", "forest"):
+        model, _ = train_model(txs, cfg, features=feats, kind=kind)
+        path = str(workdir / f"m_{kind}.npz")
+        save_model(path, model)
+        loaded = load_model(path)
+        np.testing.assert_allclose(
+            loaded.predict_proba(probe), model.predict_proba(probe), atol=1e-6
+        )
+        # numpy host path must agree with the jax path
+        np.testing.assert_allclose(
+            loaded.predict_proba_np(probe), model.predict_proba(probe),
+            atol=1e-4,
+        )
+
+
+def test_transactions_artifact_roundtrip(small_dataset, workdir):
+    from real_time_fraud_detection_system_tpu.io.artifacts import (
+        load_transactions,
+        save_transactions,
+    )
+
+    _, _, _, txs = small_dataset
+    path = str(workdir / "txs_rt.npz")
+    save_transactions(path, txs)
+    back = load_transactions(path)
+    assert np.array_equal(back.amount_cents, txs.amount_cents)
+    assert np.array_equal(back.tx_fraud, txs.tx_fraud)
+
+
+def test_warm_start_state_equals_streaming(small_dataset):
+    """Bootstrap-from-history must equal having streamed from day 0."""
+    import jax
+    import jax.numpy as jnp
+
+    from real_time_fraud_detection_system_tpu.config import FeatureConfig
+    from real_time_fraud_detection_system_tpu.features.offline import (
+        warm_start_state,
+    )
+    from real_time_fraud_detection_system_tpu.features.online import (
+        init_feature_state,
+        update_and_featurize,
+    )
+    from real_time_fraud_detection_system_tpu.core.batch import make_batch
+
+    _, _, _, txs = small_dataset
+    fcfg = FeatureConfig(customer_capacity=256, terminal_capacity=512)
+    warm = warm_start_state(txs, fcfg, chunk=1024)
+
+    state = init_feature_state(fcfg)
+    step = jax.jit(lambda s, b: update_and_featurize(s, b, fcfg)[0])
+    start_epoch_us = 1_743_465_600 * 1_000_000
+    for s in range(0, txs.n, 1024):
+        part = txs.slice(slice(s, min(s + 1024, txs.n)))
+        batch = make_batch(
+            customer_id=part.customer_id,
+            terminal_id=part.terminal_id,
+            tx_datetime_us=start_epoch_us + part.tx_time_seconds * 1_000_000,
+            amount_cents=part.amount_cents,
+            label=part.tx_fraud.astype(np.int32),
+            pad_to=1024,
+        )
+        state = step(state, jax.tree.map(jnp.asarray, batch))
+    np.testing.assert_allclose(
+        np.asarray(warm.customer.count), np.asarray(state.customer.count)
+    )
+    np.testing.assert_allclose(
+        np.asarray(warm.terminal.fraud), np.asarray(state.terminal.fraud)
+    )
+
+
+def test_latency_tracker():
+    from real_time_fraud_detection_system_tpu.utils import LatencyTracker
+
+    t = LatencyTracker(window=64)
+    for i in range(100):
+        t.record(0.001 * (i % 10 + 1), rows=10)
+    snap = t.snapshot()
+    assert snap["count"] == 100 and snap["rows"] == 1000
+    assert 0 < snap["p50_ms"] <= snap["p99_ms"] <= snap["max_ms"] <= 10.01

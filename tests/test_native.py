@@ -1,0 +1,71 @@
+"""C++ envelope decoder: exact parity with the Python reference decoder."""
+
+import numpy as np
+import pytest
+
+from real_time_fraud_detection_system_tpu.core.envelope import (
+    decode_transaction_envelopes,
+    encode_transaction_envelope,
+    encode_transaction_envelopes,
+)
+from real_time_fraud_detection_system_tpu.core.native import (
+    decode_transaction_envelopes_native,
+    native_available,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="g++ / native build unavailable"
+)
+
+
+def test_native_parity_random(rng):
+    n = 5000
+    msgs = encode_transaction_envelopes(
+        np.arange(n, dtype=np.int64),
+        rng.integers(1_700_000_000, 1_800_000_000, n) * 1_000_000,
+        rng.integers(0, 5000, n),
+        rng.integers(0, 10000, n),
+        rng.integers(-(10**9), 10**10, n),
+    )
+    c_py, i_py = decode_transaction_envelopes(msgs)
+    c_nat, i_nat = decode_transaction_envelopes_native(msgs)
+    assert np.array_equal(i_py, i_nat)
+    for k in c_py:
+        assert np.array_equal(c_py[k], c_nat[k]), k
+
+
+def test_native_parity_malformed():
+    cases = [
+        encode_transaction_envelope(1, 2, 3, 4, 500),
+        encode_transaction_envelope(7, 8, 9, 10, -12345, op="d"),
+        encode_transaction_envelope(11, 12, 13, 14, 0, op="u"),
+        b"junk",
+        b"",
+        b'{"payload": null}',
+        b'{"payload": {"after": null, "before": null}}',
+        b'{"no_payload": 1}',
+        # whitespace variants
+        b'{ "payload" : { "after" : { "tx_id" : 5, "tx_datetime": 6,'
+        b' "customer_id": 7, "terminal_id": 8, "tx_amount": "e A=" } } }'
+        .replace(b"e A=", b"eA=="),
+    ]
+    c_py, i_py = decode_transaction_envelopes(cases)
+    c_nat, i_nat = decode_transaction_envelopes_native(cases)
+    assert np.array_equal(i_py, i_nat)
+    for k in ("tx_id", "tx_datetime_us", "tx_amount_cents", "op"):
+        assert np.array_equal(c_py[k], c_nat[k]), (k, c_py[k], c_nat[k])
+
+
+def test_native_schema_section_does_not_confuse_scanner():
+    # The Debezium wire format includes a "schema" section that also contains
+    # the strings "after"/"op" etc. — the scanner must find payload's keys.
+    msg = (
+        b'{"schema": {"fields": [{"field": "after", "op": "x", "payload": 1}]},'
+        b' "payload": {"before": null, "after": {"tx_id": 42,'
+        b' "tx_datetime": 99, "customer_id": 1, "terminal_id": 2,'
+        b' "tx_amount": "Aci0"}, "op": "c"}}'
+    )
+    c, inv = decode_transaction_envelopes_native([msg])
+    assert not inv[0]
+    assert c["tx_id"][0] == 42
+    assert c["tx_amount_cents"][0] == 0x01C8B4
