@@ -12,6 +12,13 @@ from typing import Dict, Sequence
 import numpy as np
 
 
+def rebalance_pos_weight(y: np.ndarray) -> float:
+    """Soft class-rebalance weight sqrt(neg/pos) shared by all trainers."""
+    n_pos = max(float(np.asarray(y).sum()), 1.0)
+    n_tot = float(len(np.asarray(y)))
+    return float(np.sqrt(max((n_tot - n_pos) / n_pos, 1.0)))
+
+
 def roc_auc(y_true: np.ndarray, y_score: np.ndarray) -> float:
     """Mann-Whitney U formulation with midrank tie handling."""
     y = np.asarray(y_true).astype(np.float64)

@@ -199,8 +199,11 @@ def train_model(
 
     xs = np.asarray(transform(scaler, jnp.asarray(x_train, dtype=jnp.float32)))
 
-    n_pos = max(float(y_train.sum()), 1.0)
-    pos_weight = float((len(y_train) - n_pos) / n_pos) ** 0.5  # soft rebalance
+    from real_time_fraud_detection_system_tpu.models.metrics import (
+        rebalance_pos_weight,
+    )
+
+    pos_weight = rebalance_pos_weight(y_train)
 
     if kind == "logreg":
         params = train_logreg(

@@ -1,0 +1,204 @@
+"""Ring attention: sequence-parallel attention over an ICI ring.
+
+The reference's only sequence model is the dead commented seq2seq-attention
+section (``fraud_detection_model/shared_functions.py:1649-1707``) — additive
+attention over a per-customer transaction history, single device, O(T^2)
+memory. This module is its live, TPU-first successor for LONG histories:
+the sequence axis is sharded across the device mesh, and attention runs as
+a ring — each device holds its local Q block resident, and K/V blocks
+rotate around the ring via ``ppermute`` while an online-softmax accumulator
+(the Flash-Attention recurrence) folds in one block per step. Peak memory is
+O(T_local^2 / n_dev) per device and the K/V transfer rides ICI, overlapping
+with the block matmuls.
+
+Design notes (TPU/XLA):
+
+- static shapes throughout: the rotation loop is a ``lax.fori_loop`` with a
+  static ``ppermute`` ring permutation — one compiled step, n_dev trips;
+- the online-softmax state (m, l, o) uses f32 accumulators regardless of
+  input dtype (bf16-safe);
+- causal masking is done with *global* positions reconstructed from
+  ``axis_index``: Q block b holds rows [b*T_l, (b+1)*T_l), and at ring step
+  i the resident K/V block is the one originally owned by device
+  (my_index - i) mod n_dev;
+- the same kernel body (``_block_attn``) runs unsharded for the single-chip
+  path (``blockwise_attention``), so parity tests can diff ring vs local
+  bit-for-bit semantics.
+
+Used by :mod:`..models.sequence` when histories exceed one device's HBM
+budget; exercised multi-chip in ``__graft_entry__.dryrun_multichip``.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _block_attn(q, k, v, m, l, o, q_off, k_off, sm_scale, causal,
+                kv_limit=None):
+    """One online-softmax accumulation step against a K/V block.
+
+    q: [B, Tq, H, D] (resident); k/v: [B, Tk, H, D] (visiting block);
+    (m, l, o): running (row-max, row-sum, unnormalized out) in f32.
+    q_off/k_off: global position offsets of the blocks (for causal masks).
+    ``kv_limit`` masks keys at global position >= kv_limit (padding tail).
+    Returns updated (m, l, o).
+    """
+    bq, tq, h, d = q.shape
+    tk = k.shape[1]
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * sm_scale
+    kpos = k_off + jnp.arange(tk, dtype=jnp.int32)
+    if causal:
+        qpos = q_off + jnp.arange(tq, dtype=jnp.int32)
+        mask = qpos[:, None] >= kpos[None, :]  # [Tq, Tk]
+        s = jnp.where(mask[None, None, :, :], s, -jnp.inf)
+    if kv_limit is not None:
+        s = jnp.where((kpos < kv_limit)[None, None, None, :], s, -jnp.inf)
+
+    m_blk = jnp.max(s, axis=-1)  # [B, H, Tq]
+    m_new = jnp.maximum(m, m_blk)
+    # A fully-masked block (causal, future device) has m_blk = -inf; keep the
+    # old statistics untouched in that case (exp(-inf - -inf) guards below).
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe[..., None])  # [B, H, Tq, Tk]
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)  # rescale old
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    o_new = o * alpha.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def _finalize(m, l, o, dtype):
+    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]  # [B,Tq,H,1]
+    return (o / denom).astype(dtype)
+
+
+def blockwise_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    block_size: int = 512,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Single-device flash-style attention ([B, T, H, D] layout).
+
+    The memory-bounded local form of :func:`ring_attention` — same
+    recurrence, K/V blocks visited by a ``fori_loop`` instead of a ring.
+    """
+    b, t, h, d = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    nblk = max(1, -(-t // block_size))
+    tpad = nblk * block_size
+    if tpad != t:
+        pad = [(0, 0), (0, tpad - t), (0, 0), (0, 0)]
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+        # padded K rows must never win the softmax: mask via causal offsets
+        # (qpos < kpos for the pad tail) or explicit -inf for non-causal.
+    m0 = jnp.full((b, h, t), -jnp.inf, dtype=jnp.float32)
+    l0 = jnp.zeros((b, h, t), dtype=jnp.float32)
+    o0 = jnp.zeros((b, t, h, d), dtype=jnp.float32)
+
+    def body(i, carry):
+        m, l, o = carry
+        kb = jax.lax.dynamic_slice_in_dim(k, i * block_size, block_size, 1)
+        vb = jax.lax.dynamic_slice_in_dim(v, i * block_size, block_size, 1)
+        k_off = i * block_size
+        # Causal: padded keys sit at kpos >= t > any qpos, so the causal mask
+        # already excludes them; non-causal needs the explicit kv_limit.
+        m, l, o = _block_attn(
+            q, kb, vb, m, l, o,
+            q_off=jnp.int32(0), k_off=k_off,
+            sm_scale=scale, causal=causal,
+            kv_limit=None if causal else t,
+        )
+        return m, l, o
+
+    m, l, o = jax.lax.fori_loop(0, nblk, body, (m0, l0, o0))
+    return _finalize(m, l, o, q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Sequence-parallel attention inside ``shard_map``.
+
+    q/k/v: [B, T_local, H, D] — the LOCAL shard of a sequence sharded over
+    ``axis_name`` (global T = n_dev * T_local, device i owning rows
+    [i*T_local, (i+1)*T_local)). Returns the local output shard.
+
+    Ring schedule: at step i, this device attends its resident Q against the
+    K/V block originally owned by device (idx - i) mod n_dev, then passes its
+    current K/V to the next device ((idx + 1) mod n_dev) via ``ppermute`` —
+    n_dev steps visit every block with only nearest-neighbor ICI traffic.
+    """
+    n_dev = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, tl, h, d = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    q_off = idx * tl
+
+    m0 = jnp.full((b, h, tl), -jnp.inf, dtype=jnp.float32)
+    l0 = jnp.zeros((b, h, tl), dtype=jnp.float32)
+    o0 = jnp.zeros((b, tl, h, d), dtype=jnp.float32)
+    perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
+
+    def body(i, carry):
+        m, l, o, kb, vb = carry
+        src = jnp.remainder(idx - i, n_dev)  # owner of the visiting block
+        m, l, o = _block_attn(
+            q, kb, vb, m, l, o,
+            q_off=q_off, k_off=src * tl,
+            sm_scale=scale, causal=causal,
+        )
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        return m, l, o, kb, vb
+
+    m, l, o, _, _ = jax.lax.fori_loop(0, n_dev, body, (m0, l0, o0, k, v))
+    return _finalize(m, l, o, q.dtype)
+
+
+def make_ring_attention_sharded(
+    mesh: Mesh,
+    axis: str = "data",
+    causal: bool = True,
+):
+    """jit-able wrapper: global [B, T, H, D] arrays, T sharded over ``axis``.
+
+    Returns ``fn(q, k, v) -> out`` with out sharded like q. The caller's
+    arrays may live anywhere; jit will insert the resharding collectives.
+    """
+    try:
+        from jax import shard_map as _sm  # jax >= 0.8
+
+        kw = {"check_vma": False}
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map as _sm
+
+        kw = {"check_rep": False}
+
+    spec = P(None, axis, None, None)
+    fn = _sm(
+        partial(ring_attention, axis_name=axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        **kw,
+    )
+    return jax.jit(fn)
