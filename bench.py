@@ -119,13 +119,17 @@ def main() -> None:
     fstate, probs = step(fstate, params, jbatch)
     jax.block_until_ready(probs)
 
-    # timed loop
+    # timed loop — sync every `chunk` steps so the dispatch queue stays
+    # bounded (an unbounded async backlog makes the final sync unbounded,
+    # pathological over high-RTT device tunnels).
+    chunk = 8
     t0 = time.perf_counter()
     iters = 0
     while time.perf_counter() - t0 < args.seconds:
-        fstate, probs = step(fstate, params, jbatch)
-        iters += 1
-    jax.block_until_ready(probs)
+        for _ in range(chunk):
+            fstate, probs = step(fstate, params, jbatch)
+        jax.block_until_ready(probs)
+        iters += chunk
     wall = time.perf_counter() - t0
     tps = iters * args.batch_rows / wall
     per_batch_ms = wall / iters * 1e3

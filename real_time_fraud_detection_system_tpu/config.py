@@ -115,6 +115,9 @@ class RuntimeConfig:
     ``fraud_detection.py:208``)."""
 
     scorer: str = "tpu"  # cpu | tpu
+    # Fused Pallas featurize+score kernel (linear scorer only;
+    # ops/pallas_kernels.py). Interpreted (slow, exact) off-TPU.
+    use_pallas: bool = False
     trigger_seconds: float = 0.0  # 0 => score as fast as batches arrive
     # Pad/bucket micro-batches to these row counts to keep the jit cache warm.
     batch_buckets: Sequence[int] = (256, 1024, 4096, 16384, 65536)

@@ -123,6 +123,60 @@ def update_and_featurize(
     return FeatureState(customer=customer, terminal=terminal, cms=cms), features
 
 
+def update_and_score_pallas(
+    state: FeatureState,
+    batch: TxBatch,
+    cfg: FeatureConfig,
+    scaler_mean: jnp.ndarray,
+    scaler_scale: jnp.ndarray,
+    w: jnp.ndarray,
+    b: jnp.ndarray,
+    interpret: Optional[bool] = None,
+) -> Tuple[FeatureState, jnp.ndarray, jnp.ndarray]:
+    """Scatter-update state, then run the fused Pallas featurize+score
+    kernel (``ops/pallas_kernels.py``) on the gathered state rows.
+
+    Returns (new_state, probs [B], features [B, 15]) — the linear-model
+    equivalent of :func:`update_and_featurize` + scale + logreg in ONE
+    device kernel after the updates.
+    """
+    from real_time_fraud_detection_system_tpu.ops.pallas_kernels import (
+        fused_featurize_score,
+    )
+    from real_time_fraud_detection_system_tpu.ops.windows import (
+        gather_state_rows,
+    )
+
+    cust_slot = _slot(batch.customer_key, cfg.customer_capacity, cfg.key_mode)
+    term_slot = _slot(batch.terminal_key, cfg.terminal_capacity, cfg.key_mode)
+    fraud = jnp.maximum(batch.label, 0).astype(jnp.float32)
+    customer = update_windows(
+        state.customer, cust_slot, batch.day, batch.amount, fraud, batch.valid
+    )
+    terminal = update_windows(
+        state.terminal, term_slot, batch.day, batch.amount, fraud, batch.valid
+    )
+    c_bd, c_cnt, c_amt, _ = gather_state_rows(customer, cust_slot)
+    t_bd, t_cnt, _, t_frd = gather_state_rows(terminal, term_slot)
+    probs, feats = fused_featurize_score(
+        (c_bd, c_cnt, c_amt),
+        (t_bd, t_cnt, t_frd),
+        batch.day,
+        batch.tod_s,
+        batch.amount,
+        batch.valid,
+        scaler_mean, scaler_scale, w, b,
+        windows=tuple(cfg.windows),
+        delay=cfg.delay_days,
+        weekend_start=cfg.weekend_start_weekday,
+        night_end=cfg.night_end_hour,
+        interpret=interpret,
+    )
+    new_state = FeatureState(customer=customer, terminal=terminal,
+                             cms=state.cms)
+    return new_state, probs, feats
+
+
 def apply_feedback(
     state: FeatureState,
     terminal_key: jnp.ndarray,  # uint32 [B]

@@ -106,6 +106,51 @@ def update_windows(
     )
 
 
+def gather_state_rows(
+    state: WindowState, slot: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One row-gather per table: (bucket_day, count, amount, fraud)[slot],
+    each [B, NB]. The single embedding-style gather the query needs."""
+    return (
+        state.bucket_day[slot],
+        state.count[slot],
+        state.amount[slot],
+        state.fraud[slot],
+    )
+
+
+def query_gathered(
+    bucket_day: jnp.ndarray,  # int32 [B, NB]
+    count: jnp.ndarray,  # float32 [B, NB]
+    amount: jnp.ndarray,  # float32 [B, NB]
+    fraud: jnp.ndarray,  # float32 [B, NB]
+    day: jnp.ndarray,  # int32 [B]
+    windows: Sequence[int],
+    delay: int = 0,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Window sums from pre-gathered state rows — age-mask formulation.
+
+    A bucket holding absolute day s contributes to window w iff its age
+    ``a = day - delay - s`` satisfies ``0 <= a < w`` (empty buckets carry
+    stamp -1 and only match impossible ages). No per-window modulo gathers:
+    one [B, NB] age computation + a [B, NB] @ [NB→NW] masked contraction,
+    entirely VPU/MXU-friendly (and the form the Pallas fused kernel uses).
+    """
+    age = day[:, None] - jnp.int32(delay) - bucket_day  # [B, NB]
+    live = (bucket_day >= 0) & (age >= 0)
+    out_c, out_a, out_f = [], [], []
+    for w in windows:
+        sel = (live & (age < w)).astype(jnp.float32)
+        out_c.append(jnp.sum(count * sel, axis=1))
+        out_a.append(jnp.sum(amount * sel, axis=1))
+        out_f.append(jnp.sum(fraud * sel, axis=1))
+    return (
+        jnp.stack(out_c, axis=1),
+        jnp.stack(out_a, axis=1),
+        jnp.stack(out_f, axis=1),
+    )
+
+
 def query_windows(
     state: WindowState,
     slot: jnp.ndarray,  # int32 [B]
@@ -116,26 +161,9 @@ def query_windows(
     """Gather per-row window aggregates.
 
     Returns (counts, amount_sums, fraud_sums), each [B, len(windows)], where
-    window w sums days [day-delay-w+1, day-delay].
+    window w sums days [day-delay-w+1, day-delay]. One row-gather per table
+    plus dense age-mask reductions (see :func:`query_gathered`) — TPU-
+    friendlier than per-(row, day-offset) flat gathers.
     """
-    nb = state.n_buckets
-    max_w = max(windows)
-    offsets = jnp.arange(max_w, dtype=jnp.int32)  # [W]
-    wanted = day[:, None] - jnp.int32(delay) - offsets[None, :]  # [B, W]
-    bucket = jnp.remainder(wanted, nb)
-    flat = slot[:, None] * nb + bucket  # [B, W]
-
-    live = (state.bucket_day.reshape(-1)[flat] == wanted) & (wanted >= 0)
-    live_f = live.astype(jnp.float32)
-    g_count = state.count.reshape(-1)[flat] * live_f  # [B, W]
-    g_amount = state.amount.reshape(-1)[flat] * live_f
-    g_fraud = state.fraud.reshape(-1)[flat] * live_f
-
-    # Per-window masked prefix sums over the offset axis.
-    sel = jnp.stack(
-        [(offsets < w).astype(jnp.float32) for w in windows], axis=0
-    )  # [NW, W]
-    counts = g_count @ sel.T  # [B, NW]
-    amounts = g_amount @ sel.T
-    frauds = g_fraud @ sel.T
-    return counts, amounts, frauds
+    bd, cnt, amt, frd = gather_state_rows(state, slot)
+    return query_gathered(bd, cnt, amt, frd, day, windows, delay)

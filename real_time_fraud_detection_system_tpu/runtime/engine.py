@@ -33,6 +33,7 @@ from real_time_fraud_detection_system_tpu.features.online import (
     FeatureState,
     init_feature_state,
     update_and_featurize,
+    update_and_score_pallas,
 )
 from real_time_fraud_detection_system_tpu.features.spec import N_FEATURES
 from real_time_fraud_detection_system_tpu.models.forest import (
@@ -132,11 +133,20 @@ class ScoringEngine:
         self._loss = loss_fn_for(kind)
         fcfg = cfg.features
 
+        use_pallas = cfg.runtime.use_pallas and kind == "logreg"
+
         def step(fstate: FeatureState, params, scaler: Scaler, batch: TxBatch):
-            fstate, feats = update_and_featurize(fstate, batch, fcfg)
-            x = transform(scaler, feats)
-            probs = self._predict(params, x)
-            probs = jnp.where(batch.valid, probs, 0.0)
+            if use_pallas:
+                fstate, probs, feats = update_and_score_pallas(
+                    fstate, batch, fcfg, scaler.mean, scaler.scale,
+                    params.w, params.b,
+                )
+                x = transform(scaler, feats)
+            else:
+                fstate, feats = update_and_featurize(fstate, batch, fcfg)
+                x = transform(scaler, feats)
+                probs = self._predict(params, x)
+                probs = jnp.where(batch.valid, probs, 0.0)
             if self.online_lr > 0.0 and self._loss is not None:
                 labeled = batch.valid & (batch.label >= 0)
                 y = jnp.maximum(batch.label, 0)
