@@ -58,15 +58,18 @@ def _build(batch_rows: int, model_kind: str):
 
         from real_time_fraud_detection_system_tpu.models.forest import (
             ensemble_from_sklearn,
-            ensemble_predict_proba,
+            for_device,
+        )
+        from real_time_fraud_detection_system_tpu.models.forest import (
+            predict_proba as forest_predict_proba,
         )
 
         xtr = rng.normal(0, 1, (2048, 15))
         ytr = (xtr[:, 0] + 0.5 * xtr[:, 1] > 0.8).astype(np.int32)
         skl = RandomForestClassifier(n_estimators=100, max_depth=8,
                                      random_state=0, n_jobs=-1).fit(xtr, ytr)
-        params = ensemble_from_sklearn(skl, 15)
-        predict = ensemble_predict_proba
+        params = for_device(ensemble_from_sklearn(skl, 15), 15)
+        predict = forest_predict_proba
     else:
         from real_time_fraud_detection_system_tpu.models.logreg import (
             init_logreg,

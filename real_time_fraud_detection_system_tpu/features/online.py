@@ -71,6 +71,30 @@ def _flags(batch: TxBatch, cfg: FeatureConfig) -> Tuple[jnp.ndarray, jnp.ndarray
     return is_weekend, is_night
 
 
+def _update_state(
+    state: FeatureState, batch: TxBatch, cfg: FeatureConfig
+) -> Tuple[FeatureState, jnp.ndarray, jnp.ndarray]:
+    """Shared scatter-update half of both scoring paths.
+
+    Returns (new_state, cust_slot, term_slot). Labeled rows
+    (``batch.label >= 0``) also scatter fraud counts into the terminal state
+    (the feedback path); unlabeled rows contribute 0.
+    """
+    cust_slot = _slot(batch.customer_key, cfg.customer_capacity, cfg.key_mode)
+    term_slot = _slot(batch.terminal_key, cfg.terminal_capacity, cfg.key_mode)
+    fraud = jnp.maximum(batch.label, 0).astype(jnp.float32)
+    customer = update_windows(
+        state.customer, cust_slot, batch.day, batch.amount, fraud, batch.valid
+    )
+    terminal = update_windows(
+        state.terminal, term_slot, batch.day, batch.amount, fraud, batch.valid
+    )
+    cms = state.cms
+    if cms is not None:
+        cms = cms_update(cms, batch.customer_key, batch.amount, batch.day, batch.valid)
+    return FeatureState(customer=customer, terminal=terminal, cms=cms), cust_slot, term_slot
+
+
 def update_and_featurize(
     state: FeatureState,
     batch: TxBatch,
@@ -82,24 +106,10 @@ def update_and_featurize(
     its batch-mates of the same key/day — matching the offline pandas
     ``rolling(...).count()`` which includes the current row
     (``feature_transformation.ipynb · cell 17``), at micro-batch granularity.
-
-    Labeled rows (``batch.label >= 0``) also scatter fraud counts into the
-    terminal state (the feedback path); unlabeled rows contribute 0.
     """
     windows = tuple(cfg.windows)
-    cust_slot = _slot(batch.customer_key, cfg.customer_capacity, cfg.key_mode)
-    term_slot = _slot(batch.terminal_key, cfg.terminal_capacity, cfg.key_mode)
-    fraud = jnp.maximum(batch.label, 0).astype(jnp.float32)
-
-    customer = update_windows(
-        state.customer, cust_slot, batch.day, batch.amount, fraud, batch.valid
-    )
-    terminal = update_windows(
-        state.terminal, term_slot, batch.day, batch.amount, fraud, batch.valid
-    )
-    cms = state.cms
-    if cms is not None:
-        cms = cms_update(cms, batch.customer_key, batch.amount, batch.day, batch.valid)
+    state, cust_slot, term_slot = _update_state(state, batch, cfg)
+    customer, terminal = state.customer, state.terminal
 
     c_count, c_amount, _ = query_windows(customer, cust_slot, batch.day, windows)
     t_count, _, t_fraud = query_windows(
@@ -120,7 +130,7 @@ def update_and_featurize(
         cols.append(t_risk[:, i])
     features = jnp.stack(cols, axis=1)
 
-    return FeatureState(customer=customer, terminal=terminal, cms=cms), features
+    return state, features
 
 
 def update_and_score_pallas(
@@ -147,17 +157,9 @@ def update_and_score_pallas(
         gather_state_rows,
     )
 
-    cust_slot = _slot(batch.customer_key, cfg.customer_capacity, cfg.key_mode)
-    term_slot = _slot(batch.terminal_key, cfg.terminal_capacity, cfg.key_mode)
-    fraud = jnp.maximum(batch.label, 0).astype(jnp.float32)
-    customer = update_windows(
-        state.customer, cust_slot, batch.day, batch.amount, fraud, batch.valid
-    )
-    terminal = update_windows(
-        state.terminal, term_slot, batch.day, batch.amount, fraud, batch.valid
-    )
-    c_bd, c_cnt, c_amt, _ = gather_state_rows(customer, cust_slot)
-    t_bd, t_cnt, _, t_frd = gather_state_rows(terminal, term_slot)
+    state, cust_slot, term_slot = _update_state(state, batch, cfg)
+    c_bd, c_cnt, c_amt, _ = gather_state_rows(state.customer, cust_slot)
+    t_bd, t_cnt, _, t_frd = gather_state_rows(state.terminal, term_slot)
     probs, feats = fused_featurize_score(
         (c_bd, c_cnt, c_amt),
         (t_bd, t_cnt, t_frd),
@@ -172,9 +174,7 @@ def update_and_score_pallas(
         night_end=cfg.night_end_hour,
         interpret=interpret,
     )
-    new_state = FeatureState(customer=customer, terminal=terminal,
-                             cms=state.cms)
-    return new_state, probs, feats
+    return state, probs, feats
 
 
 def apply_feedback(

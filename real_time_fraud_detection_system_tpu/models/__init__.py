@@ -15,10 +15,14 @@ from real_time_fraud_detection_system_tpu.models.mlp import (  # noqa: F401
     train_mlp,
 )
 from real_time_fraud_detection_system_tpu.models.forest import (  # noqa: F401
+    GemmEnsemble,
     TreeEnsemble,
     ensemble_from_sklearn,
     ensemble_predict_proba,
     fit_forest,
+    for_device,
+    gemm_predict_proba,
+    to_gemm,
 )
 from real_time_fraud_detection_system_tpu.models.metrics import (  # noqa: F401
     average_precision,

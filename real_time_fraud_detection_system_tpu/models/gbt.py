@@ -24,20 +24,34 @@ import jax.numpy as jnp
 import numpy as np
 
 from real_time_fraud_detection_system_tpu.models.forest import (
+    GemmEnsemble,
     TreeEnsemble,
     _f32_round_down,
     ensemble_leaf_values,
+    for_device,
+    gemm_leaf_sum,
 )
 
 
 class GBTModel(NamedTuple):
-    trees: TreeEnsemble  # prob field holds raw leaf scores (lr pre-applied)
+    # prob/leaf_val field holds raw leaf scores (lr pre-applied)
+    trees: "TreeEnsemble | GemmEnsemble"
     base_score: jnp.ndarray  # float32 [] — initial logit
 
 
 def gbt_predict_proba(model: GBTModel, x: jnp.ndarray) -> jnp.ndarray:
-    raw = jnp.sum(ensemble_leaf_values(model.trees, x), axis=1)
+    if isinstance(model.trees, GemmEnsemble):
+        raw = gemm_leaf_sum(model.trees, x)
+    else:
+        raw = jnp.sum(ensemble_leaf_values(model.trees, x), axis=1)
     return jax.nn.sigmoid(model.base_score + raw)
+
+
+def gbt_for_device(model: GBTModel, n_features: int) -> GBTModel:
+    """GEMM-form trees for fast TPU inference (see forest.predict_proba)."""
+    if isinstance(model.trees, TreeEnsemble):
+        return model._replace(trees=for_device(model.trees, n_features))
+    return model
 
 
 class _Node(NamedTuple):

@@ -24,8 +24,11 @@ from real_time_fraud_detection_system_tpu.features.offline import (
 )
 from real_time_fraud_detection_system_tpu.models.forest import (
     TreeEnsemble,
-    ensemble_predict_proba,
     fit_forest,
+    for_device,
+)
+from real_time_fraud_detection_system_tpu.models.forest import (
+    predict_proba as forest_predict_proba,
 )
 from real_time_fraud_detection_system_tpu.models.logreg import (
     LogRegParams,
@@ -87,6 +90,14 @@ class TrainedModel:
     scaler: Scaler
     params: object  # LogRegParams | MLPParams | TreeEnsemble
 
+    def _device_params(self, convert):
+        """Lazily convert params to the fast device form, once."""
+        dev = getattr(self, "_dev_cache", None)
+        if dev is None:
+            dev = convert(self.params)
+            object.__setattr__(self, "_dev_cache", dev)
+        return dev
+
     def predict_proba(self, features: np.ndarray) -> np.ndarray:
         import jax.numpy as jnp
 
@@ -97,12 +108,17 @@ class TrainedModel:
             return np.asarray(mlp_predict_proba(self.params, x))
         if self.kind == "gbt":
             from real_time_fraud_detection_system_tpu.models.gbt import (
+                gbt_for_device,
                 gbt_predict_proba,
             )
 
-            return np.asarray(gbt_predict_proba(self.params, x))
+            nf = int(x.shape[1])
+            dev = self._device_params(lambda p: gbt_for_device(p, nf))
+            return np.asarray(gbt_predict_proba(dev, x))
         if self.kind in ("tree", "forest"):
-            return np.asarray(ensemble_predict_proba(self.params, x))
+            nf = int(x.shape[1])
+            dev = self._device_params(lambda p: for_device(p, nf))
+            return np.asarray(forest_predict_proba(dev, x))
         raise ValueError(f"unknown model kind {self.kind}")
 
     def _np_params(self):

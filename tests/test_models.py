@@ -123,3 +123,48 @@ def test_card_precision_top_k():
     score = np.asarray([0.1, 0.2, 0.3, 0.8, 0.5, 0.9])
     fraud = np.asarray([0, 0, 0, 0, 1, 1])
     assert card_precision_top_k(fraud, score, days, cust, k=2) == 0.5
+
+
+def test_for_device_dispatch(xy):
+    """for_device picks GEMM for bounded forests, descent for huge trees;
+    the unified predict_proba dispatches both; GBT gemm matches descent."""
+    from sklearn.ensemble import RandomForestClassifier
+
+    from real_time_fraud_detection_system_tpu.models.forest import (
+        GemmEnsemble,
+        for_device,
+        predict_proba,
+    )
+
+    x, y = xy
+    clf = RandomForestClassifier(n_estimators=10, max_depth=5, random_state=0)
+    clf.fit(x, y)
+    ens = ensemble_from_sklearn(clf, x.shape[1])
+    dev = for_device(ens, x.shape[1])
+    assert isinstance(dev, GemmEnsemble)
+    x32 = jnp.asarray(x, jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(predict_proba(dev, x32)),
+        np.asarray(predict_proba(ens, x32)),
+        atol=1e-5,
+    )
+    # over-budget ensembles stay in descent form
+    assert for_device(ens, x.shape[1], max_gemm_bytes=16) is ens
+
+
+def test_gbt_device_form_matches(xy):
+    from real_time_fraud_detection_system_tpu.models.gbt import (
+        gbt_for_device,
+        gbt_predict_proba,
+        train_gbt,
+    )
+
+    x, y = xy
+    x32 = x.astype(np.float32)
+    model = train_gbt(x32, y.astype(np.float32), n_trees=8, max_depth=3)
+    dev = gbt_for_device(model, x.shape[1])
+    np.testing.assert_allclose(
+        np.asarray(gbt_predict_proba(dev, jnp.asarray(x32))),
+        np.asarray(gbt_predict_proba(model, jnp.asarray(x32))),
+        atol=1e-5,
+    )

@@ -98,21 +98,61 @@ def test_engine_pallas_path_matches(small_dataset):
 
 
 def test_fused_kernel_jit_and_padding(rng):
+    """Padded rows (valid=False) and a multi-tile grid must match the jnp
+    path: 100 real rows padded to 256, scored with block_rows=128 → grid=(2,)
+    where the second tile is mostly padding."""
+    from real_time_fraud_detection_system_tpu.features.online import _update_state
+    from real_time_fraud_detection_system_tpu.ops.pallas_kernels import (
+        fused_featurize_score,
+    )
+    from real_time_fraud_detection_system_tpu.ops.windows import gather_state_rows
+
     cfg = FeatureConfig(customer_capacity=256, terminal_capacity=512)
     params = init_logreg(15)
-    scaler = Scaler(mean=jnp.zeros(15), scale=jnp.ones(15))
-    state = init_feature_state(cfg)
-    batch = _batch(rng, n=100)
-    batch = jax.tree.map(jnp.asarray, batch._replace(
-        valid=jnp.asarray(np.r_[np.ones(100, bool)])
-    ))
-
-    fn = jax.jit(
-        lambda s, bt: update_and_score_pallas(
-            s, bt, cfg, scaler.mean, scaler.scale, params.w, params.b
-        )
+    params = params._replace(
+        w=jnp.asarray(rng.normal(0, 0.3, 15).astype(np.float32))
     )
-    state, probs, feats = fn(state, batch)
-    assert probs.shape == (100,)
-    assert feats.shape == (100, 15)
-    assert np.isfinite(np.asarray(probs)).all()
+    scaler = Scaler(mean=jnp.zeros(15), scale=jnp.ones(15))
+    raw = _batch(rng, n=100, with_labels=False)
+    padded = make_batch(
+        customer_id=np.asarray(raw.customer_key, np.int64),
+        terminal_id=np.asarray(raw.terminal_key, np.int64),
+        tx_datetime_us=np.asarray(raw.day, np.int64) * 86400_000_000
+        + np.asarray(raw.tod_s, np.int64) * 1_000_000,
+        amount_cents=(np.asarray(raw.amount) * 100).astype(np.int64),
+        pad_to=256,
+    )
+    assert int(np.asarray(padded.valid).sum()) == 100
+    batch = jax.tree.map(jnp.asarray, padded)
+
+    # reference: jnp composition on the same padded batch
+    state_ref, feats_ref = update_and_featurize(
+        init_feature_state(cfg), batch, cfg
+    )
+    probs_ref = jnp.where(
+        batch.valid,
+        logreg_predict_proba(params, transform(scaler, feats_ref)),
+        0.0,
+    )
+
+    # kernel with a 2-tile grid (256 / 128)
+    state, cust_slot, term_slot = _update_state(
+        init_feature_state(cfg), batch, cfg
+    )
+    c_bd, c_cnt, c_amt, _ = gather_state_rows(state.customer, cust_slot)
+    t_bd, t_cnt, _, t_frd = gather_state_rows(state.terminal, term_slot)
+    probs, feats = fused_featurize_score(
+        (c_bd, c_cnt, c_amt), (t_bd, t_cnt, t_frd),
+        batch.day, batch.tod_s, batch.amount, batch.valid,
+        scaler.mean, scaler.scale, params.w, params.b,
+        windows=tuple(cfg.windows), delay=cfg.delay_days,
+        weekend_start=cfg.weekend_start_weekday,
+        night_end=cfg.night_end_hour, block_rows=128,
+    )
+    np.testing.assert_allclose(
+        np.asarray(feats), np.asarray(feats_ref), rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(probs), np.asarray(probs_ref), rtol=1e-5, atol=1e-5
+    )
+    assert (np.asarray(probs)[100:] == 0.0).all()
