@@ -33,6 +33,17 @@ from real_time_fraud_detection_system_tpu.models.metrics import (  # noqa: F401
 )
 from real_time_fraud_detection_system_tpu.models.train import (  # noqa: F401
     TrainedModel,
+    fit_classifier,
     train_delay_test_split,
     train_model,
+)
+from real_time_fraud_detection_system_tpu.models.selection import (  # noqa: F401
+    FoldPerformance,
+    SelectionSummary,
+    execution_times,
+    kfold_cv_with_classifier,
+    model_selection_wrapper,
+    prequential_grid_search,
+    prequential_split,
+    summarize_performances,
 )

@@ -190,36 +190,25 @@ class TrainedModel:
         raise ValueError(f"unknown model kind {self.kind}")
 
 
-def train_model(
-    txs: Transactions,
+def fit_classifier(
+    kind: str,
+    xs: np.ndarray,
+    y_train: np.ndarray,
     cfg: Config,
-    features: Optional[np.ndarray] = None,
-    kind: Optional[str] = None,
-) -> Tuple[TrainedModel, dict]:
-    """End-to-end offline training; returns (model, test metrics)."""
-    kind = kind or cfg.model.kind
-    if features is None:
-        features = compute_features_replay(
-            txs, cfg.features, start_date=cfg.data.start_date
+    pos_weight: Optional[float] = None,
+):
+    """Fit one classifier of the 5-model zoo on pre-scaled features.
+
+    Dispatch shared by :func:`train_model` and the model-selection machinery
+    (``models/selection.py``); reference equivalent is the classifier dict of
+    ``model_training.ipynb · cell 50``.
+    """
+    if pos_weight is None:
+        from real_time_fraud_detection_system_tpu.models.metrics import (
+            rebalance_pos_weight,
         )
-    train_mask, test_mask = train_delay_test_split(
-        txs,
-        delta_train=cfg.train.delta_train_days,
-        delta_delay=cfg.train.delta_delay_days,
-        delta_test=cfg.train.delta_test_days,
-    )
-    x_train = features[train_mask]
-    y_train = txs.tx_fraud[train_mask].astype(np.float32)
-    scaler = fit_scaler(x_train)
-    import jax.numpy as jnp
 
-    xs = np.asarray(transform(scaler, jnp.asarray(x_train, dtype=jnp.float32)))
-
-    from real_time_fraud_detection_system_tpu.models.metrics import (
-        rebalance_pos_weight,
-    )
-
-    pos_weight = rebalance_pos_weight(y_train)
+        pos_weight = rebalance_pos_weight(y_train)
 
     if kind == "logreg":
         params = train_logreg(
@@ -258,13 +247,67 @@ def train_model(
         )
     else:
         raise ValueError(f"unknown model kind {kind}")
+    return params
 
+
+def fit_and_assess(
+    txs: Transactions,
+    features: np.ndarray,
+    cfg: Config,
+    kind: str,
+    train_mask: np.ndarray,
+    test_mask: np.ndarray,
+) -> Tuple[TrainedModel, dict, float, float]:
+    """scale → fit → predict → assess on one (train, test) mask pair.
+
+    Shared by :func:`train_model` and the model-selection sweeps; returns
+    (model, test metrics, fit_seconds, predict_seconds) — the timing pair is
+    the reference's per-classifier execution-time hook
+    (``shared_functions.py:312-320``).
+    """
+    import time
+
+    import jax.numpy as jnp
+
+    x_train = features[train_mask]
+    y_train = txs.tx_fraud[train_mask].astype(np.float32)
+    scaler = fit_scaler(x_train)
+    xs = np.asarray(transform(scaler, jnp.asarray(x_train, dtype=jnp.float32)))
+    t0 = time.perf_counter()
+    params = fit_classifier(kind, xs, y_train, cfg)
+    fit_s = time.perf_counter() - t0
     model = TrainedModel(kind=kind, scaler=scaler, params=params)
+    t0 = time.perf_counter()
     probs = model.predict_proba(features[test_mask])
+    predict_s = time.perf_counter() - t0
     metrics = performance_assessment(
         txs.tx_fraud[test_mask],
         probs,
         days=txs.tx_time_days[test_mask],
         customer_ids=txs.customer_id[test_mask],
+    )
+    return model, metrics, fit_s, predict_s
+
+
+def train_model(
+    txs: Transactions,
+    cfg: Config,
+    features: Optional[np.ndarray] = None,
+    kind: Optional[str] = None,
+) -> Tuple[TrainedModel, dict]:
+    """End-to-end offline training; returns (model, test metrics)."""
+    kind = kind or cfg.model.kind
+    if features is None:
+        features = compute_features_replay(
+            txs, cfg.features, start_date=cfg.data.start_date
+        )
+    train_mask, test_mask = train_delay_test_split(
+        txs,
+        delta_train=cfg.train.delta_train_days,
+        delta_delay=cfg.train.delta_delay_days,
+        delta_test=cfg.train.delta_test_days,
+    )
+    model, metrics, _, _ = fit_and_assess(
+        txs, features, cfg, kind, train_mask, test_mask
     )
     return model, metrics
