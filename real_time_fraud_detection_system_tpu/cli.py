@@ -184,7 +184,8 @@ def main(argv=None) -> int:
     p = sub.add_parser("train", help="offline training on a generated table")
     p.add_argument("--data", required=True)
     p.add_argument("--model", default="forest",
-                   choices=["logreg", "mlp", "tree", "forest", "gbt"])
+                   choices=["logreg", "mlp", "tree", "forest", "gbt",
+                            "autoencoder"])
     p.add_argument("--out-model", required=True)
     p.add_argument("--delta-train", type=int, default=153)
     p.add_argument("--delta-delay", type=int, default=30)

@@ -83,9 +83,13 @@ class ModelConfig:
     """Classifier selection, mirroring the reference's 5-model zoo
     (``model_training.ipynb · cell 50``: LogReg, DT-2, DT, RF, XGBoost)."""
 
-    kind: str = "logreg"  # logreg | mlp | tree | forest | gbt
+    kind: str = "logreg"  # logreg | mlp | tree | forest | gbt | autoencoder
     n_features: int = 15
     mlp_hidden: Sequence[int] = (64, 32)
+    # Unsupervised anomaly scorer (successor to the dormant torch
+    # autoencoder, shared_functions.py:1312-1707); encoder widths, the last
+    # entry is the bottleneck.
+    autoencoder_hidden: Sequence[int] = (32, 8)
     forest_n_trees: int = 100
     forest_max_depth: int = 8
     tree_max_depth: int = 2

@@ -45,6 +45,12 @@ def save_model(path: str, model: TrainedModel) -> None:
             arrays["base_score"] = np.asarray(p.base_score)
         for f in ("feat", "thresh", "left", "right", "prob"):
             arrays[f] = np.asarray(getattr(trees, f))
+    elif model.kind == "autoencoder":
+        meta["n_layers"] = len(p.layers)
+        arrays["err_scale"] = np.asarray(p.err_scale)
+        for i, (w, b) in enumerate(p.layers):
+            arrays[f"w{i}"] = np.asarray(w)
+            arrays[f"b{i}"] = np.asarray(b)
     else:
         raise ValueError(f"unknown model kind {model.kind}")
     tmp = path + ".tmp"
@@ -86,6 +92,18 @@ def load_model(path: str) -> TrainedModel:
                 )
             else:
                 params = trees
+        elif kind == "autoencoder":
+            from real_time_fraud_detection_system_tpu.models.autoencoder import (
+                AutoencoderParams,
+            )
+
+            params = AutoencoderParams(
+                layers=[
+                    (jnp.asarray(z[f"w{i}"]), jnp.asarray(z[f"b{i}"]))
+                    for i in range(meta["n_layers"])
+                ],
+                err_scale=jnp.asarray(z["err_scale"]),
+            )
         else:
             raise ValueError(f"unknown model kind {kind}")
     return TrainedModel(kind=kind, scaler=scaler, params=params)

@@ -37,6 +37,14 @@ from real_time_fraud_detection_system_tpu.models.train import (  # noqa: F401
     train_delay_test_split,
     train_model,
 )
+from real_time_fraud_detection_system_tpu.models.autoencoder import (  # noqa: F401
+    AutoencoderParams,
+    autoencoder_loss,
+    autoencoder_predict_proba,
+    init_autoencoder,
+    reconstruction_error,
+    train_autoencoder,
+)
 from real_time_fraud_detection_system_tpu.models.selection import (  # noqa: F401
     FoldPerformance,
     SelectionSummary,

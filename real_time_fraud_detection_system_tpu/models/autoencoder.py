@@ -1,0 +1,151 @@
+"""Autoencoder anomaly scorer — the reference's dormant unsupervised model.
+
+The commented-out PyTorch section of the reference
+(``shared_functions.py:1312-1707``) includes a ``SimpleAutoencoder``
+(encoder/decoder MLP trained to reconstruct the scaled feature vector, MSE
+loss) intended for unsupervised fraud scoring: frauds reconstruct poorly, so
+reconstruction error is the anomaly score. This is its live TPU-native
+equivalent:
+
+- plain (W, b) pytree layers like :mod:`.mlp`, MXU-friendly matmuls;
+- trained with optax Adam on **legitimate transactions only** (labels are
+  used solely to exclude known frauds from the train set — the serving path
+  never needs labels);
+- ``autoencoder_predict_proba`` maps per-row reconstruction MSE through a
+  calibrated squashing ``1 - exp(-err/scale)`` so the engine can treat it
+  exactly like any classifier's fraud probability (monotone in error,
+  in [0, 1)); ``scale`` is fit to the train-set median error.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+Layers = List[Tuple[jnp.ndarray, jnp.ndarray]]
+
+
+class AutoencoderParams(NamedTuple):
+    layers: Layers  # encoder + decoder stacked; last layer linear
+    err_scale: jnp.ndarray  # scalar calibration for proba squashing
+
+
+def init_autoencoder(
+    n_features: int,
+    hidden: Sequence[int] = (32, 8),
+    seed: int = 0,
+) -> AutoencoderParams:
+    """Symmetric hourglass: f → hidden… → bottleneck → …hidden → f."""
+    key = jax.random.PRNGKey(seed)
+    dims = [n_features, *hidden, *reversed(hidden[:-1]), n_features]
+    layers: Layers = []
+    for i in range(len(dims) - 1):
+        key, k = jax.random.split(key)
+        scale = np.sqrt(2.0 / dims[i])
+        layers.append(
+            (
+                scale
+                * jax.random.normal(k, (dims[i], dims[i + 1]), dtype=jnp.float32),
+                jnp.zeros((dims[i + 1],), dtype=jnp.float32),
+            )
+        )
+    return AutoencoderParams(layers=layers, err_scale=jnp.asarray(1.0))
+
+
+def reconstruct(params: AutoencoderParams, x: jnp.ndarray) -> jnp.ndarray:
+    h = x
+    for w, b in params.layers[:-1]:
+        h = jax.nn.relu(h @ w + b)
+    w, b = params.layers[-1]
+    return h @ w + b
+
+
+def reconstruction_error(
+    params: AutoencoderParams, x: jnp.ndarray
+) -> jnp.ndarray:
+    """Per-row mean squared reconstruction error."""
+    r = reconstruct(params, x)
+    return jnp.mean((r - x) ** 2, axis=-1)
+
+
+def autoencoder_predict_proba(
+    params: AutoencoderParams, x: jnp.ndarray
+) -> jnp.ndarray:
+    """Anomaly score in [0, 1): 1 - exp(-err / err_scale).
+
+    err == median legit error → score ≈ 0.39; large errors → 1. Monotone in
+    the reconstruction error, so ranking metrics (AUC/AP/CP@k) are identical
+    to using the raw error.
+    """
+    err = reconstruction_error(params, x)
+    return 1.0 - jnp.exp(-err / jnp.maximum(params.err_scale, 1e-12))
+
+
+def autoencoder_loss(
+    params: AutoencoderParams,
+    x: jnp.ndarray,
+    y: jnp.ndarray | None = None,
+    valid: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Masked mean reconstruction MSE. ``y`` (labels, 1=fraud), when given,
+    masks frauds out of the objective — online updates then only pull the
+    manifold toward legitimate traffic."""
+    per = reconstruction_error(params, x)
+    w = jnp.ones_like(per)
+    if y is not None:
+        w = w * (1.0 - jnp.clip(y.astype(jnp.float32), 0.0, 1.0))
+    if valid is not None:
+        w = w * valid.astype(jnp.float32)
+    return jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def train_autoencoder(
+    x: np.ndarray,
+    y: np.ndarray | None = None,
+    hidden: Sequence[int] = (32, 8),
+    learning_rate: float = 1e-3,
+    batch_size: int = 4096,
+    epochs: int = 10,
+    seed: int = 0,
+) -> AutoencoderParams:
+    """Fit on scaled features; rows with y==1 are excluded from training."""
+    x = np.asarray(x, dtype=np.float32)
+    if y is not None:
+        x = x[np.asarray(y) == 0]
+    n, f = x.shape
+    if n == 0:
+        raise ValueError(
+            "train_autoencoder: no legitimate rows to train on "
+            "(all rows filtered out by labels)"
+        )
+    params = init_autoencoder(f, hidden, seed)
+    opt = optax.adam(learning_rate)
+    opt_state = opt.init(params.layers)
+
+    @jax.jit
+    def step(layers, opt_state, xb):
+        def loss_fn(ls):
+            return autoencoder_loss(params._replace(layers=ls), xb)
+
+        loss, g = jax.value_and_grad(loss_fn)(layers)
+        updates, opt_state = opt.update(g, opt_state)
+        return optax.apply_updates(layers, updates), opt_state, loss
+
+    rng = np.random.default_rng(seed)
+    xj = jnp.asarray(x)
+    layers = params.layers
+    bs = min(batch_size, n)
+    for _ in range(epochs):
+        perm = rng.permutation(n)
+        for s in range(0, n - bs + 1, bs):
+            idx = perm[s : s + bs]
+            layers, opt_state, _ = step(layers, opt_state, xj[idx])
+    params = params._replace(layers=layers)
+    # Calibrate the probability squash to the train-set median error.
+    errs = np.asarray(reconstruction_error(params, xj))
+    med = float(np.median(errs)) if len(errs) else 1.0
+    return params._replace(err_scale=jnp.asarray(max(med, 1e-6)))

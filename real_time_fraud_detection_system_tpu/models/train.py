@@ -119,6 +119,12 @@ class TrainedModel:
             nf = int(x.shape[1])
             dev = self._device_params(lambda p: for_device(p, nf))
             return np.asarray(forest_predict_proba(dev, x))
+        if self.kind == "autoencoder":
+            from real_time_fraud_detection_system_tpu.models.autoencoder import (
+                autoencoder_predict_proba,
+            )
+
+            return np.asarray(autoencoder_predict_proba(self.params, x))
         raise ValueError(f"unknown model kind {self.kind}")
 
     def _np_params(self):
@@ -129,6 +135,11 @@ class TrainedModel:
                 cached = (np.asarray(self.params.w), float(self.params.b))
             elif self.kind == "mlp":
                 cached = [(np.asarray(w), np.asarray(b)) for w, b in self.params]
+            elif self.kind == "autoencoder":
+                cached = (
+                    [(np.asarray(w), np.asarray(b)) for w, b in self.params.layers],
+                    float(self.params.err_scale),
+                )
             elif self.kind in ("tree", "forest", "gbt"):
                 trees = self.params.trees if self.kind == "gbt" else self.params
                 cached = {
@@ -166,6 +177,14 @@ class TrainedModel:
             w, b = params[-1]
             z = (h @ w + b)[:, 0]
             return 1.0 / (1.0 + np.exp(-z))
+        if self.kind == "autoencoder":
+            layers, err_scale = params
+            h = x
+            for w, b in layers[:-1]:
+                h = np.maximum(h @ w + b, 0.0)
+            w, b = layers[-1]
+            err = np.mean((h @ w + b - x) ** 2, axis=1)
+            return 1.0 - np.exp(-err / max(err_scale, 1e-12))
         if self.kind in ("tree", "forest", "gbt"):
             feat = params["feat"]
             thresh = params["thresh"]
@@ -244,6 +263,18 @@ def fit_classifier(
             xs, y_train,
             n_trees=cfg.model.forest_n_trees,
             max_depth=cfg.model.forest_max_depth,
+        )
+    elif kind == "autoencoder":
+        from real_time_fraud_detection_system_tpu.models.autoencoder import (
+            train_autoencoder,
+        )
+
+        params = train_autoencoder(
+            xs, y_train,
+            hidden=tuple(cfg.model.autoencoder_hidden),
+            batch_size=cfg.train.batch_size,
+            epochs=cfg.train.epochs,
+            seed=cfg.model.seed,
         )
     else:
         raise ValueError(f"unknown model kind {kind}")

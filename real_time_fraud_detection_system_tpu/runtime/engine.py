@@ -69,6 +69,12 @@ def predict_fn_for(kind: str) -> Callable:
         return gbt_predict_proba
     if kind in ("tree", "forest"):
         return forest_predict_proba
+    if kind == "autoencoder":
+        from real_time_fraud_detection_system_tpu.models.autoencoder import (
+            autoencoder_predict_proba,
+        )
+
+        return autoencoder_predict_proba
     raise ValueError(f"unknown model kind {kind}")
 
 
@@ -77,6 +83,12 @@ def loss_fn_for(kind: str) -> Optional[Callable]:
         return logreg_loss
     if kind == "mlp":
         return mlp_loss
+    if kind == "autoencoder":
+        from real_time_fraud_detection_system_tpu.models.autoencoder import (
+            autoencoder_loss,
+        )
+
+        return autoencoder_loss
     return None  # tree ensembles have no gradient path
 
 
