@@ -42,10 +42,11 @@ def _platform_setup(platform: str | None) -> None:
 
 
 def _start_epoch_s(start_date: str) -> int:
-    import datetime as dt
+    from real_time_fraud_detection_system_tpu.utils.timing import (
+        date_to_epoch_s,
+    )
 
-    d = dt.date.fromisoformat(start_date)
-    return int((d - dt.date(1970, 1, 1)).days) * 86400
+    return date_to_epoch_s(start_date)
 
 
 def cmd_datagen(args) -> int:
@@ -153,6 +154,66 @@ def cmd_score(args) -> int:
     return 0
 
 
+def cmd_demo(args) -> int:
+    """Full E2E demo: generate → CDC envelopes → sink jobs → score.
+
+    The in-process equivalent of the reference's `make up && make
+    load_initial_data && make connectors && make run-all` flow (README.md:
+    31-43) with the datagen container driving it.
+    """
+    from real_time_fraud_detection_system_tpu.config import (
+        Config,
+        DataConfig,
+        FeatureConfig,
+        TrainConfig,
+    )
+    from real_time_fraud_detection_system_tpu.runtime.pipeline import run_demo
+    from real_time_fraud_detection_system_tpu.utils.logging import get_logger
+
+    log = get_logger("demo")
+    cfg = Config(
+        data=DataConfig(
+            n_customers=args.customers,
+            n_terminals=args.terminals,
+            n_days=args.days,
+            seed=args.seed,
+        ),
+        features=FeatureConfig(
+            customer_capacity=_pow2_at_least(args.customers),
+            terminal_capacity=_pow2_at_least(args.terminals),
+        ),
+        train=TrainConfig(
+            delta_train_days=args.delta_train,
+            delta_delay_days=args.delta_delay,
+            delta_test_days=args.delta_test,
+        ),
+    )
+    model = None
+    if args.model_file:
+        from real_time_fraud_detection_system_tpu.io.artifacts import (
+            load_model,
+        )
+
+        model = load_model(args.model_file)
+        log.info("loaded model %s from %s", model.kind, args.model_file)
+    summary = run_demo(
+        cfg,
+        model=model,
+        model_kind=args.model,
+        out_dir=args.out or None,
+        batch_rows=args.batch_rows,
+    )
+    print(json.dumps(summary))
+    return 0
+
+
+def _pow2_at_least(n: int) -> int:
+    p = 1
+    while p < 2 * n:
+        p *= 2
+    return p
+
+
 def cmd_bench(args) -> int:
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     sys.path.insert(0, repo_root)
@@ -206,6 +267,23 @@ def main(argv=None) -> int:
     p.add_argument("--max-batches", type=int, default=0)
     p.add_argument("--online-lr", type=float, default=0.0)
     p.set_defaults(fn=cmd_score)
+
+    p = sub.add_parser("demo",
+                       help="full E2E demo: datagen → CDC → sinks → scorer")
+    p.add_argument("--customers", type=int, default=500)
+    p.add_argument("--terminals", type=int, default=1000)
+    p.add_argument("--days", type=int, default=90)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--model", default="forest",
+                   choices=["logreg", "mlp", "tree", "forest", "gbt",
+                            "autoencoder"])
+    p.add_argument("--model-file", default="")
+    p.add_argument("--delta-train", type=int, default=45)
+    p.add_argument("--delta-delay", type=int, default=10)
+    p.add_argument("--delta-test", type=int, default=20)
+    p.add_argument("--batch-rows", type=int, default=4096)
+    p.add_argument("--out", default="")
+    p.set_defaults(fn=cmd_demo)
 
     p = sub.add_parser("bench", help="run the benchmark harness")
     p.add_argument("--quick", action="store_true")

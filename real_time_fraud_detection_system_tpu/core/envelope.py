@@ -199,6 +199,104 @@ def decode_transaction_envelopes(
     return cols, ~valid
 
 
+def encode_profile_envelope(
+    table: str,
+    row: dict,
+    op: str = "c",
+    ts_ms: int = 0,
+) -> bytes:
+    """One Debezium envelope for a dimension-table row (customers/terminals).
+
+    The reference's job1/job2 consume these from
+    ``debezium.payment.{customers,terminals}`` with plain numeric columns
+    (``kafka_s3_sink_customers.py:51-90``) — no binary decimals involved.
+    """
+    env = {
+        "schema": {
+            "type": "struct",
+            "name": f"debezium.payment.{table}.Envelope",
+        },
+        "payload": {
+            "before": None,
+            "after": {
+                k: (float(v) if isinstance(v, (float, np.floating)) else int(v))
+                for k, v in row.items()
+            },
+            "source": {
+                "connector": "postgresql",
+                "db": "postgres",
+                "schema": "payment",
+                "table": table,
+                "ts_ms": int(ts_ms),
+            },
+            "op": op,
+            "ts_ms": int(ts_ms),
+        },
+    }
+    return json.dumps(env, separators=(",", ":")).encode("utf-8")
+
+
+def encode_profile_envelopes(
+    table: str,
+    columns: dict,
+    ts_ms: int = 0,
+) -> List[bytes]:
+    """Columnar dict → list of envelopes, one per row."""
+    names = list(columns)
+    n = len(columns[names[0]]) if names else 0
+    return [
+        encode_profile_envelope(
+            table, {k: columns[k][i] for k in names}, ts_ms=ts_ms
+        )
+        for i in range(n)
+    ]
+
+
+def decode_profile_envelopes(
+    messages: Iterable[bytes],
+    fields: Sequence[Tuple[str, str]],
+    kafka_timestamps_ms: Optional[Sequence[int]] = None,
+) -> Tuple[dict, np.ndarray]:
+    """Decode dimension-table envelopes into columns per a TableSchema.
+
+    Returns ``(columns, tombstone_mask)`` with ``op`` and ``kafka_ts_ms``
+    columns appended, mirroring :func:`decode_transaction_envelopes`.
+    Extraction semantics follow ``kafka_s3_sink_customers.py:124-160``:
+    take ``payload.after`` (or ``before`` for deletes), mask null payloads.
+    """
+    msgs = list(messages)
+    n = len(msgs)
+    cols = {name: np.zeros(n, dtype=dt) for name, dt in fields}
+    op = np.zeros(n, dtype=np.int8)
+    valid = np.zeros(n, dtype=bool)
+    op_codes = {"c": 0, "u": 1, "d": 2, "r": 3}
+    for i, m in enumerate(msgs):
+        try:
+            payload = json.loads(m)["payload"]
+        except (ValueError, KeyError, TypeError):
+            continue
+        if payload is None:
+            continue
+        row = payload.get("after") or payload.get("before")
+        if row is None:
+            continue
+        try:
+            for name, _ in fields:
+                cols[name][i] = row[name]
+        except (KeyError, TypeError, ValueError):
+            for name, _ in fields:
+                cols[name][i] = 0
+            continue
+        op[i] = op_codes.get(payload.get("op", "c"), 0)
+        valid[i] = True
+    cols["op"] = op
+    if kafka_timestamps_ms is None:
+        cols["kafka_ts_ms"] = np.zeros(n, dtype=np.int64)
+    else:
+        cols["kafka_ts_ms"] = np.asarray(kafka_timestamps_ms, dtype=np.int64)
+    return cols, ~valid
+
+
 def decode_transaction_envelopes_fast(
     messages: Iterable[bytes],
     kafka_timestamps_ms: Optional[Sequence[int]] = None,

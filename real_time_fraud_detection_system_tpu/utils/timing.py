@@ -9,10 +9,17 @@ cheap enough for the 1M txns/s target loop.
 
 from __future__ import annotations
 
+import datetime as _dt
 import time
 from typing import Dict, Optional
 
 import numpy as np
+
+
+def date_to_epoch_s(date: str) -> int:
+    """ISO date string → seconds since the unix epoch (UTC midnight)."""
+    d = _dt.date.fromisoformat(date)
+    return int((d - _dt.date(1970, 1, 1)).days) * 86400
 
 
 class Timer:
