@@ -41,6 +41,22 @@ def _platform_setup(platform: str | None) -> None:
         jax.config.update("jax_platforms", want)
 
 
+def _json_line(obj) -> str:
+    """Strict-JSON dump: NaN/Inf floats become null (json.dumps would emit
+    the non-standard literals and break jq/JSON.parse consumers)."""
+
+    def clean(v):
+        if isinstance(v, dict):
+            return {k: clean(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return [clean(x) for x in v]
+        if isinstance(v, float) and not np.isfinite(v):
+            return None
+        return v
+
+    return json.dumps(clean(obj), allow_nan=False)
+
+
 def _start_epoch_s(start_date: str) -> int:
     from real_time_fraud_detection_system_tpu.utils.timing import (
         date_to_epoch_s,
@@ -98,7 +114,7 @@ def cmd_train(args) -> int:
     save_model(args.out_model, model)
     log.info("model=%s metrics=%s -> %s", args.model,
              {k: round(v, 4) for k, v in metrics.items()}, args.out_model)
-    print(json.dumps({"model": args.model, **metrics}))
+    print(_json_line({"model": args.model, **metrics}))
     return 0
 
 
@@ -150,7 +166,7 @@ def cmd_score(args) -> int:
     stats = engine.run(source, sink=sink, checkpointer=ckpt,
                        max_batches=args.max_batches)
     log.info("done: %s", stats)
-    print(json.dumps({"scorer": args.scorer, **stats}))
+    print(_json_line({"scorer": args.scorer, **stats}))
     return 0
 
 
@@ -179,8 +195,8 @@ def cmd_demo(args) -> int:
             seed=args.seed,
         ),
         features=FeatureConfig(
-            customer_capacity=_pow2_at_least(args.customers),
-            terminal_capacity=_pow2_at_least(args.terminals),
+            customer_capacity=_pow2_capacity_for(args.customers),
+            terminal_capacity=_pow2_capacity_for(args.terminals),
         ),
         train=TrainConfig(
             delta_train_days=args.delta_train,
@@ -203,15 +219,31 @@ def cmd_demo(args) -> int:
         out_dir=args.out or None,
         batch_rows=args.batch_rows,
     )
-    print(json.dumps(summary))
+    print(_json_line(summary))
     return 0
 
 
-def _pow2_at_least(n: int) -> int:
+def _pow2_capacity_for(n: int) -> int:
+    """Smallest power of two >= 2n — direct-mode slot capacity with 2x
+    headroom over the live key count."""
     p = 1
     while p < 2 * n:
         p *= 2
     return p
+
+
+def cmd_query(args) -> int:
+    """Dashboard reports over analyzed output (the Trino/Superset role)."""
+    from real_time_fraud_detection_system_tpu.io.query import (
+        load_analyzed,
+        report,
+    )
+
+    cols = load_analyzed(args.data)
+    out = report(cols, kind=args.report, threshold=args.threshold,
+                 k=args.top_k, bucket=args.bucket)
+    print(_json_line(out))
+    return 0
 
 
 def cmd_bench(args) -> int:
@@ -284,6 +316,18 @@ def main(argv=None) -> int:
     p.add_argument("--batch-rows", type=int, default=4096)
     p.add_argument("--out", default="")
     p.set_defaults(fn=cmd_demo)
+
+    p = sub.add_parser("query",
+                       help="dashboard reports over analyzed parquet output")
+    p.add_argument("--data", required=True,
+                   help="analyzed output directory (ParquetSink)")
+    p.add_argument("--report", default="summary",
+                   choices=["summary", "timeseries", "terminals",
+                            "customers", "alerts"])
+    p.add_argument("--threshold", type=float, default=0.5)
+    p.add_argument("--top-k", type=int, default=10)
+    p.add_argument("--bucket", default="day", choices=["hour", "day"])
+    p.set_defaults(fn=cmd_query)
 
     p = sub.add_parser("bench", help="run the benchmark harness")
     p.add_argument("--quick", action="store_true")

@@ -1,0 +1,182 @@
+"""Dashboard queries over the analyzed-transactions output.
+
+The reference serves its results through Trino SQL over the Iceberg
+``nessie.payment.analyzed_transactions`` table into a Superset dashboard
+(``trino-config/catalog/nessie.properties:1-14``,
+``superset/entrypoint.sh:19``). This module is the in-process equivalent for
+deployments without that stack: the canned aggregations a fraud-ops
+dashboard is built from, computed columnar over the ParquetSink output (or
+any analyzed column dict). Trino/Superset still work unchanged on the
+Parquet files for full-SQL deployments.
+
+All functions take the analyzed column dict produced by
+``io.sink._result_to_columns`` (keys: ``tx_id``, ``tx_datetime_us``,
+``customer_id``, ``terminal_id``, ``tx_amount``, 15 feature columns,
+``prediction``, ``processed_at_us``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+_US_PER_HOUR = 3_600_000_000
+_US_PER_DAY = 24 * _US_PER_HOUR
+
+
+def load_analyzed(directory: str) -> Dict[str, np.ndarray]:
+    """Read every parquet part file of an analyzed output directory."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    files = sorted(
+        os.path.join(directory, f)
+        for f in os.listdir(directory)
+        if f.endswith(".parquet")
+    )
+    if not files:
+        return {}
+    table = pa.concat_tables([pq.read_table(f) for f in files])
+    return {c: table[c].to_numpy() for c in table.column_names}
+
+
+def summary_stats(cols: Dict[str, np.ndarray],
+                  threshold: float = 0.5) -> dict:
+    """Headline tiles: volumes, amounts, flag rate, score distribution."""
+    n = len(cols.get("tx_id", ()))
+    if n == 0:
+        return {"transactions": 0}
+    pred = cols["prediction"]
+    amount = cols["tx_amount"]
+    flagged = pred >= threshold
+    return {
+        "transactions": int(n),
+        "customers": int(len(np.unique(cols["customer_id"]))),
+        "terminals": int(len(np.unique(cols["terminal_id"]))),
+        "total_amount": float(amount.sum()),
+        "flagged": int(flagged.sum()),
+        "flagged_rate": float(flagged.mean()),
+        "flagged_amount": float(amount[flagged].sum()),
+        "score_mean": float(pred.mean()),
+        "score_p50": float(np.percentile(pred, 50)),
+        "score_p99": float(np.percentile(pred, 99)),
+        "threshold": float(threshold),
+    }
+
+
+def fraud_rate_over_time(
+    cols: Dict[str, np.ndarray],
+    bucket: str = "hour",
+    threshold: float = 0.5,
+) -> Dict[str, np.ndarray]:
+    """Time series of volume / flags / mean score per hour or day bucket."""
+    div = _US_PER_HOUR if bucket == "hour" else _US_PER_DAY
+    if bucket not in ("hour", "day"):
+        raise ValueError("bucket must be 'hour' or 'day'")
+    t = cols["tx_datetime_us"] // div
+    pred = cols["prediction"]
+    uniq, inv = np.unique(t, return_inverse=True)
+    count = np.bincount(inv, minlength=len(uniq))
+    flags = np.bincount(inv, weights=(pred >= threshold), minlength=len(uniq))
+    score_sum = np.bincount(inv, weights=pred, minlength=len(uniq))
+    amount = np.bincount(inv, weights=cols["tx_amount"], minlength=len(uniq))
+    return {
+        "bucket_start_us": uniq * div,
+        "transactions": count.astype(np.int64),
+        "flagged": flags.astype(np.int64),
+        "flag_rate": flags / np.maximum(count, 1),
+        "mean_score": score_sum / np.maximum(count, 1),
+        "amount": amount,
+    }
+
+
+def _top_by_key(
+    cols: Dict[str, np.ndarray],
+    key_col: str,
+    k: int,
+    threshold: float,
+    min_transactions: int,
+) -> Dict[str, np.ndarray]:
+    keys = cols[key_col]
+    pred = cols["prediction"]
+    uniq, inv = np.unique(keys, return_inverse=True)
+    count = np.bincount(inv, minlength=len(uniq))
+    score_sum = np.bincount(inv, weights=pred, minlength=len(uniq))
+    flags = np.bincount(inv, weights=(pred >= threshold), minlength=len(uniq))
+    amount = np.bincount(inv, weights=cols["tx_amount"], minlength=len(uniq))
+    mean_score = score_sum / np.maximum(count, 1)
+    eligible = count >= min_transactions
+    rank_score = np.where(eligible, mean_score, -np.inf)
+    top = np.argsort(-rank_score, kind="mergesort")[:k]
+    top = top[np.isfinite(rank_score[top])]
+    return {
+        key_col: uniq[top],
+        "transactions": count[top].astype(np.int64),
+        "mean_score": mean_score[top],
+        "flagged": flags[top].astype(np.int64),
+        "amount": amount[top],
+    }
+
+
+def top_risky_terminals(
+    cols: Dict[str, np.ndarray],
+    k: int = 10,
+    threshold: float = 0.5,
+    min_transactions: int = 3,
+) -> Dict[str, np.ndarray]:
+    """Terminals ranked by mean fraud score (the compromised-terminal view —
+    scenario 2's detection surface, ``data_generator.ipynb · cell 42``)."""
+    return _top_by_key(cols, "terminal_id", k, threshold, min_transactions)
+
+
+def top_risky_customers(
+    cols: Dict[str, np.ndarray],
+    k: int = 10,
+    threshold: float = 0.5,
+    min_transactions: int = 3,
+) -> Dict[str, np.ndarray]:
+    """Customers ranked by mean fraud score (scenario-3 view; the per-card
+    ranking that Card Precision@k assesses)."""
+    return _top_by_key(cols, "customer_id", k, threshold, min_transactions)
+
+
+def recent_alerts(
+    cols: Dict[str, np.ndarray],
+    threshold: float = 0.5,
+    limit: int = 100,
+) -> Dict[str, np.ndarray]:
+    """Most recent flagged transactions — the ops work queue."""
+    pred = cols["prediction"]
+    idx = np.flatnonzero(pred >= threshold)
+    order = np.argsort(-cols["tx_datetime_us"][idx], kind="mergesort")[:limit]
+    pick = idx[order]
+    keep = ("tx_id", "tx_datetime_us", "customer_id", "terminal_id",
+            "tx_amount", "prediction")
+    return {c: cols[c][pick] for c in keep if c in cols}
+
+
+def report(
+    cols: Dict[str, np.ndarray],
+    kind: str = "summary",
+    threshold: float = 0.5,
+    k: int = 10,
+    bucket: str = "day",
+) -> dict:
+    """Dispatch a named dashboard report; arrays JSON-ready (lists)."""
+    if kind == "summary":
+        return summary_stats(cols, threshold)
+    if kind not in ("timeseries", "terminals", "customers", "alerts"):
+        raise ValueError(f"unknown report kind {kind}")
+    if not cols or len(cols.get("tx_id", ())) == 0:
+        return {}
+    if kind == "timeseries":
+        out = fraud_rate_over_time(cols, bucket, threshold)
+    elif kind == "terminals":
+        out = top_risky_terminals(cols, k, threshold)
+    elif kind == "customers":
+        out = top_risky_customers(cols, k, threshold)
+    else:
+        out = recent_alerts(cols, threshold, k)
+    return {key: v.tolist() for key, v in out.items()}

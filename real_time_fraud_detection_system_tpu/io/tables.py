@@ -49,14 +49,12 @@ class UpsertTable:
             arr = np.zeros(new_cap, dtype=self._cols[name].dtype)
             arr[: self._n] = self._cols[name][: self._n]
             self._cols[name] = arr
-        for old, name in ((self._version, "_version"), (self._live, "_live")):
-            arr = np.full(
-                new_cap,
-                np.iinfo(np.int64).min if name == "_version" else False,
-                dtype=old.dtype,
-            )
-            arr[: self._n] = old[: self._n]
-            setattr(self, name, arr)
+        version = np.full(new_cap, np.iinfo(np.int64).min, np.int64)
+        version[: self._n] = self._version[: self._n]
+        self._version = version
+        live = np.zeros(new_cap, dtype=bool)
+        live[: self._n] = self._live[: self._n]
+        self._live = live
 
     def merge(
         self,

@@ -1,0 +1,104 @@
+"""Dashboard query layer (the Trino/Superset role, SURVEY §2.2/L5)."""
+
+import numpy as np
+import pytest
+
+from real_time_fraud_detection_system_tpu.io.query import (
+    fraud_rate_over_time,
+    recent_alerts,
+    report,
+    summary_stats,
+    top_risky_customers,
+    top_risky_terminals,
+)
+
+_US_HOUR = 3_600_000_000
+
+
+@pytest.fixture(scope="module")
+def analyzed():
+    # 8 txs over 3 hours, two terminals; terminal 20 is "hot".
+    return {
+        "tx_id": np.arange(8, dtype=np.int64),
+        "tx_datetime_us": np.array(
+            [0, 1, 1, 2, 2, 2, 2, 2], dtype=np.int64) * _US_HOUR,
+        "customer_id": np.array([1, 1, 2, 2, 3, 3, 3, 4], dtype=np.int64),
+        "terminal_id": np.array([10, 10, 20, 20, 20, 20, 10, 10],
+                                dtype=np.int64),
+        "tx_amount": np.array([10.0, 20, 30, 40, 50, 60, 70, 80]),
+        "prediction": np.array([0.1, 0.2, 0.9, 0.8, 0.7, 0.95, 0.1, 0.3]),
+    }
+
+
+def test_summary_stats(analyzed):
+    s = summary_stats(analyzed, threshold=0.5)
+    assert s["transactions"] == 8
+    assert s["customers"] == 4
+    assert s["terminals"] == 2
+    assert s["flagged"] == 4
+    assert s["flagged_rate"] == 0.5
+    assert s["flagged_amount"] == 30.0 + 40 + 50 + 60
+    assert summary_stats({"tx_id": np.zeros(0)}) == {"transactions": 0}
+
+
+def test_fraud_rate_over_time(analyzed):
+    ts = fraud_rate_over_time(analyzed, bucket="hour", threshold=0.5)
+    np.testing.assert_array_equal(ts["transactions"], [1, 2, 5])
+    np.testing.assert_array_equal(ts["flagged"], [0, 1, 3])
+    np.testing.assert_allclose(ts["flag_rate"], [0.0, 0.5, 0.6])
+    assert (np.diff(ts["bucket_start_us"]) > 0).all()
+    with pytest.raises(ValueError):
+        fraud_rate_over_time(analyzed, bucket="week")
+
+
+def test_top_risky_terminals(analyzed):
+    top = top_risky_terminals(analyzed, k=5, min_transactions=3)
+    # terminal 20: scores .9 .8 .7 .95 → mean .8375; terminal 10: mean .175
+    np.testing.assert_array_equal(top["terminal_id"], [20, 10])
+    np.testing.assert_allclose(top["mean_score"], [0.8375, 0.175])
+    # min_transactions filters low-support keys out entirely
+    top2 = top_risky_terminals(analyzed, k=5, min_transactions=5)
+    assert top2["terminal_id"].tolist() == []
+
+
+def test_top_risky_customers(analyzed):
+    top = top_risky_customers(analyzed, k=2, min_transactions=1)
+    assert top["customer_id"][0] == 2  # mean(.9,.8) highest
+
+
+def test_recent_alerts(analyzed):
+    alerts = recent_alerts(analyzed, threshold=0.5, limit=2)
+    assert len(alerts["tx_id"]) == 2
+    # newest first
+    assert (np.diff(alerts["tx_datetime_us"]) <= 0).all()
+    assert (alerts["prediction"] >= 0.5).all()
+
+
+def test_report_dispatch_and_cli(analyzed, tmp_path):
+    assert report(analyzed, "summary")["transactions"] == 8
+    assert isinstance(report(analyzed, "terminals")["terminal_id"], list)
+    with pytest.raises(ValueError):
+        report(analyzed, "nope")
+    # Empty directory / no rows: empty report, no KeyError.
+    assert report({}, "timeseries") == {}
+    assert report({}, "summary") == {"transactions": 0}
+    assert report({"tx_id": np.zeros(0)}, "alerts") == {}
+
+    # CLI path over a real parquet dir.
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    pq.write_table(pa.table({k: pa.array(v) for k, v in analyzed.items()}),
+                   str(tmp_path / "part-0.parquet"))
+    import json
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, "-m", "real_time_fraud_detection_system_tpu.cli",
+         "query", "--data", str(tmp_path), "--report", "summary"],
+        capture_output=True, text=True, cwd="/root/repo",
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout.strip().splitlines()[-1])["transactions"] == 8
