@@ -140,15 +140,17 @@ def cmd_score(args) -> int:
     if args.scorer == "cpu":
         cpu_model = model  # TrainedModel.predict_proba runs host-side numpy
 
-    engine = ScoringEngine(
-        cfg,
-        kind=model.kind,
-        params=model.params,
-        scaler=model.scaler,
-        scorer=args.scorer,
-        cpu_model=cpu_model,
-        online_lr=args.online_lr,
-    )
+    def make_engine():
+        return ScoringEngine(
+            cfg,
+            kind=model.kind,
+            params=model.params,
+            scaler=model.scaler,
+            scorer=args.scorer,
+            cpu_model=cpu_model,
+            online_lr=args.online_lr,
+        )
+
     source = ReplaySource(
         txs,
         _start_epoch_s(args.start_date),
@@ -157,14 +159,27 @@ def cmd_score(args) -> int:
         with_labels=args.online_lr > 0,
     )
     ckpt = Checkpointer(args.checkpoint_dir) if args.checkpoint_dir else None
-    if ckpt is not None and args.resume:
-        restored = ckpt.restore(engine.state)
-        if restored is not None:
-            source.seek(engine.state.offsets)
-            log.info("resumed from batch %d", engine.state.batches_done)
     sink = ParquetSink(args.out) if args.out else None
-    stats = engine.run(source, sink=sink, checkpointer=ckpt,
-                       max_batches=args.max_batches)
+    if ckpt is not None and args.max_restarts > 0:
+        # Supervised mode: restart-on-failure with checkpoint replay
+        # (the compose `restart: on-failure` + Spark checkpoint contract).
+        from real_time_fraud_detection_system_tpu.runtime.faults import (
+            run_with_recovery,
+        )
+
+        stats = run_with_recovery(
+            make_engine, source, ckpt, sink=sink,
+            max_restarts=args.max_restarts, max_batches=args.max_batches,
+        )
+    else:
+        engine = make_engine()
+        if ckpt is not None and args.resume:
+            restored = ckpt.restore(engine.state)
+            if restored is not None:
+                source.seek(engine.state.offsets)
+                log.info("resumed from batch %d", engine.state.batches_done)
+        stats = engine.run(source, sink=sink, checkpointer=ckpt,
+                           max_batches=args.max_batches)
     log.info("done: %s", stats)
     print(_json_line({"scorer": args.scorer, **stats}))
     return 0
@@ -298,6 +313,9 @@ def main(argv=None) -> int:
     p.add_argument("--resume", action="store_true")
     p.add_argument("--max-batches", type=int, default=0)
     p.add_argument("--online-lr", type=float, default=0.0)
+    p.add_argument("--max-restarts", type=int, default=0,
+                   help="supervised mode: restart-on-failure with "
+                        "checkpoint replay (requires --checkpoint-dir)")
     p.set_defaults(fn=cmd_score)
 
     p = sub.add_parser("demo",

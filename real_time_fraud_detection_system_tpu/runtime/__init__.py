@@ -7,3 +7,15 @@ from real_time_fraud_detection_system_tpu.runtime.engine import (  # noqa: F401
     EngineState,
     ScoringEngine,
 )
+from real_time_fraud_detection_system_tpu.runtime.faults import (  # noqa: F401
+    FlakySource,
+    Heartbeat,
+    RetryPolicy,
+    TransientError,
+    corrupt_messages,
+    run_with_recovery,
+    with_retries,
+)
+from real_time_fraud_detection_system_tpu.runtime.pipeline import (  # noqa: F401
+    run_demo,
+)

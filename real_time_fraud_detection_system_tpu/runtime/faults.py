@@ -1,0 +1,207 @@
+"""Failure detection, retry policies, fault injection, supervised recovery.
+
+The reference's resilience machinery is compose-level only (SURVEY §5.3):
+healthchecks + ``restart:`` policies (``docker-compose.yml:83-87,133``), the
+datagen 4×5 s connect retry (``datagen/data_gen.py:72-80``), tolerated model
+-download 404s (``fraud_detection.py:73-79``), and Spark checkpoint replay.
+It has **no fault injection at all**. This module provides the in-process
+equivalents plus the missing injection tools:
+
+- :class:`RetryPolicy` / :func:`with_retries` — exponential-backoff retry,
+  the ``psycopg2`` connect-loop analogue;
+- :class:`Heartbeat` — stall detection for the micro-batch loop (the
+  healthcheck role: no progress for ``timeout_s`` → unhealthy);
+- :class:`FlakySource` / :func:`corrupt_messages` — deterministic fault
+  injectors: scripted transient poll failures (source wrapper) and
+  scripted envelope corruption (message transform);
+- :func:`run_with_recovery` — the ``restart: on-failure`` supervisor: on a
+  crash, rebuild the engine state from the last checkpoint, seek the
+  source, resume; exactly-once at micro-batch granularity because offsets
+  and state are checkpointed atomically together (``io/checkpoint.py``).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence, Tuple, Type
+
+from real_time_fraud_detection_system_tpu.utils.logging import get_logger
+
+log = get_logger("faults")
+
+
+class TransientError(RuntimeError):
+    """An injected or genuinely transient failure — safe to retry."""
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff: delay = base * multiplier^attempt (capped)."""
+
+    max_attempts: int = 4
+    base_delay_s: float = 5.0
+    multiplier: float = 1.0  # reference uses constant 5 s sleeps
+    max_delay_s: float = 60.0
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+
+    def delay(self, attempt: int) -> float:
+        return min(self.base_delay_s * self.multiplier**attempt,
+                   self.max_delay_s)
+
+
+def with_retries(
+    fn: Callable,
+    policy: RetryPolicy = RetryPolicy(),
+    retry_on: Tuple[Type[BaseException], ...] = (TransientError,),
+    sleep: Callable[[float], None] = time.sleep,
+):
+    """Call ``fn()`` with up to ``max_attempts`` tries (the datagen connect
+    loop, ``data_gen.py:72-80``). Non-listed exceptions propagate at once."""
+    last: Optional[BaseException] = None
+    for attempt in range(policy.max_attempts):
+        try:
+            return fn()
+        except retry_on as e:  # noqa: PERF203 — retry loop by design
+            last = e
+            if attempt + 1 < policy.max_attempts:
+                d = policy.delay(attempt)
+                log.warning("attempt %d/%d failed (%s); retrying in %.1fs",
+                            attempt + 1, policy.max_attempts, e, d)
+                sleep(d)
+    raise last  # type: ignore[misc]
+
+
+class Heartbeat:
+    """Progress-based failure detector (the compose healthcheck role).
+
+    ``beat()`` on every processed batch (:func:`run_with_recovery` wires
+    this automatically when given a heartbeat); ``healthy()`` is False once
+    ``timeout_s`` passes with no beat. Checking is the job of an external
+    monitor thread — the supervisor loop itself is synchronous and can only
+    react to crashes, not silent stalls.
+    """
+
+    def __init__(self, timeout_s: float = 60.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.timeout_s = timeout_s
+        self._clock = clock
+        self._last = clock()
+        self.beats = 0
+
+    def beat(self) -> None:
+        self._last = self._clock()
+        self.beats += 1
+
+    def healthy(self) -> bool:
+        return (self._clock() - self._last) <= self.timeout_s
+
+    def seconds_since_beat(self) -> float:
+        return self._clock() - self._last
+
+
+class FlakySource:
+    """Wraps a source; raises TransientError on scripted poll indices.
+
+    ``fail_at`` lists 0-based poll indices that raise *instead of* returning
+    the batch; the underlying source is only advanced on success, so a
+    retried poll returns the batch the failure swallowed — exactly like a
+    Kafka consumer that died before committing.
+    """
+
+    def __init__(self, inner, fail_at: Sequence[int] = ()):
+        self.inner = inner
+        self.fail_at = set(int(i) for i in fail_at)
+        self._polls = 0
+
+    def poll_batch(self):
+        i = self._polls
+        self._polls += 1
+        if i in self.fail_at:
+            raise TransientError(f"injected poll failure #{i}")
+        return self.inner.poll_batch()
+
+    @property
+    def offsets(self):
+        return self.inner.offsets
+
+    def seek(self, offsets):
+        self.inner.seek(offsets)
+
+
+def corrupt_messages(msgs: Sequence[bytes],
+                     corrupt_every: int = 17) -> list:
+    """Envelope-level fault injection: truncate every k-th message.
+
+    Corrupt envelopes must be masked by the decoder, never crash the batch
+    (the golden-decode robustness property, SURVEY §4). Produce the result
+    into a broker/topic to exercise the full envelope path."""
+    k = max(int(corrupt_every), 1)
+    return [
+        m[: max(len(m) // 2, 1)] if i % k == k - 1 else m
+        for i, m in enumerate(msgs)
+    ]
+
+
+def run_with_recovery(
+    make_engine: Callable[[], object],
+    source,
+    checkpointer,
+    sink=None,
+    max_restarts: int = 3,
+    max_batches: int = 0,
+    heartbeat: Optional[Heartbeat] = None,
+) -> dict:
+    """Supervisor loop: run → on crash, restore last checkpoint and resume.
+
+    ``make_engine`` builds a fresh engine (state template) per incarnation;
+    the checkpointer restores (offsets, feature state, params, scaler) into
+    it and the source seeks to the checkpointed offsets, so every committed
+    micro-batch is processed exactly once and uncommitted ones are replayed
+    — Spark's checkpointLocation recovery contract (SURVEY §5.4).
+
+    The sink must tolerate replayed batches (idempotent append by tx_id or
+    latest-wins MERGE downstream, as in the reference's MERGE INTO).
+    """
+    restarts = 0
+    initial_offsets = list(source.offsets)
+    if heartbeat is not None:
+        inner_sink = sink
+
+        class _BeatSink:
+            def append(self, res):
+                heartbeat.beat()
+                if inner_sink is not None:
+                    inner_sink.append(res)
+
+        sink = _BeatSink()
+    while True:
+        engine = make_engine()
+        restored = checkpointer.restore(engine.state)
+        if restored is not None:
+            source.seek(engine.state.offsets)
+            log.info("restored checkpoint at batch %d",
+                     engine.state.batches_done)
+        else:
+            # No checkpoint yet: a fresh engine must consume from the very
+            # beginning, or batches polled before the crash would be lost
+            # to the new (empty) feature state.
+            source.seek(initial_offsets)
+        try:
+            stats = engine.run(
+                source, sink=sink, checkpointer=checkpointer,
+                max_batches=max_batches,
+            )
+            # Final checkpoint so a clean exit never replays.
+            checkpointer.save(engine.state)
+            stats["restarts"] = restarts
+            return stats
+        except TransientError as e:
+            restarts += 1
+            log.warning("engine crashed (%s); restart %d/%d",
+                        e, restarts, max_restarts)
+            if restarts > max_restarts:
+                raise

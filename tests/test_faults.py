@@ -1,0 +1,215 @@
+"""Failure detection, retries, fault injection, checkpoint recovery
+(SURVEY §5.3/§5.4 — the build must exceed the reference's compose-level
+resilience)."""
+
+import numpy as np
+import pytest
+
+from real_time_fraud_detection_system_tpu.config import (
+    Config,
+    DataConfig,
+    FeatureConfig,
+    RuntimeConfig,
+)
+from real_time_fraud_detection_system_tpu.io.checkpoint import Checkpointer
+from real_time_fraud_detection_system_tpu.io.sink import MemorySink
+from real_time_fraud_detection_system_tpu.models.logreg import init_logreg
+from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+from real_time_fraud_detection_system_tpu.runtime.engine import ScoringEngine
+from real_time_fraud_detection_system_tpu.runtime.faults import (
+    FlakySource,
+    Heartbeat,
+    RetryPolicy,
+    TransientError,
+    corrupt_messages,
+    run_with_recovery,
+    with_retries,
+)
+from real_time_fraud_detection_system_tpu.runtime.sources import ReplaySource
+
+EPOCH0 = 1_743_465_600
+
+
+def test_with_retries_succeeds_after_failures():
+    calls = {"n": 0}
+    sleeps = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise TransientError("boom")
+        return 42
+
+    out = with_retries(flaky, RetryPolicy(max_attempts=4, base_delay_s=5.0),
+                       sleep=sleeps.append)
+    assert out == 42
+    assert calls["n"] == 3
+    assert sleeps == [5.0, 5.0]  # reference's constant 5s cadence
+
+
+def test_with_retries_exhausts_and_raises():
+    def always():
+        raise TransientError("nope")
+
+    with pytest.raises(TransientError):
+        with_retries(always, RetryPolicy(max_attempts=2, base_delay_s=0.0),
+                     sleep=lambda _: None)
+
+
+def test_with_retries_nonlisted_exception_propagates_immediately():
+    calls = {"n": 0}
+
+    def bad():
+        calls["n"] += 1
+        raise ValueError("fatal")
+
+    with pytest.raises(ValueError):
+        with_retries(bad, RetryPolicy(max_attempts=5, base_delay_s=0.0),
+                     sleep=lambda _: None)
+    assert calls["n"] == 1
+
+
+def test_retry_policy_backoff_capped():
+    p = RetryPolicy(base_delay_s=1.0, multiplier=10.0, max_delay_s=30.0)
+    assert p.delay(0) == 1.0
+    assert p.delay(1) == 10.0
+    assert p.delay(2) == 30.0  # capped
+
+
+def test_heartbeat_detects_stall():
+    t = {"now": 0.0}
+    hb = Heartbeat(timeout_s=10.0, clock=lambda: t["now"])
+    assert hb.healthy()
+    t["now"] = 5.0
+    hb.beat()
+    t["now"] = 14.0
+    assert hb.healthy()
+    t["now"] = 16.0
+    assert not hb.healthy()
+    assert hb.seconds_since_beat() == 11.0
+    assert hb.beats == 1
+
+
+def test_corrupt_messages_masked_by_decoder(small_dataset):
+    from real_time_fraud_detection_system_tpu.core.envelope import (
+        decode_transaction_envelopes_fast,
+        encode_transaction_envelopes,
+    )
+
+    _, _, _, txs = small_dataset
+    part = txs.slice(slice(0, 100))
+    msgs = encode_transaction_envelopes(
+        part.tx_id, part.epoch_us(EPOCH0), part.customer_id,
+        part.terminal_id, part.amount_cents,
+    )
+    bad = corrupt_messages(msgs, corrupt_every=10)
+    cols, invalid = decode_transaction_envelopes_fast(bad)
+    assert invalid.sum() == 10  # every 10th truncated and masked
+    good = ~invalid
+    np.testing.assert_array_equal(cols["tx_id"][good],
+                                  part.tx_id[np.flatnonzero(good)])
+
+
+def _mk(small_dataset, tmp_path, every=2):
+    dcfg, _, _, txs = small_dataset
+    cfg = Config(
+        data=dcfg,
+        features=FeatureConfig(customer_capacity=256, terminal_capacity=512,
+                               cms_width=1 << 10),
+        runtime=RuntimeConfig(checkpoint_every_batches=every,
+                              batch_buckets=(256,), max_batch_rows=256),
+    )
+    params = init_logreg(15)
+    scaler = Scaler(mean=np.zeros(15, np.float32),
+                    scale=np.ones(15, np.float32))
+
+    def make_engine():
+        import jax.numpy as jnp
+
+        return ScoringEngine(
+            cfg, kind="logreg",
+            params=params, scaler=Scaler(jnp.asarray(scaler.mean),
+                                         jnp.asarray(scaler.scale)),
+        )
+
+    return cfg, txs, make_engine
+
+
+def test_run_with_recovery_exactly_once(small_dataset, tmp_path):
+    """Crash mid-stream → restore → final output ≡ clean run (by tx_id,
+    latest wins on replays)."""
+    cfg, txs, make_engine = _mk(small_dataset, tmp_path)
+    part = txs.slice(slice(0, 2048))
+
+    # Clean reference run.
+    clean_sink = MemorySink()
+    src = ReplaySource(part, EPOCH0, batch_rows=256)
+    make_engine().run(src, sink=clean_sink)
+    clean = clean_sink.concat()
+
+    # Faulty run: two injected crashes.
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    sink = MemorySink()
+    src2 = FlakySource(ReplaySource(part, EPOCH0, batch_rows=256),
+                       fail_at=(3, 6))
+    stats = run_with_recovery(make_engine, src2, ckpt, sink=sink,
+                              max_restarts=5)
+    assert stats["restarts"] == 2
+
+    out = sink.concat()
+    # Replayed batches may duplicate rows: dedup by tx_id keeping the last.
+    _, last_idx = np.unique(out["tx_id"][::-1], return_index=True)
+    keep = len(out["tx_id"]) - 1 - last_idx
+    assert len(keep) == len(clean["tx_id"])  # no gaps
+    a = np.argsort(out["tx_id"][keep])
+    b = np.argsort(clean["tx_id"])
+    np.testing.assert_array_equal(out["tx_id"][keep][a],
+                                  clean["tx_id"][b])
+    np.testing.assert_allclose(out["prediction"][keep][a],
+                               clean["prediction"][b], rtol=1e-5)
+
+
+def test_retry_policy_rejects_zero_attempts():
+    with pytest.raises(ValueError, match="max_attempts"):
+        RetryPolicy(max_attempts=0)
+
+
+def test_recovery_crash_before_first_checkpoint(small_dataset, tmp_path):
+    """A crash before ANY checkpoint must rewind to the stream start, or
+    the fresh engine's feature state would silently miss early batches."""
+    cfg, txs, make_engine = _mk(small_dataset, tmp_path, every=100)
+    part = txs.slice(slice(0, 1024))
+
+    clean_sink = MemorySink()
+    make_engine().run(ReplaySource(part, EPOCH0, batch_rows=256),
+                      sink=clean_sink)
+    clean = clean_sink.concat()
+
+    ckpt = Checkpointer(str(tmp_path / "ck3"))
+    sink = MemorySink()
+    hb = Heartbeat(timeout_s=1e9)
+    src = FlakySource(ReplaySource(part, EPOCH0, batch_rows=256),
+                      fail_at=(1,))  # batch 0 processed, then crash, no ckpt
+    stats = run_with_recovery(make_engine, src, ckpt, sink=sink,
+                              max_restarts=3, heartbeat=hb)
+    assert stats["restarts"] == 1
+    assert hb.beats > 0  # heartbeat wired into the batch loop
+
+    out = sink.concat()
+    _, last_idx = np.unique(out["tx_id"][::-1], return_index=True)
+    keep = len(out["tx_id"]) - 1 - last_idx
+    assert len(keep) == len(clean["tx_id"])
+    a = np.argsort(out["tx_id"][keep])
+    b = np.argsort(clean["tx_id"])
+    np.testing.assert_allclose(out["prediction"][keep][a],
+                               clean["prediction"][b], rtol=1e-5)
+
+
+def test_run_with_recovery_gives_up(small_dataset, tmp_path):
+    cfg, txs, make_engine = _mk(small_dataset, tmp_path)
+    part = txs.slice(slice(0, 1024))
+    ckpt = Checkpointer(str(tmp_path / "ck2"))
+    src = FlakySource(ReplaySource(part, EPOCH0, batch_rows=256),
+                      fail_at=(0, 1, 2, 3, 4, 5, 6, 7, 8))
+    with pytest.raises(TransientError):
+        run_with_recovery(make_engine, src, ckpt, max_restarts=2)
