@@ -160,6 +160,10 @@ def cmd_score(args) -> int:
     )
     ckpt = Checkpointer(args.checkpoint_dir) if args.checkpoint_dir else None
     sink = ParquetSink(args.out) if args.out else None
+    if args.max_restarts > 0 and ckpt is None:
+        log.error("--max-restarts requires --checkpoint-dir "
+                  "(there is nothing to recover from without checkpoints)")
+        return 2
     if ckpt is not None and args.max_restarts > 0:
         # Supervised mode: restart-on-failure with checkpoint replay
         # (the compose `restart: on-failure` + Spark checkpoint contract).
@@ -170,6 +174,7 @@ def cmd_score(args) -> int:
         stats = run_with_recovery(
             make_engine, source, ckpt, sink=sink,
             max_restarts=args.max_restarts, max_batches=args.max_batches,
+            resume=args.resume,
         )
     else:
         engine = make_engine()

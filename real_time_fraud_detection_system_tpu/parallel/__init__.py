@@ -6,3 +6,9 @@ from real_time_fraud_detection_system_tpu.parallel.step import (  # noqa: F401
     make_sharded_step,
     partition_batch_by_customer,
 )
+from real_time_fraud_detection_system_tpu.parallel.distributed import (  # noqa: F401
+    initialize_distributed,
+    make_hybrid_mesh,
+    mesh_axes,
+    process_local_batch_slice,
+)

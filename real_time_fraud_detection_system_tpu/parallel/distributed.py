@@ -1,0 +1,132 @@
+"""Multi-host distributed runtime: process init + hybrid DCN×ICI meshes.
+
+The reference's "distributed backend" is Kafka + Py4J + Arrow + HTTP on one
+host (SURVEY §5.8) — there is no NCCL/MPI to port. The TPU-native fabric is:
+
+- **DCN** (data-center network) between hosts: carries Kafka consumer
+  traffic in, and the outer mesh axis of cross-host collectives;
+- **ICI** (inter-chip interconnect) within a pod slice: carries the in-step
+  collectives (``all_to_all`` terminal routing, ``psum`` gradient sync).
+
+:func:`initialize_distributed` wraps ``jax.distributed.initialize`` with
+env-var autodetection (a no-op single-process). :func:`make_hybrid_mesh`
+builds the 2-axis ``(dcn, ici)`` mesh — via
+``mesh_utils.create_hybrid_device_mesh`` on real multi-host TPU, or by
+reshaping visible devices single-process (virtual-CPU testing). The sharded
+step (:func:`..parallel.step.make_sharded_step`) accepts the axis pair
+``("dcn", "ici")`` directly: batch rows shard over the flattened super-axis
+and the collectives ride the ICI fast path within a host, DCN across.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AxisName = Union[str, Tuple[str, ...]]
+
+_INITIALIZED = False
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize multi-process JAX if configured; returns True if active.
+
+    Resolution order: explicit args → standard env vars
+    (``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID`` —
+    on Cloud TPU ``jax.distributed.initialize()`` autodetects from metadata
+    instead). Single-process (nothing configured) is a no-op returning
+    False, so the same binary runs a laptop test and a pod.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return True
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if coordinator_address is None and num_processes is None:
+        return False  # single-process mode
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _INITIALIZED = True
+    return True
+
+
+def make_hybrid_mesh(
+    n_hosts: int = 0,
+    devices_per_host: int = 0,
+    dcn_axis: str = "dcn",
+    ici_axis: str = "ici",
+) -> Mesh:
+    """2-axis ``(dcn, ici)`` mesh: hosts × local devices.
+
+    Multi-process: uses ``mesh_utils.create_hybrid_device_mesh`` so the
+    outer axis crosses slices over DCN and the inner axis stays on ICI.
+    Single-process (tests, virtual CPU devices): reshapes the visible
+    devices row-major into [n_hosts, devices_per_host] — collective
+    semantics are identical, only the physical network differs.
+    """
+    devs = jax.devices()
+    n_proc = jax.process_count()
+    if n_proc > 1:
+        from jax.experimental import mesh_utils
+
+        per_host = devices_per_host or jax.local_device_count()
+        hosts = n_hosts or n_proc
+        mesh_devs = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(1, per_host),
+            dcn_mesh_shape=(hosts, 1),
+        )
+        return Mesh(mesh_devs, (dcn_axis, ici_axis))
+    # Single process: emulate the host split.
+    if n_hosts == 0 and devices_per_host == 0:
+        n_hosts = 2 if len(devs) % 2 == 0 and len(devs) > 1 else 1
+    if n_hosts == 0:
+        n_hosts = len(devs) // devices_per_host
+    if devices_per_host == 0:
+        devices_per_host = len(devs) // n_hosts
+    need = n_hosts * devices_per_host
+    if need == 0 or need > len(devs):
+        raise ValueError(
+            f"mesh {n_hosts}x{devices_per_host} needs {need or 'at least 1'}"
+            f" device(s), {len(devs)} visible"
+        )
+    grid = np.asarray(devs[:need]).reshape(n_hosts, devices_per_host)
+    return Mesh(grid, (dcn_axis, ici_axis))
+
+
+def mesh_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """The mesh's axis-name tuple, in collective-flattening order — pass
+    this as ``axis=`` to :func:`..parallel.step.make_sharded_step` (a 1-axis
+    mesh yields a 1-tuple, which the step treats like the plain name)."""
+    return tuple(mesh.axis_names)
+
+
+def process_local_batch_slice(
+    n_rows_global: int, mesh: Mesh
+) -> slice:
+    """Which rows of the globally-partitioned batch this process feeds.
+
+    With rows laid out [n_dev_total × rows_per_shard] (see
+    ``partition_batch_by_customer``), each host's Kafka consumers need only
+    its own devices' row range — DCN never carries another host's rows.
+    """
+    n_dev = mesh.devices.size
+    per = n_rows_global // n_dev
+    local = jax.local_device_count()
+    start = jax.process_index() * local * per
+    return slice(start, start + local * per)

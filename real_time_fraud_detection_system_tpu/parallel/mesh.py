@@ -45,9 +45,12 @@ def make_mesh(n_devices: int = 0, axis: str = "data") -> Mesh:
 
 
 def shard_feature_state(
-    state: FeatureState, mesh: Mesh, axis: str = "data"
+    state: FeatureState, mesh: Mesh, axis: "str | tuple[str, ...]" = "data"
 ) -> FeatureState:
-    """Place window tables sharded along the slot axis, CMS replicated."""
+    """Place window tables sharded along the slot axis, CMS replicated.
+
+    ``axis`` may be one mesh axis name or a tuple (hybrid DCN×ICI meshes,
+    see :mod:`.distributed`)."""
     row_sharded = NamedSharding(mesh, P(axis, None))
     repl = NamedSharding(mesh, P())
 

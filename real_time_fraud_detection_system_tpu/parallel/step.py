@@ -105,12 +105,17 @@ def make_sharded_step(
     loss_fn: Optional[Callable] = None,
     online_lr: float = 0.0,
     mesh: Optional[Mesh] = None,
-    axis: str = "data",
+    axis: "str | Tuple[str, ...]" = "data",
 ):
     """Build the jitted multi-chip step.
 
     step(feature_state, params, scaler, batch) -> (feature_state, params,
     probs, features); batch leaves are [n_dev*B_local] sharded on axis 0.
+
+    ``axis`` may be a single mesh axis name or a tuple of names (e.g.
+    ``("dcn", "ici")`` from :func:`.distributed.make_hybrid_mesh`): rows
+    shard over the flattened super-axis and every collective runs over the
+    pair — cross-host hops ride DCN, intra-host ICI.
     """
     assert mesh is not None
     n_dev = mesh.devices.size

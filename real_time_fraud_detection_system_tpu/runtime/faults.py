@@ -154,6 +154,10 @@ def run_with_recovery(
     max_restarts: int = 3,
     max_batches: int = 0,
     heartbeat: Optional[Heartbeat] = None,
+    resume: bool = True,
+    recover_on: Tuple[Type[BaseException], ...] = (
+        TransientError, OSError, ConnectionError,
+    ),
 ) -> dict:
     """Supervisor loop: run → on crash, restore last checkpoint and resume.
 
@@ -165,6 +169,12 @@ def run_with_recovery(
 
     The sink must tolerate replayed batches (idempotent append by tx_id or
     latest-wins MERGE downstream, as in the reference's MERGE INTO).
+
+    ``resume=False`` ignores any pre-existing checkpoint for the FIRST
+    incarnation (a fresh pass over the stream); crash incarnations always
+    restore — that is the whole point. ``recover_on`` lists the exception
+    types treated as recoverable; anything else propagates immediately
+    (engine bugs should crash loudly, not restart-loop).
     """
     restarts = 0
     initial_offsets = list(source.offsets)
@@ -180,7 +190,9 @@ def run_with_recovery(
         sink = _BeatSink()
     while True:
         engine = make_engine()
-        restored = checkpointer.restore(engine.state)
+        restored = None
+        if resume or restarts > 0:
+            restored = checkpointer.restore(engine.state)
         if restored is not None:
             source.seek(engine.state.offsets)
             log.info("restored checkpoint at batch %d",
@@ -199,7 +211,7 @@ def run_with_recovery(
             checkpointer.save(engine.state)
             stats["restarts"] = restarts
             return stats
-        except TransientError as e:
+        except recover_on as e:
             restarts += 1
             log.warning("engine crashed (%s); restart %d/%d",
                         e, restarts, max_restarts)

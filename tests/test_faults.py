@@ -205,6 +205,68 @@ def test_recovery_crash_before_first_checkpoint(small_dataset, tmp_path):
                                clean["prediction"][b], rtol=1e-5)
 
 
+def test_recovery_rerun_fresh_with_resume_false(small_dataset, tmp_path):
+    """A second supervised run with resume=False must re-score the stream
+    instead of silently resuming past the end of it."""
+    cfg, txs, make_engine = _mk(small_dataset, tmp_path)
+    part = txs.slice(slice(0, 512))
+    ckpt = Checkpointer(str(tmp_path / "ck4"))
+
+    s1 = MemorySink()
+    run_with_recovery(make_engine,
+                      ReplaySource(part, EPOCH0, batch_rows=256),
+                      ckpt, sink=s1, max_restarts=1)
+    assert len(s1.concat()["tx_id"]) == 512
+
+    # resume=True (default): continues from the end-of-stream checkpoint.
+    s2 = MemorySink()
+    stats = run_with_recovery(make_engine,
+                              ReplaySource(part, EPOCH0, batch_rows=256),
+                              ckpt, sink=s2, max_restarts=1)
+    assert s2.concat() == {}
+
+    # resume=False: fresh pass, full output again.
+    s3 = MemorySink()
+    run_with_recovery(make_engine,
+                      ReplaySource(part, EPOCH0, batch_rows=256),
+                      ckpt, sink=s3, max_restarts=1, resume=False)
+    assert len(s3.concat()["tx_id"]) == 512
+
+
+def test_recovery_catches_oserror(small_dataset, tmp_path):
+    """Real-world transient faults (OSError family) are supervised too."""
+    cfg, txs, make_engine = _mk(small_dataset, tmp_path)
+    part = txs.slice(slice(0, 512))
+
+    class OsFlaky:
+        def __init__(self, inner):
+            self.inner = inner
+            self._polls = 0
+
+        def poll_batch(self):
+            self._polls += 1
+            if self._polls == 2:
+                raise ConnectionResetError("broker hiccup")
+            return self.inner.poll_batch()
+
+        @property
+        def offsets(self):
+            return self.inner.offsets
+
+        def seek(self, offsets):
+            self.inner.seek(offsets)
+
+    ckpt = Checkpointer(str(tmp_path / "ck5"))
+    sink = MemorySink()
+    stats = run_with_recovery(
+        make_engine, OsFlaky(ReplaySource(part, EPOCH0, batch_rows=256)),
+        ckpt, sink=sink, max_restarts=2,
+    )
+    assert stats["restarts"] == 1
+    out = sink.concat()
+    assert len(np.unique(out["tx_id"])) == 512
+
+
 def test_run_with_recovery_gives_up(small_dataset, tmp_path):
     cfg, txs, make_engine = _mk(small_dataset, tmp_path)
     part = txs.slice(slice(0, 1024))
