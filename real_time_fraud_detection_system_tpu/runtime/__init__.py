@@ -19,3 +19,10 @@ from real_time_fraud_detection_system_tpu.runtime.faults import (  # noqa: F401
 from real_time_fraud_detection_system_tpu.runtime.pipeline import (  # noqa: F401
     run_demo,
 )
+from real_time_fraud_detection_system_tpu.runtime.feedback import (  # noqa: F401
+    FEEDBACK_TOPIC,
+    FeatureCache,
+    FeedbackLoop,
+    decode_feedback_envelopes,
+    encode_feedback_envelopes,
+)

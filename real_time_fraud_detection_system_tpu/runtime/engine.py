@@ -133,12 +133,17 @@ class ScoringEngine:
         scorer: Optional[str] = None,
         cpu_model=None,
         online_lr: float = 0.0,
+        feature_cache=None,
     ):
         self.cfg = cfg
         self.kind = kind
         self.scorer = scorer or cfg.runtime.scorer
         self.cpu_model = cpu_model
         self.online_lr = online_lr
+        # Optional runtime.feedback.FeatureCache: every scored row's raw
+        # feature vector is cached for the labeled-feedback join.
+        self.feature_cache = feature_cache
+        self._feedback_step = None
         # Depth-bounded tree ensembles score ~100× faster on TPU in the GEMM
         # form (see models/forest.py::predict_proba); convert once at build.
         if kind in ("tree", "forest") and isinstance(params, TreeEnsemble):
@@ -209,6 +214,8 @@ class ScoringEngine:
         self.state.params = params
 
         feats_np = np.asarray(feats)[:n]
+        if self.feature_cache is not None and n:
+            self.feature_cache.put_batch(cols["tx_id"], feats_np)
         if self.scorer == "cpu":
             # parity/baseline oracle: host-side pipeline on the same features
             # (sklearn pipeline, or a TrainedModel's pure-NumPy path)
@@ -229,6 +236,49 @@ class ScoringEngine:
             features=feats_np,
             probs=probs_np,
             latency_s=time.perf_counter() - t0,
+        )
+
+    def apply_feedback(self, features: np.ndarray, labels: np.ndarray) -> None:
+        """One SGD step from delayed labels (the feedback-topic path,
+        BASELINE.json config 4; see ``runtime/feedback.py``).
+
+        ``features`` are RAW feature rows (as cached by the scorer);
+        scaling happens inside the jitted update with the engine's scaler,
+        so the gradient is on exactly the serving representation.
+        """
+        if self._loss is None:
+            raise ValueError(
+                f"model kind {self.kind!r} has no gradient path for "
+                "feedback updates"
+            )
+        lr = self.online_lr or self.cfg.train.online_learning_rate
+        if self._feedback_step is None:
+            loss = self._loss
+
+            def fb(params, scaler, x_raw, y, valid, lr):
+                x = transform(scaler, x_raw)
+                g = jax.grad(loss)(params, x, y, valid)
+                return jax.tree.map(lambda p, gi: p - lr * gi, params, g)
+
+            self._feedback_step = jax.jit(fb)
+        n = len(labels)
+        if n == 0:
+            return
+        pad = bucket_size(n, self.cfg.runtime.batch_buckets)
+        x = np.zeros((pad, features.shape[1]), dtype=np.float32)
+        x[:n] = features
+        y = np.zeros(pad, dtype=np.int32)
+        y[:n] = np.maximum(labels, 0)
+        valid = np.zeros(pad, dtype=bool)
+        # label < 0 is the 'unlabeled' sentinel everywhere in this codebase
+        # (engine step masks it the same way) — never train on it.
+        valid[:n] = np.asarray(labels) >= 0
+        if not valid.any():
+            return
+        self.state.params = self._feedback_step(
+            self.state.params, self.state.scaler,
+            jnp.asarray(x), jnp.asarray(y), jnp.asarray(valid),
+            jnp.float32(lr),
         )
 
     def run(

@@ -1,0 +1,156 @@
+"""Labeled-feedback topic → online-SGD updates (BASELINE.json config 4).
+
+In production, fraud labels arrive days after the transaction (chargebacks,
+investigations) on their own stream — the reference models this delay in the
+offline features (7-day terminal-risk shift,
+``feature_transformation.ipynb · cell 25``) but has no online learning at
+all (its only live successor is the dormant torch training loop,
+``shared_functions.py:1312-1707``). Here the loop is closed:
+
+1. the :class:`~.engine.ScoringEngine` caches each scored row's feature
+   vector in a bounded :class:`FeatureCache` (tx_id → float32[15]);
+2. label events ``{tx_id, label}`` arrive on a ``payment.feedback`` topic
+   (:func:`encode_feedback_envelopes` / :func:`decode_feedback_envelopes`);
+3. :class:`FeedbackLoop` polls the topic, joins labels to cached features,
+   and applies one jitted SGD step per poll via
+   :meth:`~.engine.ScoringEngine.apply_feedback` — gradients on the SAME
+   loss the in-band online path uses, padded to fixed buckets to keep the
+   jit cache warm.
+
+The join is by tx_id, so feedback ordering/duplication is harmless: a
+duplicate label simply contributes another (identical) gradient term.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, List, Sequence, Tuple
+
+import numpy as np
+
+from real_time_fraud_detection_system_tpu.features.spec import N_FEATURES
+from real_time_fraud_detection_system_tpu.utils.logging import get_logger
+
+log = get_logger("feedback")
+
+FEEDBACK_TOPIC = "payment.feedback"
+
+
+def encode_feedback_envelopes(
+    tx_ids: Sequence[int],
+    labels: Sequence[int],
+    ts_ms: int = 0,
+) -> List[bytes]:
+    """Label events as minimal JSON envelopes (no Debezium wrapper: the
+    feedback stream is app-produced, not CDC)."""
+    return [
+        json.dumps(
+            {"tx_id": int(t), "label": int(y), "ts_ms": int(ts_ms)},
+            separators=(",", ":"),
+        ).encode()
+        for t, y in zip(tx_ids, labels)
+    ]
+
+
+def decode_feedback_envelopes(
+    messages: Iterable[bytes],
+) -> Tuple[np.ndarray, np.ndarray]:
+    """→ (tx_ids int64 [n], labels int32 [n]); malformed events dropped."""
+    ids: List[int] = []
+    ys: List[int] = []
+    for m in messages:
+        try:
+            d = json.loads(m)
+            ids.append(int(d["tx_id"]))
+            ys.append(int(d["label"]))
+        except (ValueError, KeyError, TypeError):
+            continue
+    return (np.asarray(ids, dtype=np.int64),
+            np.asarray(ys, dtype=np.int32))
+
+
+class FeatureCache:
+    """Bounded tx_id → feature-row cache, direct-mapped (slot = tx_id mod
+    capacity), fully vectorized — zero Python-per-row cost on the scoring
+    hot path.
+
+    The scorer inserts every row it scores; the feedback join looks rows up
+    when their labels arrive. Capacity bounds host memory (default 1M rows
+    × 15 f32 ≈ 60 MB). A colliding insert evicts the previous occupant —
+    with the generator's sequential tx_ids that is exactly a sliding window
+    of the most recent ``capacity`` transactions; evicted rows miss and the
+    loop skips their labels (too old to learn from cheaply).
+    """
+
+    def __init__(self, capacity: int = 1_000_000,
+                 n_features: int = N_FEATURES):
+        self.capacity = int(capacity)
+        self._feat = np.zeros((self.capacity, n_features), dtype=np.float32)
+        self._ids = np.full(self.capacity, -1, dtype=np.int64)
+
+    def __len__(self) -> int:
+        return int((self._ids >= 0).sum())
+
+    def put_batch(self, tx_ids: np.ndarray, features: np.ndarray) -> None:
+        tx_ids = np.asarray(tx_ids, dtype=np.int64)
+        slots = tx_ids % self.capacity
+        self._ids[slots] = tx_ids
+        self._feat[slots] = features
+
+    def get_batch(
+        self, tx_ids: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """→ (features [m, F], hit_mask [n]) for the cached subset."""
+        tx_ids = np.asarray(tx_ids, dtype=np.int64)
+        slots = tx_ids % self.capacity
+        # tx_ids < 0 would alias the empty-slot sentinel: always a miss.
+        hit = (self._ids[slots] == tx_ids) & (tx_ids >= 0)
+        return self._feat[slots[hit]], hit
+
+
+class FeedbackLoop:
+    """Polls the feedback topic and applies SGD updates to the engine.
+
+    One instance per engine; call :meth:`poll_and_apply` from the host
+    loop, BETWEEN micro-batches. The engine's state is not synchronized —
+    calling from another thread races with ``process_batch``'s
+    read-modify-write of ``state.params`` and can silently drop updates.
+
+    ``cache`` defaults to the engine's own ``feature_cache``.
+    """
+
+    def __init__(self, engine, broker, cache: FeatureCache = None,
+                 topic: str = FEEDBACK_TOPIC, max_events: int = 65536):
+        self.engine = engine
+        self.broker = broker
+        self.cache = cache if cache is not None else engine.feature_cache
+        if self.cache is None:
+            raise ValueError(
+                "FeedbackLoop needs a FeatureCache: pass one here or "
+                "construct the engine with feature_cache="
+            )
+        self.topic = topic
+        self.max_events = max_events
+        self._offsets = [0] * broker.n_partitions
+        self.stats = {"events": 0, "applied": 0, "missed": 0}
+
+    def poll_and_apply(self) -> int:
+        """Drain available label events; returns number of rows learned."""
+        msgs: List[bytes] = []
+        for p in range(self.broker.n_partitions):
+            recs = self.broker.poll(self.topic, p, self._offsets[p],
+                                    self.max_events)
+            self._offsets[p] += len(recs)
+            msgs += [r.value for r in recs]
+        if not msgs:
+            return 0
+        tx_ids, labels = decode_feedback_envelopes(msgs)
+        feats, hit = self.cache.get_batch(tx_ids)
+        n_hit = int(hit.sum())
+        self.stats["events"] += len(tx_ids)
+        self.stats["missed"] += len(tx_ids) - n_hit
+        if n_hit == 0:
+            return 0
+        self.engine.apply_feedback(feats, labels[hit])
+        self.stats["applied"] += n_hit
+        return n_hit
