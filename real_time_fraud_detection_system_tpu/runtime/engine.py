@@ -261,25 +261,32 @@ class ScoringEngine:
                 return jax.tree.map(lambda p, gi: p - lr * gi, params, g)
 
             self._feedback_step = jax.jit(fb)
-        n = len(labels)
-        if n == 0:
+        labels = np.asarray(labels)
+        total = len(labels)
+        if total == 0:
             return
-        pad = bucket_size(n, self.cfg.runtime.batch_buckets)
-        x = np.zeros((pad, features.shape[1]), dtype=np.float32)
-        x[:n] = features
-        y = np.zeros(pad, dtype=np.int32)
-        y[:n] = np.maximum(labels, 0)
-        valid = np.zeros(pad, dtype=bool)
-        # label < 0 is the 'unlabeled' sentinel everywhere in this codebase
-        # (engine step masks it the same way) — never train on it.
-        valid[:n] = np.asarray(labels) >= 0
-        if not valid.any():
-            return
-        self.state.params = self._feedback_step(
-            self.state.params, self.state.scaler,
-            jnp.asarray(x), jnp.asarray(y), jnp.asarray(valid),
-            jnp.float32(lr),
-        )
+        # A label backlog can exceed the largest jit bucket: chunk it.
+        biggest = max(self.cfg.runtime.batch_buckets)
+        for s in range(0, total, biggest):
+            lab = labels[s : s + biggest]
+            n = len(lab)
+            pad = bucket_size(n, self.cfg.runtime.batch_buckets)
+            x = np.zeros((pad, features.shape[1]), dtype=np.float32)
+            x[:n] = features[s : s + n]
+            y = np.zeros(pad, dtype=np.int32)
+            y[:n] = np.maximum(lab, 0)
+            valid = np.zeros(pad, dtype=bool)
+            # label < 0 is the 'unlabeled' sentinel everywhere in this
+            # codebase (engine step masks it the same way) — never train
+            # on it.
+            valid[:n] = lab >= 0
+            if not valid.any():
+                continue
+            self.state.params = self._feedback_step(
+                self.state.params, self.state.scaler,
+                jnp.asarray(x), jnp.asarray(y), jnp.asarray(valid),
+                jnp.float32(lr),
+            )
 
     def run(
         self,

@@ -61,10 +61,13 @@ def decode_feedback_envelopes(
     for m in messages:
         try:
             d = json.loads(m)
-            ids.append(int(d["tx_id"]))
-            ys.append(int(d["label"]))
+            # Parse BOTH fields before appending either, or a message with
+            # a valid tx_id but bad label would misalign the two lists.
+            t, y = int(d["tx_id"]), int(d["label"])
         except (ValueError, KeyError, TypeError):
             continue
+        ids.append(t)
+        ys.append(y)
     return (np.asarray(ids, dtype=np.int64),
             np.asarray(ys, dtype=np.int32))
 
@@ -151,6 +154,8 @@ class FeedbackLoop:
         self.stats["missed"] += len(tx_ids) - n_hit
         if n_hit == 0:
             return 0
-        self.engine.apply_feedback(feats, labels[hit])
-        self.stats["applied"] += n_hit
-        return n_hit
+        y = labels[hit]
+        n_labeled = int((y >= 0).sum())  # -1 = pending, masked by the step
+        self.engine.apply_feedback(feats, y)
+        self.stats["applied"] += n_labeled
+        return n_labeled

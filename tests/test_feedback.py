@@ -34,7 +34,9 @@ EPOCH0 = 1_743_465_600
 
 def test_feedback_envelope_roundtrip():
     msgs = encode_feedback_envelopes([5, 9], [1, 0], ts_ms=42)
-    ids, ys = decode_feedback_envelopes(msgs + [b"garbage", b"{}"])
+    # valid tx_id with missing label must NOT misalign the two arrays
+    bad = [b"garbage", b"{}", b'{"tx_id": 7}', b'{"label": 1}']
+    ids, ys = decode_feedback_envelopes(msgs[:1] + bad + msgs[1:])
     np.testing.assert_array_equal(ids, [5, 9])
     np.testing.assert_array_equal(ys, [1, 0])
 
@@ -135,6 +137,33 @@ def test_feedback_loop_requires_cache():
     engine, _ = _engine(cache=None)
     with pytest.raises(ValueError, match="FeatureCache"):
         FeedbackLoop(engine, InProcBroker(2))
+
+
+def test_apply_feedback_chunked_backlog():
+    """A label backlog larger than the biggest jit bucket is chunked, and
+    all of it contributes gradient."""
+    engine, cfg = _engine()
+    biggest = max(cfg.runtime.batch_buckets)
+    n = biggest + 123
+    w0 = np.asarray(engine.state.params.w).copy()
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (n, 15)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    engine.apply_feedback(x, y)  # must not raise broadcast errors
+    assert not np.allclose(w0, np.asarray(engine.state.params.w))
+
+
+def test_poll_and_apply_counts_only_labeled():
+    cache = FeatureCache(capacity=64)
+    engine, _ = _engine(cache)
+    cache.put_batch(np.array([1, 2]), np.ones((2, 15), np.float32))
+    broker = InProcBroker(2)
+    msgs = encode_feedback_envelopes([1, 2], [-1, -1])  # both pending
+    broker.produce_many(FEEDBACK_TOPIC, [b"a", b"b"], msgs)
+    loop = FeedbackLoop(engine, broker)
+    assert loop.poll_and_apply() == 0
+    assert loop.stats["applied"] == 0
+    assert loop.stats["missed"] == 0
 
 
 def test_apply_feedback_masks_unlabeled():
