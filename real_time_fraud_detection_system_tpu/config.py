@@ -73,9 +73,26 @@ class FeatureConfig:
     # Count-min sketch for unbounded key cardinality (velocity features).
     cms_depth: int = 4
     cms_width: int = 1 << 15
+    # Where customer velocity features come from: "table" = exact dense
+    # window state (keys must fit customer_capacity); "cms" = the count-min
+    # sketch (BASELINE config 3) — bounded memory for billions of cards,
+    # overestimate-only error. Terminal risk always uses the table (the
+    # sketch holds no fraud sums).
+    customer_source: str = "table"
     # Canonical flag definitions (see module docstring).
     night_end_hour: int = 6
     weekend_start_weekday: int = 5  # Monday == 0
+
+    def __post_init__(self):
+        if self.customer_source not in ("table", "cms"):
+            raise ValueError(
+                f"customer_source must be 'table' or 'cms', "
+                f"got {self.customer_source!r}"
+            )
+        if self.key_mode not in ("direct", "hash"):
+            raise ValueError(
+                f"key_mode must be 'direct' or 'hash', got {self.key_mode!r}"
+            )
 
 
 @dataclass(frozen=True)

@@ -41,7 +41,11 @@ class FeatureState(NamedTuple):
     cms: Optional[CountMinSketch]
 
 
-def init_feature_state(cfg: FeatureConfig, with_cms: bool = False) -> FeatureState:
+def init_feature_state(
+    cfg: FeatureConfig, with_cms: Optional[bool] = None
+) -> FeatureState:
+    if with_cms is None:
+        with_cms = cfg.customer_source == "cms"
     return FeatureState(
         customer=init_window_state(cfg.customer_capacity, cfg.n_day_buckets),
         terminal=init_window_state(cfg.terminal_capacity, cfg.n_day_buckets),
@@ -83,9 +87,13 @@ def _update_state(
     cust_slot = _slot(batch.customer_key, cfg.customer_capacity, cfg.key_mode)
     term_slot = _slot(batch.terminal_key, cfg.terminal_capacity, cfg.key_mode)
     fraud = jnp.maximum(batch.label, 0).astype(jnp.float32)
-    customer = update_windows(
-        state.customer, cust_slot, batch.day, batch.amount, fraud, batch.valid
-    )
+    if cfg.customer_source == "cms":
+        customer = state.customer  # unused in cms mode: skip the scatter
+    else:
+        customer = update_windows(
+            state.customer, cust_slot, batch.day, batch.amount, fraud,
+            batch.valid,
+        )
     terminal = update_windows(
         state.terminal, term_slot, batch.day, batch.amount, fraud, batch.valid
     )
@@ -111,7 +119,21 @@ def update_and_featurize(
     state, cust_slot, term_slot = _update_state(state, batch, cfg)
     customer, terminal = state.customer, state.terminal
 
-    c_count, c_amount, _ = query_windows(customer, cust_slot, batch.day, windows)
+    if cfg.customer_source == "cms":
+        if state.cms is None:
+            raise ValueError(
+                "customer_source='cms' but the feature state has no sketch "
+                "(init_feature_state must be built from the same config)"
+            )
+        from real_time_fraud_detection_system_tpu.ops.cms import cms_query
+
+        c_count, c_amount = cms_query(
+            state.cms, batch.customer_key, batch.day, windows
+        )
+    else:
+        c_count, c_amount, _ = query_windows(
+            customer, cust_slot, batch.day, windows
+        )
     t_count, _, t_fraud = query_windows(
         terminal, term_slot, batch.day, windows, delay=cfg.delay_days
     )

@@ -118,6 +118,11 @@ def make_sharded_step(
     pair — cross-host hops ride DCN, intra-host ICI.
     """
     assert mesh is not None
+    if cfg.features.customer_source != "table":
+        raise NotImplementedError(
+            "sharded step serves customer windows from the sharded dense "
+            "table; customer_source='cms' is single-chip only for now"
+        )
     n_dev = mesh.devices.size
     fcfg = cfg.features
     windows = tuple(fcfg.windows)

@@ -144,6 +144,7 @@ class ScoringEngine:
         # feature vector is cached for the labeled-feedback join.
         self.feature_cache = feature_cache
         self._feedback_step = None
+        self._state_feedback_step = None
         # Depth-bounded tree ensembles score ~100× faster on TPU in the GEMM
         # form (see models/forest.py::predict_proba); convert once at build.
         if kind in ("tree", "forest") and isinstance(params, TreeEnsemble):
@@ -163,7 +164,11 @@ class ScoringEngine:
         self._loss = loss_fn_for(kind)
         fcfg = cfg.features
 
-        use_pallas = cfg.runtime.use_pallas and kind == "logreg"
+        use_pallas = (
+            cfg.runtime.use_pallas
+            and kind == "logreg"
+            and cfg.features.customer_source == "table"
+        )
 
         def step(fstate: FeatureState, params, scaler: Scaler, batch: TxBatch):
             if use_pallas:
@@ -215,7 +220,20 @@ class ScoringEngine:
 
         feats_np = np.asarray(feats)[:n]
         if self.feature_cache is not None and n:
-            self.feature_cache.put_batch(cols["tx_id"], feats_np)
+            from real_time_fraud_detection_system_tpu.core.batch import (
+                US_PER_DAY,
+            )
+
+            in_band = cols.get("label")
+            self.feature_cache.put_batch(
+                cols["tx_id"], feats_np,
+                terminal_ids=cols["terminal_id"],
+                days=(cols["tx_datetime_us"] // US_PER_DAY).astype(np.int32),
+                # In-band labels were already scattered into the risk state
+                # by the step; mark them so feedback events can't re-land.
+                labeled=(np.asarray(in_band) >= 0)
+                if in_band is not None else None,
+            )
         if self.scorer == "cpu":
             # parity/baseline oracle: host-side pipeline on the same features
             # (sklearn pipeline, or a TrainedModel's pure-NumPy path)
@@ -237,6 +255,64 @@ class ScoringEngine:
             probs=probs_np,
             latency_s=time.perf_counter() - t0,
         )
+
+    @property
+    def supports_online_sgd(self) -> bool:
+        """True for model kinds with a gradient path (logreg/mlp/autoencoder)."""
+        return self._loss is not None
+
+    def apply_state_feedback(
+        self,
+        terminal_ids: np.ndarray,
+        days: np.ndarray,
+        labels: np.ndarray,
+    ) -> None:
+        """Land delayed fraud labels in the terminal risk windows.
+
+        The in-state analogue of the reference's delayed terminal-risk
+        computation (``feature_transformation.ipynb · cell 25``): fraud
+        sums of PAST day buckets change; delay-shifted queries pick them
+        up. Model-independent (works for tree kinds too). No-op rows:
+        label < 0 (pending) and buckets whose ring slot has already
+        advanced past the transaction's day.
+        """
+        from real_time_fraud_detection_system_tpu.core.batch import fold_key
+        from real_time_fraud_detection_system_tpu.features.online import (
+            apply_feedback as state_feedback,
+        )
+
+        labels = np.asarray(labels)
+        mask = labels >= 0
+        if not mask.any():
+            return
+        if self._state_feedback_step is None:
+            fcfg = self.cfg.features
+
+            def sf(fstate, term_key, day, label, valid):
+                return state_feedback(
+                    fstate, term_key, day, label, valid, fcfg
+                )
+
+            self._state_feedback_step = jax.jit(sf, donate_argnums=(0,))
+        biggest = max(self.cfg.runtime.batch_buckets)
+        t_ids = np.asarray(terminal_ids)[mask]
+        d = np.asarray(days)[mask]
+        y = labels[mask]
+        for s in range(0, len(y), biggest):
+            n = len(y[s : s + biggest])
+            pad = bucket_size(n, self.cfg.runtime.batch_buckets)
+            tk = np.zeros(pad, dtype=np.uint32)
+            tk[:n] = fold_key(t_ids[s : s + n])
+            dd = np.zeros(pad, dtype=np.int32)
+            dd[:n] = d[s : s + n]
+            yy = np.zeros(pad, dtype=np.int32)
+            yy[:n] = y[s : s + n]
+            valid = np.zeros(pad, dtype=bool)
+            valid[:n] = True
+            self.state.feature_state = self._state_feedback_step(
+                self.state.feature_state, jnp.asarray(tk), jnp.asarray(dd),
+                jnp.asarray(yy), jnp.asarray(valid),
+            )
 
     def apply_feedback(self, features: np.ndarray, labels: np.ndarray) -> None:
         """One SGD step from delayed labels (the feedback-topic path,

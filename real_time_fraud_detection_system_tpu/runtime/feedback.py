@@ -17,8 +17,14 @@ all (its only live successor is the dormant torch training loop,
    loss the in-band online path uses, padded to fixed buckets to keep the
    jit cache warm.
 
-The join is by tx_id, so feedback ordering/duplication is harmless: a
-duplicate label simply contributes another (identical) gradient term.
+The join is by tx_id. Duplicate/replayed label events are safe: the cache
+tracks which cached transactions already had their label landed in the
+risk-window state (``mark_labeled``), so the state update — which is an
+additive scatter and NOT naturally idempotent — runs at most once per cached
+transaction; rows whose label arrived in-band at scoring time are marked at
+insert. Duplicate SGD updates (for rows still cached) are likewise skipped
+with the same mask. Labels for evicted rows always miss, so nothing is ever
+double-counted.
 """
 
 from __future__ import annotations
@@ -90,25 +96,71 @@ class FeatureCache:
         self.capacity = int(capacity)
         self._feat = np.zeros((self.capacity, n_features), dtype=np.float32)
         self._ids = np.full(self.capacity, -1, dtype=np.int64)
+        # Aux columns for state-level feedback (terminal risk windows need
+        # the original transaction's terminal + day, features/online.py::
+        # apply_feedback).
+        self._terminal = np.zeros(self.capacity, dtype=np.int64)
+        self._day = np.zeros(self.capacity, dtype=np.int32)
+        # True once this transaction's label has been landed in the risk
+        # state (either in-band at scoring time or via a feedback event) —
+        # the idempotence guard for the additive state scatter.
+        self._labeled = np.zeros(self.capacity, dtype=bool)
 
     def __len__(self) -> int:
         return int((self._ids >= 0).sum())
 
-    def put_batch(self, tx_ids: np.ndarray, features: np.ndarray) -> None:
+    def put_batch(
+        self,
+        tx_ids: np.ndarray,
+        features: np.ndarray,
+        terminal_ids: np.ndarray = None,
+        days: np.ndarray = None,
+        labeled: np.ndarray = None,
+    ) -> None:
+        """Insert scored rows. ``labeled`` marks rows whose label was known
+        in-band at scoring time (already scattered into the risk state).
+        Aux columns are always (over)written so an evicting insert can
+        never leave the previous occupant's terminal/day bound to the new
+        tx_id."""
         tx_ids = np.asarray(tx_ids, dtype=np.int64)
+        n = len(tx_ids)
         slots = tx_ids % self.capacity
         self._ids[slots] = tx_ids
         self._feat[slots] = features
+        self._terminal[slots] = (
+            np.zeros(n, np.int64) if terminal_ids is None else terminal_ids
+        )
+        self._day[slots] = np.zeros(n, np.int32) if days is None else days
+        self._labeled[slots] = (
+            np.zeros(n, bool) if labeled is None else labeled
+        )
+
+    def mark_labeled(self, tx_ids: np.ndarray) -> None:
+        """Record that these transactions' labels reached the risk state."""
+        tx_ids = np.asarray(tx_ids, dtype=np.int64)
+        slots = tx_ids % self.capacity
+        own = (self._ids[slots] == tx_ids) & (tx_ids >= 0)
+        self._labeled[slots[own]] = True
 
     def get_batch(
         self, tx_ids: np.ndarray
     ) -> Tuple[np.ndarray, np.ndarray]:
         """→ (features [m, F], hit_mask [n]) for the cached subset."""
+        feats, _, _, hit, _ = self.get_batch_full(tx_ids)
+        return feats, hit
+
+    def get_batch_full(
+        self, tx_ids: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """→ (features [m, F], terminal_ids [m], days [m], hit_mask [n],
+        already_labeled [m])."""
         tx_ids = np.asarray(tx_ids, dtype=np.int64)
         slots = tx_ids % self.capacity
         # tx_ids < 0 would alias the empty-slot sentinel: always a miss.
         hit = (self._ids[slots] == tx_ids) & (tx_ids >= 0)
-        return self._feat[slots[hit]], hit
+        sel = slots[hit]
+        return (self._feat[sel], self._terminal[sel], self._day[sel], hit,
+                self._labeled[sel])
 
 
 class FeedbackLoop:
@@ -148,14 +200,29 @@ class FeedbackLoop:
         if not msgs:
             return 0
         tx_ids, labels = decode_feedback_envelopes(msgs)
-        feats, hit = self.cache.get_batch(tx_ids)
+        feats, term_ids, days, hit, done = self.cache.get_batch_full(tx_ids)
         n_hit = int(hit.sum())
         self.stats["events"] += len(tx_ids)
         self.stats["missed"] += len(tx_ids) - n_hit
         if n_hit == 0:
             return 0
-        y = labels[hit]
-        n_labeled = int((y >= 0).sum())  # -1 = pending, masked by the step
-        self.engine.apply_feedback(feats, y)
+        # Idempotence: rows whose label already reached the state (in-band
+        # at scoring time, or an earlier feedback event) are skipped — the
+        # state scatter is additive and must run at most once per tx.
+        fresh = (labels[hit] >= 0) & ~done
+        if not fresh.any():
+            return 0
+        y = labels[hit][fresh]
+        # 1) state update: land the fraud labels in the terminal risk
+        #    windows (delay-shifted queries will see them, matching the
+        #    reference's delayed-risk semantics). Works for EVERY model
+        #    kind — risk features are model-independent.
+        self.engine.apply_state_feedback(term_ids[fresh], days[fresh], y)
+        # 2) model update (SGD on the cached serving features), only for
+        #    differentiable kinds — tree ensembles learn via retraining.
+        if self.engine.supports_online_sgd:
+            self.engine.apply_feedback(feats[fresh], y)
+        self.cache.mark_labeled(tx_ids[hit][fresh])
+        n_labeled = int(len(y))
         self.stats["applied"] += n_labeled
         return n_labeled

@@ -180,6 +180,141 @@ def test_apply_feedback_masks_unlabeled():
     assert not np.allclose(w0, np.asarray(engine.state.params.w))
 
 
+def test_state_feedback_raises_terminal_risk():
+    """A delayed fraud label must flow into the terminal risk windows:
+    later transactions at that terminal (past the label delay) see risk>0."""
+    from real_time_fraud_detection_system_tpu.core.batch import US_PER_DAY
+
+    cache = FeatureCache(capacity=1 << 10)
+    engine, cfg = _engine(cache)
+    delay = cfg.features.delay_days
+    day0 = 20200
+
+    def cols_for(day, tx0):
+        n = 4
+        return {
+            "tx_id": np.arange(tx0, tx0 + n, dtype=np.int64),
+            "tx_datetime_us": np.full(n, day, np.int64) * US_PER_DAY + 1,
+            "customer_id": np.arange(n, dtype=np.int64),
+            "terminal_id": np.full(n, 7, dtype=np.int64),
+            "tx_amount_cents": np.full(n, 1000, dtype=np.int64),
+            "kafka_ts_ms": np.zeros(n, dtype=np.int64),
+        }
+
+    engine.process_batch(cols_for(day0, 0))
+    # Label tx 0..3 as fraud via the feedback topic.
+    broker = InProcBroker(2)
+    broker.produce_many(
+        FEEDBACK_TOPIC, [b""] * 4,
+        encode_feedback_envelopes(np.arange(4), np.ones(4, np.int64)),
+    )
+    FeedbackLoop(engine, broker).poll_and_apply()
+    # Score the same terminal past the delay: risk features must be > 0.
+    res = engine.process_batch(cols_for(day0 + delay + 1, 100))
+    from real_time_fraud_detection_system_tpu.features.spec import (
+        FEATURE_NAMES,
+    )
+
+    risk_cols = [i for i, nm in enumerate(FEATURE_NAMES) if "RISK" in nm]
+    assert res.features[:, risk_cols].max() > 0
+
+    # Without feedback, a fresh engine sees zero risk.
+    engine2, _ = _engine(FeatureCache(capacity=1 << 10))
+    engine2.process_batch(cols_for(day0, 0))
+    res2 = engine2.process_batch(cols_for(day0 + delay + 1, 100))
+    assert res2.features[:, risk_cols].max() == 0
+
+
+def test_state_feedback_idempotent_on_replay():
+    """Replayed label events must not double-count terminal fraud sums."""
+    from real_time_fraud_detection_system_tpu.core.batch import US_PER_DAY
+
+    cache = FeatureCache(capacity=1 << 10)
+    engine, cfg = _engine(cache)
+    delay = cfg.features.delay_days
+    day0 = 20200
+    n = 4
+    cols = {
+        "tx_id": np.arange(n, dtype=np.int64),
+        "tx_datetime_us": np.full(n, day0, np.int64) * US_PER_DAY + 1,
+        "customer_id": np.arange(n, dtype=np.int64),
+        "terminal_id": np.full(n, 7, dtype=np.int64),
+        "tx_amount_cents": np.full(n, 1000, dtype=np.int64),
+        "kafka_ts_ms": np.zeros(n, dtype=np.int64),
+    }
+    engine.process_batch(cols)
+    broker = InProcBroker(2)
+    msgs = encode_feedback_envelopes(np.arange(n), np.ones(n, np.int64))
+    broker.produce_many(FEEDBACK_TOPIC, [b""] * n, msgs)
+    loop = FeedbackLoop(engine, broker)
+    assert loop.poll_and_apply() == n
+    # Replay: same events from a NEW consumer (offset reset) — must no-op.
+    loop2 = FeedbackLoop(engine, broker)
+    assert loop2.poll_and_apply() == 0
+    # Risk after delay reflects exactly n frauds over n transactions: 1.0.
+    probe = dict(cols)
+    probe["tx_id"] = np.arange(100, 100 + n, dtype=np.int64)
+    probe["tx_datetime_us"] = (
+        np.full(n, day0 + delay + 1, np.int64) * US_PER_DAY + 1
+    )
+    res = engine.process_batch(probe)
+    from real_time_fraud_detection_system_tpu.features.spec import (
+        FEATURE_NAMES,
+    )
+
+    risk_cols = [i for i, nm in enumerate(FEATURE_NAMES) if "RISK" in nm]
+    assert res.features[:, risk_cols].max() <= 1.0 + 1e-6
+
+
+def test_in_band_labels_not_relanded_by_feedback(small_dataset):
+    """Rows scored WITH labels already scattered fraud into the state; a
+    later feedback event for them must be skipped."""
+    _, _, _, txs = small_dataset
+    part = txs.slice(slice(0, 512))
+    cache = FeatureCache(capacity=1 << 12)
+    engine, _ = _engine(cache)
+    engine.run(ReplaySource(part, EPOCH0, batch_rows=256, with_labels=True))
+    broker = InProcBroker(2)
+    broker.produce_many(
+        FEEDBACK_TOPIC, [b""] * part.n,
+        encode_feedback_envelopes(part.tx_id, part.tx_fraud),
+    )
+    loop = FeedbackLoop(engine, broker)
+    assert loop.poll_and_apply() == 0  # all already labeled in-band
+
+
+def test_feedback_loop_with_forest_updates_state_only(small_dataset):
+    """Tree kinds have no gradient path; the loop must still land labels in
+    the risk state without crashing."""
+    from real_time_fraud_detection_system_tpu.models.forest import fit_forest
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (256, 15))
+    yv = (x[:, 0] > 0).astype(np.float32)
+    params = fit_forest(x, yv, n_trees=4, max_depth=3)
+    cfg = Config(
+        features=FeatureConfig(customer_capacity=256, terminal_capacity=512,
+                               cms_width=1 << 10),
+    )
+    cache = FeatureCache(capacity=1 << 10)
+    engine = ScoringEngine(
+        cfg, kind="forest", params=params,
+        scaler=Scaler(mean=jnp.zeros(15), scale=jnp.ones(15)),
+        feature_cache=cache,
+    )
+    assert not engine.supports_online_sgd
+    _, _, _, txs = small_dataset
+    part = txs.slice(slice(0, 512))
+    engine.run(ReplaySource(part, EPOCH0, batch_rows=256))
+    broker = InProcBroker(2)
+    broker.produce_many(
+        FEEDBACK_TOPIC, [b""] * part.n,
+        encode_feedback_envelopes(part.tx_id, part.tx_fraud),
+    )
+    loop = FeedbackLoop(engine, broker)
+    assert loop.poll_and_apply() > 0
+
+
 def test_apply_feedback_requires_gradient_path(small_dataset):
     from real_time_fraud_detection_system_tpu.models.forest import fit_forest
 
