@@ -45,6 +45,15 @@ from real_time_fraud_detection_system_tpu.models.autoencoder import (  # noqa: F
     reconstruction_error,
     train_autoencoder,
 )
+from real_time_fraud_detection_system_tpu.models.plots import (  # noqa: F401
+    plot_execution_times,
+    plot_model_comparison,
+    plot_precision_recall,
+    plot_prequential_summary,
+    plot_roc,
+    plot_threshold_metrics,
+    save_plots,
+)
 from real_time_fraud_detection_system_tpu.models.selection import (  # noqa: F401
     FoldPerformance,
     SelectionSummary,

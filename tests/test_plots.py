@@ -1,0 +1,89 @@
+"""Evaluation plots (reference ``shared_functions.py:925-1302``)."""
+
+import os
+
+import matplotlib
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+
+from real_time_fraud_detection_system_tpu.models.plots import (
+    plot_execution_times,
+    plot_model_comparison,
+    plot_precision_recall,
+    plot_prequential_summary,
+    plot_roc,
+    plot_threshold_metrics,
+    pr_points,
+    roc_points,
+    save_plots,
+)
+
+
+@pytest.fixture(scope="module")
+def scored(rng):
+    n = 2000
+    y = (rng.random(n) < 0.1).astype(np.float64)
+    s = np.clip(0.3 * y + 0.2 * rng.random(n), 0, 1)
+    return y, s
+
+
+def test_roc_points_match_sklearn(scored):
+    from sklearn.metrics import roc_curve
+
+    y, s = scored
+    fpr, tpr = roc_points(y, s)
+    fpr_sk, tpr_sk, _ = roc_curve(y, s)
+    # Same curve: trapezoid areas agree.
+    area = np.trapezoid(tpr, fpr)
+    area_sk = np.trapezoid(tpr_sk, fpr_sk)
+    assert abs(area - area_sk) < 1e-9
+
+
+def test_pr_points_match_sklearn(scored):
+    from sklearn.metrics import precision_recall_curve
+
+    y, s = scored
+    recall, precision = pr_points(y, s)
+    p_sk, r_sk, _ = precision_recall_curve(y, s)
+    # Compare the step-integral (average precision style).
+    ap = np.sum(np.diff(recall) * precision[1:])
+    ap_sk = np.sum(np.diff(r_sk[::-1]) * p_sk[::-1][1:])
+    assert abs(ap - ap_sk) < 1e-9
+
+
+def test_figures_build(scored):
+    y, s = scored
+    assert plot_roc(y, s, "m") is not None
+    assert plot_precision_recall(y, s, "m") is not None
+    assert plot_threshold_metrics(y, s) is not None
+    assert plot_model_comparison(
+        {"logreg": {"auc_roc": 0.8, "average_precision": 0.4},
+         "forest": {"auc_roc": 0.9, "average_precision": 0.6}}
+    ) is not None
+    assert plot_execution_times(
+        {"logreg": {"fit_seconds": 1.0, "predict_seconds": 0.1}}
+    ) is not None
+
+
+def test_prequential_summary_plot():
+    from real_time_fraud_detection_system_tpu.models.selection import (
+        FoldPerformance,
+    )
+
+    rows = [
+        FoldPerformance(params={"d": d}, fold=f, expe_type=e,
+                        metrics={"auc_roc": 0.7 + 0.05 * d + 0.01 * f},
+                        fit_seconds=1.0, predict_seconds=0.1,
+                        n_train=10, n_test=5)
+        for d in (1, 2) for f in (0, 1) for e in ("validation", "test")
+    ]
+    assert plot_prequential_summary(rows) is not None
+
+
+def test_save_plots(tmp_path, scored):
+    y, s = scored
+    out = save_plots(str(tmp_path / "report.png"), y, s, "forest")
+    assert os.path.exists(out)
+    assert os.path.getsize(out) > 10_000  # a real rendered PNG
