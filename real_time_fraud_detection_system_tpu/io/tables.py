@@ -34,6 +34,10 @@ class UpsertTable:
         self._version = np.full(capacity, np.iinfo(np.int64).min, np.int64)
         self._live = np.zeros(capacity, dtype=bool)
         self._index: Dict[int, int] = {}
+        # Deletes for keys never inserted: version-only tombstones (no row
+        # slot — a stream of unknown-key deletes must not grow the column
+        # arrays). Consulted on insert to filter out-of-order stale rows.
+        self._tombstones: Dict[int, int] = {}
         self._n = 0
         self._seq = 0  # monotonic fallback version counter across merges
 
@@ -100,13 +104,25 @@ class UpsertTable:
             slot = self._index.get(k)
             if slot is not None and v <= int(self._version[slot]):
                 continue  # stale replay
+            if slot is None and v <= self._tombstones.get(k, np.iinfo(np.int64).min):
+                continue  # stale vs an unknown-key delete's tombstone
             if op[i] == 2:  # delete
-                if slot is not None and self._live[slot]:
+                if slot is None:
+                    # Never-seen key: record the delete's version as a
+                    # tombstone, so an out-of-order STALE insert (lower
+                    # ts) replayed later is still filtered — latest-wins
+                    # must hold for delete-then-insert arriving out of
+                    # order.
+                    self._tombstones[k] = v
+                elif self._live[slot]:
                     self._live[slot] = False
                     self._version[slot] = v
                     deleted += 1
+                else:
+                    self._version[slot] = v
                 continue
             if slot is None:
+                self._tombstones.pop(k, None)
                 slot = self._n
                 self._n += 1
                 self._index[k] = slot

@@ -42,6 +42,10 @@ def roc_points(y_true: np.ndarray, y_score: np.ndarray):
     """(fpr, tpr) at every distinct threshold, descending score order."""
     y = np.asarray(y_true).astype(np.float64)
     s = np.asarray(y_score).astype(np.float64)
+    if len(s) == 0:
+        # Trivial curve: the plots degrade gracefully on an empty score set
+        # (np.r_'s length-1 mask would otherwise IndexError a 0-row array).
+        return np.array([0.0, 1.0]), np.array([0.0, 1.0])
     order = np.argsort(-s, kind="mergesort")
     y = y[order]
     tp = np.cumsum(y)
@@ -56,6 +60,8 @@ def pr_points(y_true: np.ndarray, y_score: np.ndarray):
     """(recall, precision) curve points, descending score order."""
     y = np.asarray(y_true).astype(np.float64)
     s = np.asarray(y_score).astype(np.float64)
+    if len(s) == 0:
+        return np.array([0.0, 1.0]), np.array([1.0, 1.0])
     order = np.argsort(-s, kind="mergesort")
     y = y[order]
     tp = np.cumsum(y)

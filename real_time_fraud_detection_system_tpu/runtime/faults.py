@@ -22,7 +22,9 @@ equivalents plus the missing injection tools:
 
 from __future__ import annotations
 
+import os
 import time
+import uuid
 from dataclasses import dataclass
 from typing import Callable, Optional, Sequence, Tuple, Type
 
@@ -146,6 +148,72 @@ def corrupt_messages(msgs: Sequence[bytes],
     ]
 
 
+class _FencedCheckpointer:
+    """Restores only checkpoints saved through THIS wrapper.
+
+    Used by :func:`run_with_recovery` when ``resume=False``: a stale
+    checkpoint left by a previous run must never be restored by a crash
+    incarnation of a run that explicitly asked for a fresh start. The
+    pre-existing checkpoint files are recorded at construction and left
+    untouched until this run's FIRST save — if the fresh run dies before
+    ever saving, the previous run's checkpoints remain resumable. The
+    first save supersedes the old lineage: the stale files are renamed
+    aside (``stale-<token>-ckpt-…``, bytes preserved, unique token so
+    repeated fresh runs never clobber each other's stash) so they are
+    invisible to ``latest()`` AND to the retention GC — otherwise `keep`
+    stale higher-numbered files would garbage-collect this run's first
+    saves the moment they land.
+    """
+
+    def __init__(self, inner):
+        self.inner = inner
+        self._saved: list = []
+        self._stale: list = []
+        directory = getattr(inner, "directory", None)
+        if directory and os.path.isdir(directory):
+            self._stale = [
+                os.path.join(directory, f)
+                for f in sorted(os.listdir(directory))
+                if f.startswith("ckpt-") and f.endswith(".npz")
+            ]
+
+    def _quarantine_stale(self) -> None:
+        # Retention: one stash only — clear any previous run's stale-*
+        # files first, so repeated resume=False runs on a persistent dir
+        # keep at most `keep` quarantined snapshots, not an unbounded pile.
+        dirs = {os.path.dirname(p) for p in self._stale}
+        for d in dirs:
+            for old in os.listdir(d):
+                if old.startswith("stale-") and old.endswith(".npz"):
+                    os.remove(os.path.join(d, old))
+        token = uuid.uuid4().hex[:8]
+        for p in self._stale:
+            if os.path.exists(p):
+                d, f = os.path.split(p)
+                os.replace(p, os.path.join(d, f"stale-{token}-{f}"))
+        self._stale = []
+
+    def save(self, engine_state):
+        if self._stale:
+            self._quarantine_stale()
+        path = self.inner.save(engine_state)
+        self._saved.append(path)
+        return path
+
+    def restore(self, engine_state, path=None):
+        import os as _os
+
+        if path is None:
+            mine = [p for p in self._saved if _os.path.exists(p)]
+            if not mine:
+                return None
+            path = max(mine)
+        return self.inner.restore(engine_state, path=path)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
 def run_with_recovery(
     make_engine: Callable[[], object],
     source,
@@ -170,14 +238,18 @@ def run_with_recovery(
     The sink must tolerate replayed batches (idempotent append by tx_id or
     latest-wins MERGE downstream, as in the reference's MERGE INTO).
 
-    ``resume=False`` ignores any pre-existing checkpoint for the FIRST
-    incarnation (a fresh pass over the stream); crash incarnations always
-    restore — that is the whole point. ``recover_on`` lists the exception
-    types treated as recoverable; anything else propagates immediately
-    (engine bugs should crash loudly, not restart-loop).
+    ``resume=False`` ignores any pre-existing checkpoint for the whole run
+    (a fresh pass over the stream): the checkpointer is fenced so crash
+    incarnations restore only checkpoints written by THIS run — a stale
+    checkpoint from a previous run is never silently resumed, even if the
+    first incarnation crashes before its first save. ``recover_on`` lists
+    the exception types treated as recoverable; anything else propagates
+    immediately (engine bugs should crash loudly, not restart-loop).
     """
     restarts = 0
     initial_offsets = list(source.offsets)
+    if not resume:
+        checkpointer = _FencedCheckpointer(checkpointer)
     if heartbeat is not None:
         inner_sink = sink
 
@@ -192,6 +264,8 @@ def run_with_recovery(
         engine = make_engine()
         restored = None
         if resume or restarts > 0:
+            # With resume=False the fence makes this a no-op until the
+            # current run has saved at least once.
             restored = checkpointer.restore(engine.state)
         if restored is not None:
             source.seek(engine.state.offsets)

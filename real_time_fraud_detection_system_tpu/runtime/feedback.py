@@ -35,6 +35,7 @@ from typing import Iterable, List, Sequence, Tuple
 import numpy as np
 
 from real_time_fraud_detection_system_tpu.features.spec import N_FEATURES
+from real_time_fraud_detection_system_tpu.ops.dedup import latest_wins_mask_np
 from real_time_fraud_detection_system_tpu.utils.logging import get_logger
 
 log = get_logger("feedback")
@@ -60,22 +61,31 @@ def encode_feedback_envelopes(
 
 def decode_feedback_envelopes(
     messages: Iterable[bytes],
-) -> Tuple[np.ndarray, np.ndarray]:
-    """→ (tx_ids int64 [n], labels int32 [n]); malformed events dropped."""
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """→ (tx_ids int64 [n], labels int32 [n], ts_ms int64 [n]); malformed
+    events dropped. A missing/bad ``ts_ms`` defaults to 0 (not a decode
+    failure — only tx_id and label are required)."""
     ids: List[int] = []
     ys: List[int] = []
+    ts: List[int] = []
     for m in messages:
         try:
             d = json.loads(m)
-            # Parse BOTH fields before appending either, or a message with
-            # a valid tx_id but bad label would misalign the two lists.
+            # Parse required fields before appending any, or a message with
+            # a valid tx_id but bad label would misalign the lists.
             t, y = int(d["tx_id"]), int(d["label"])
         except (ValueError, KeyError, TypeError):
             continue
+        try:
+            s = int(d.get("ts_ms", 0))
+        except (ValueError, TypeError):
+            s = 0
         ids.append(t)
         ys.append(y)
+        ts.append(s)
     return (np.asarray(ids, dtype=np.int64),
-            np.asarray(ys, dtype=np.int32))
+            np.asarray(ys, dtype=np.int32),
+            np.asarray(ts, dtype=np.int64))
 
 
 class FeatureCache:
@@ -187,7 +197,10 @@ class FeedbackLoop:
         self.topic = topic
         self.max_events = max_events
         self._offsets = [0] * broker.n_partitions
-        self.stats = {"events": 0, "applied": 0, "missed": 0}
+        # Decomposition: events == duplicates + missed + (cache hits);
+        # applied ⊆ hits (the rest were already labeled or label < 0).
+        self.stats = {"events": 0, "applied": 0, "missed": 0,
+                      "duplicates": 0}
 
     def poll_and_apply(self) -> int:
         """Drain available label events; returns number of rows learned."""
@@ -199,10 +212,22 @@ class FeedbackLoop:
             msgs += [r.value for r in recs]
         if not msgs:
             return 0
-        tx_ids, labels = decode_feedback_envelopes(msgs)
+        tx_ids, labels, ts_ms = decode_feedback_envelopes(msgs)
+        self.stats["events"] += len(tx_ids)
+        if len(tx_ids):
+            # Within-poll dedup, latest-wins: the `done` guard below only
+            # protects across polls (mark_labeled runs only after apply), so
+            # a tx_id appearing twice in one drained batch would run the
+            # additive fraud scatter + SGD step once per copy. Winner is
+            # the greatest event ts_ms (drain position breaks ties) — NOT
+            # bare drain position, which across a multi-partition topic
+            # orders by partition number, not recency. Same latest-wins
+            # rule and helper as the ingest MERGE path.
+            keep = latest_wins_mask_np(tx_ids, ts_ms)
+            self.stats["duplicates"] += int(len(tx_ids) - keep.sum())
+            tx_ids, labels = tx_ids[keep], labels[keep]
         feats, term_ids, days, hit, done = self.cache.get_batch_full(tx_ids)
         n_hit = int(hit.sum())
-        self.stats["events"] += len(tx_ids)
         self.stats["missed"] += len(tx_ids) - n_hit
         if n_hit == 0:
             return 0

@@ -233,6 +233,36 @@ def test_recovery_rerun_fresh_with_resume_false(small_dataset, tmp_path):
     assert len(s3.concat()["tx_id"]) == 512
 
 
+def test_resume_false_never_restores_foreign_checkpoint(small_dataset,
+                                                        tmp_path):
+    """resume=False + a stale checkpoint from a PREVIOUS run + a crash
+    before this run's first save: the crash incarnation must restart from
+    the stream beginning, not silently resume the foreign checkpoint the
+    caller asked to ignore."""
+    cfg, txs, make_engine = _mk(small_dataset, tmp_path, every=100)
+    part = txs.slice(slice(0, 512))
+    ckpt = Checkpointer(str(tmp_path / "ck_fence"))
+
+    # Previous run leaves a checkpoint at end-of-stream.
+    run_with_recovery(make_engine,
+                      ReplaySource(part, EPOCH0, batch_rows=256),
+                      ckpt, sink=MemorySink(), max_restarts=1)
+    assert ckpt.latest() is not None
+
+    # New run, resume=False, crash on poll 1 (batch 0 done, nothing saved:
+    # checkpoint_every=100). Without fencing, the restart restores the
+    # stale end-of-stream checkpoint and outputs nothing further.
+    sink = MemorySink()
+    src = FlakySource(ReplaySource(part, EPOCH0, batch_rows=256),
+                      fail_at=(1,))
+    stats = run_with_recovery(make_engine, src, ckpt, sink=sink,
+                              max_restarts=3, resume=False)
+    assert stats["restarts"] == 1
+    out = sink.concat()
+    # Full fresh pass: every tx scored (batch 0 replayed after restart).
+    assert len(np.unique(out["tx_id"])) == 512
+
+
 def test_recovery_catches_oserror(small_dataset, tmp_path):
     """Real-world transient faults (OSError family) are supervised too."""
     cfg, txs, make_engine = _mk(small_dataset, tmp_path)

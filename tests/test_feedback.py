@@ -36,9 +36,10 @@ def test_feedback_envelope_roundtrip():
     msgs = encode_feedback_envelopes([5, 9], [1, 0], ts_ms=42)
     # valid tx_id with missing label must NOT misalign the two arrays
     bad = [b"garbage", b"{}", b'{"tx_id": 7}', b'{"label": 1}']
-    ids, ys = decode_feedback_envelopes(msgs[:1] + bad + msgs[1:])
+    ids, ys, ts = decode_feedback_envelopes(msgs[:1] + bad + msgs[1:])
     np.testing.assert_array_equal(ids, [5, 9])
     np.testing.assert_array_equal(ys, [1, 0])
+    np.testing.assert_array_equal(ts, [42, 42])
 
 
 class TestFeatureCache:
@@ -264,6 +265,91 @@ def test_state_feedback_idempotent_on_replay():
 
     risk_cols = [i for i, nm in enumerate(FEATURE_NAMES) if "RISK" in nm]
     assert res.features[:, risk_cols].max() <= 1.0 + 1e-6
+
+
+def test_state_feedback_dedups_within_one_poll():
+    """Duplicate label events for the same tx_id inside a SINGLE drained
+    batch must apply once (cross-poll replays are guarded by the cache's
+    ``labeled`` bit, but within one poll that bit is only set after apply —
+    an at-least-once producer retry often lands both copies in one drain)."""
+    from real_time_fraud_detection_system_tpu.core.batch import US_PER_DAY
+
+    cache = FeatureCache(capacity=1 << 10)
+    engine, cfg = _engine(cache)
+    delay = cfg.features.delay_days
+    day0 = 20200
+    n = 4
+    cols = {
+        "tx_id": np.arange(n, dtype=np.int64),
+        "tx_datetime_us": np.full(n, day0, np.int64) * US_PER_DAY + 1,
+        "customer_id": np.arange(n, dtype=np.int64),
+        "terminal_id": np.full(n, 7, dtype=np.int64),
+        "tx_amount_cents": np.full(n, 1000, dtype=np.int64),
+        "kafka_ts_ms": np.zeros(n, dtype=np.int64),
+    }
+    engine.process_batch(cols)
+    broker = InProcBroker(1)
+    # Each event produced twice — both copies land in the same drain.
+    msgs = encode_feedback_envelopes(np.arange(n), np.ones(n, np.int64))
+    broker.produce_many(FEEDBACK_TOPIC, [b""] * (2 * n), msgs + msgs)
+    loop = FeedbackLoop(engine, broker)
+    assert loop.poll_and_apply() == n  # not 2n
+    # Fraud sum landed once: risk after the delay is exactly n/n = 1.0.
+    probe = dict(cols)
+    probe["tx_id"] = np.arange(100, 100 + n, dtype=np.int64)
+    probe["tx_datetime_us"] = (
+        np.full(n, day0 + delay + 1, np.int64) * US_PER_DAY + 1
+    )
+    res = engine.process_batch(probe)
+    from real_time_fraud_detection_system_tpu.features.spec import (
+        FEATURE_NAMES,
+    )
+
+    risk_cols = [i for i, nm in enumerate(FEATURE_NAMES) if "RISK" in nm]
+    assert res.features[:, risk_cols].max() <= 1.0 + 1e-6
+
+
+def test_feedback_within_poll_newest_ts_wins():
+    """Conflicting labels for one tx_id in one poll: the greatest event
+    ts_ms wins, even when the older event drains LATER (a multi-partition
+    topic orders the drain by partition, not recency)."""
+    from real_time_fraud_detection_system_tpu.core.batch import US_PER_DAY
+    from real_time_fraud_detection_system_tpu.features.spec import (
+        FEATURE_NAMES,
+    )
+
+    cache = FeatureCache(capacity=1 << 10)
+    engine, cfg = _engine(cache)
+    delay = cfg.features.delay_days
+    day0 = 20200
+    cols = {
+        "tx_id": np.zeros(1, dtype=np.int64),
+        "tx_datetime_us": np.full(1, day0, np.int64) * US_PER_DAY + 1,
+        "customer_id": np.zeros(1, dtype=np.int64),
+        "terminal_id": np.full(1, 7, dtype=np.int64),
+        "tx_amount_cents": np.full(1, 1000, dtype=np.int64),
+        "kafka_ts_ms": np.zeros(1, dtype=np.int64),
+    }
+    engine.process_batch(cols)
+    broker = InProcBroker(1)
+    # Newest label (ts=2, legit) drains FIRST; stale fraud label (ts=1)
+    # drains after it. Drain-position ordering would pick the stale fraud.
+    msgs = (encode_feedback_envelopes([0], [0], ts_ms=2)
+            + encode_feedback_envelopes([0], [1], ts_ms=1))
+    broker.produce_many(FEEDBACK_TOPIC, [b"", b""], msgs)
+    loop = FeedbackLoop(engine, broker)
+    assert loop.poll_and_apply() == 1
+    assert loop.stats["events"] == 2
+    assert loop.stats["duplicates"] == 1
+    # The legit label won: no fraud scattered, terminal risk stays 0.
+    probe = dict(cols)
+    probe["tx_id"] = np.array([100], dtype=np.int64)
+    probe["tx_datetime_us"] = (
+        np.full(1, day0 + delay + 1, np.int64) * US_PER_DAY + 1
+    )
+    res = engine.process_batch(probe)
+    risk_cols = [i for i, nm in enumerate(FEATURE_NAMES) if "RISK" in nm]
+    assert res.features[:, risk_cols].max() == 0
 
 
 def test_in_band_labels_not_relanded_by_feedback(small_dataset):

@@ -103,6 +103,39 @@ class TestUpsertTable:
         assert upd == 1
         assert t2.get(1)["x_location"] == 5.0
 
+    def test_delete_unknown_key_fences_stale_insert(self):
+        # Out-of-order delete-then-insert: a delete for a never-seen key
+        # must leave a versioned tombstone, so the stale insert (lower ts)
+        # replayed afterwards is filtered — latest-wins says the row is
+        # deleted.
+        t = UpsertTable(CUSTOMERS)
+        ins, upd, dele = t.merge(self._cols([9], [0.0], [100], op=[2]))
+        assert (ins, upd, dele) == (0, 0, 0)
+        assert t.get(9) is None
+        ins, upd, dele = t.merge(self._cols([9], [5.0], [50]))  # stale
+        assert (ins, upd, dele) == (0, 0, 0)
+        assert t.get(9) is None
+        # A genuinely NEWER insert after the delete is accepted.
+        ins, upd, dele = t.merge(self._cols([9], [7.0], [200]))
+        assert ins == 1
+        assert t.get(9)["x_location"] == 7.0
+
+    def test_unknown_key_deletes_do_not_grow_rows(self):
+        # Tombstones are version-only: a stream of deletes for never-seen
+        # keys must not allocate column-array slots.
+        t = UpsertTable(CUSTOMERS, capacity=4)
+        ids = list(range(100, 200))
+        t.merge(self._cols(ids, [0.0] * 100, [10] * 100,
+                           op=[2] * 100))
+        assert len(t) == 0
+        assert t._n == 0  # no row slots consumed
+        # Keys remain fenced against stale inserts...
+        t.merge(self._cols([150], [1.0], [5]))
+        assert t.get(150) is None
+        # ...but fresh inserts land and clear their tombstone.
+        t.merge(self._cols([150], [2.0], [50]))
+        assert t.get(150)["x_location"] == 2.0
+
     def test_to_columns_snapshot(self):
         t = UpsertTable(CUSTOMERS)
         t.merge(self._cols([5, 6], [1.0, 2.0], [1, 1]))

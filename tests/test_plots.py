@@ -29,6 +29,17 @@ def scored(rng):
     return y, s
 
 
+def test_curves_degrade_gracefully_on_empty_input():
+    empty = np.array([])
+    fpr, tpr = roc_points(empty, empty)
+    assert len(fpr) == len(tpr) >= 2
+    rec, prec = pr_points(empty, empty)
+    assert len(rec) == len(prec) >= 2
+    # The figures build too (would previously IndexError).
+    plot_roc(empty, empty)
+    plot_precision_recall(empty, empty)
+
+
 def test_roc_points_match_sklearn(scored):
     from sklearn.metrics import roc_curve
 
