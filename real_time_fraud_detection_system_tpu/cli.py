@@ -140,7 +140,26 @@ def cmd_score(args) -> int:
     if args.scorer == "cpu":
         cpu_model = model  # TrainedModel.predict_proba runs host-side numpy
 
+    if args.devices > 1 and args.scorer == "cpu":
+        log.error("--scorer cpu is the single-host sklearn oracle; it does "
+                  "not compose with --devices > 1 (the sharded engine "
+                  "always scores on-device)")
+        return 2
+
     def make_engine():
+        if args.devices > 1:
+            from real_time_fraud_detection_system_tpu.runtime import (
+                ShardedScoringEngine,
+            )
+
+            return ShardedScoringEngine(
+                cfg,
+                kind=model.kind,
+                params=model.params,
+                scaler=model.scaler,
+                n_devices=args.devices,
+                online_lr=args.online_lr,
+            )
         return ScoringEngine(
             cfg,
             kind=model.kind,
@@ -321,6 +340,10 @@ def main(argv=None) -> int:
     p.add_argument("--max-restarts", type=int, default=0,
                    help="supervised mode: restart-on-failure with "
                         "checkpoint replay (requires --checkpoint-dir)")
+    p.add_argument("--devices", type=int, default=1,
+                   help="serve on an N-device mesh (sharded engine: "
+                        "customer-partitioned rows, all_to_all terminal "
+                        "exchange); 1 = single-chip engine")
     p.set_defaults(fn=cmd_score)
 
     p = sub.add_parser("demo",

@@ -215,6 +215,21 @@ def apply_feedback(
     delay-shifted risk windows will pick up.
     """
     term_slot = _slot(terminal_key, cfg.terminal_capacity, cfg.key_mode)
+    return apply_feedback_at_slot(state, term_slot, day, label, valid)
+
+
+def apply_feedback_at_slot(
+    state: FeatureState,
+    term_slot: jnp.ndarray,  # int32 [B] — row into the terminal table
+    day: jnp.ndarray,
+    label: jnp.ndarray,
+    valid: jnp.ndarray,
+) -> FeatureState:
+    """Slot-addressed core of :func:`apply_feedback`.
+
+    Separated so layouts with a different key→slot mapping (the sharded
+    engine's owner-partitioned terminal table, ``parallel/step.py``) can
+    land labels without re-deriving the single-chip mapping."""
     nb = state.terminal.n_buckets
     bucket = jnp.remainder(day, nb)
     flat = term_slot * nb + bucket

@@ -43,39 +43,75 @@ from real_time_fraud_detection_system_tpu.ops.windows import (
 )
 
 
-def partition_batch_by_customer(
+def partition_batch_spill(
     cols: dict, n_dev: int, rows_per_shard: int
-) -> Tuple[dict, np.ndarray]:
-    """Host-side partitioner: layout rows as [n_dev × rows_per_shard].
+) -> "list[Tuple[dict, np.ndarray, np.ndarray]]":
+    """Host-side partitioner with hot-key spill: one or more
+    [n_dev × rows_per_shard] layouts.
 
-    Returns (columns dict with every array length n_dev*rows_per_shard,
-    gather_index) where ``gather_index[i]`` is the output position of input
-    row i (for re-assembling results in input order). Partition of a row is
-    ``customer_id % n_dev`` — the broker's key-hash analogue, sticky per
-    customer.
+    Partition of a row is ``customer_id % n_dev`` — the broker's key-hash
+    analogue, sticky per customer. A skewed key distribution can put more
+    than ``rows_per_shard`` rows on one shard; instead of failing, the
+    overflow **spills** into follow-on sub-batches (rank r within a shard
+    goes to chunk ``r // rows_per_shard``), so the stream absorbs hot keys
+    at the cost of extra steps rather than dying.
+
+    Returns a list of (columns dict with every array length
+    n_dev*rows_per_shard plus a ``__valid__`` mask, input_rows, pos):
+    ``input_rows[j]`` is the original row index of the chunk's j-th
+    occupied slot and ``pos[j]`` its position in the chunk layout — for
+    re-assembling results in input order.
     """
     cust = cols["customer_id"]
     n = len(cust)
     part = (cust % n_dev).astype(np.int64)
     order = np.argsort(part, kind="stable")
     part_sorted = part[order]
-    rank_sorted = np.arange(n) - np.searchsorted(part_sorted, part_sorted, "left")
-    if n and rank_sorted.max() >= rows_per_shard:
+    rank_sorted = (
+        np.arange(n) - np.searchsorted(part_sorted, part_sorted, "left")
+    )
+    rank = np.empty(n, dtype=np.int64)
+    rank[order] = rank_sorted
+    chunk_of = rank // rows_per_shard
+    n_chunks = int(chunk_of.max()) + 1 if n else 1
+    total = n_dev * rows_per_shard
+    chunks = []
+    for c in range(n_chunks):
+        rows = np.flatnonzero(chunk_of == c)
+        pos = part[rows] * rows_per_shard + (rank[rows] - c * rows_per_shard)
+        out = {}
+        for k, v in cols.items():
+            buf = np.zeros(total, dtype=v.dtype)
+            buf[pos] = v[rows]
+            out[k] = buf
+        valid = np.zeros(total, dtype=bool)
+        valid[pos] = True
+        out["__valid__"] = valid
+        chunks.append((out, rows, pos))
+    return chunks
+
+
+def partition_batch_by_customer(
+    cols: dict, n_dev: int, rows_per_shard: int
+) -> Tuple[dict, np.ndarray]:
+    """Single-chunk partitioner: layout rows as [n_dev × rows_per_shard].
+
+    Returns (columns dict with every array length n_dev*rows_per_shard,
+    gather_index) where ``gather_index[i]`` is the output position of input
+    row i. Raises on shard overflow — callers that must survive hot keys
+    use :func:`partition_batch_spill` (the sharded engine does).
+    """
+    chunks = partition_batch_spill(cols, n_dev, rows_per_shard)
+    if len(chunks) > 1:
         raise ValueError(
             f"partition overflow: >{rows_per_shard} rows on one shard; "
-            f"raise rows_per_shard or poll smaller batches"
+            f"raise rows_per_shard, poll smaller batches, or use "
+            f"partition_batch_spill"
         )
+    out, rows, pos_chunk = chunks[0]
+    n = len(cols["customer_id"])
     pos = np.empty(n, dtype=np.int64)
-    pos[order] = part_sorted * rows_per_shard + rank_sorted
-    total = n_dev * rows_per_shard
-    out = {}
-    for k, v in cols.items():
-        buf = np.zeros(total, dtype=v.dtype)
-        buf[pos] = v
-        out[k] = buf
-    valid = np.zeros(total, dtype=bool)
-    valid[pos] = True
-    out["__valid__"] = valid
+    pos[rows] = pos_chunk
     return out, pos
 
 

@@ -7,6 +7,9 @@ from real_time_fraud_detection_system_tpu.runtime.engine import (  # noqa: F401
     EngineState,
     ScoringEngine,
 )
+from real_time_fraud_detection_system_tpu.runtime.sharded_engine import (  # noqa: F401
+    ShardedScoringEngine,
+)
 from real_time_fraud_detection_system_tpu.runtime.faults import (  # noqa: F401
     FlakySource,
     Heartbeat,

@@ -1,0 +1,259 @@
+"""Multi-chip streaming engine: the sharded serving loop.
+
+Round 1 proved the sharded *step* (``parallel/step.py``: customer-sharded
+window state, terminal ``all_to_all`` exchange, psum'd online SGD) on
+single dry-run steps; this module makes it a *serving engine* — the same
+source → dedup → step → sink → checkpoint stream contract as
+:class:`~.engine.ScoringEngine`, but the step runs under ``shard_map``
+over a ``jax.sharding.Mesh``. This is the TPU-native analogue of the
+reference's scaled-out deployment (8-partition Kafka stream feeding
+parallel Spark executors, SURVEY §2.3 items 1-2;
+``fraud_detection.py:204-211`` is the loop being replaced).
+
+Row → device placement is ``customer_id % n_devices`` (the broker's
+key-hash partition analogue), computed host-side by
+:func:`~..parallel.step.partition_batch_spill`; a hot-key shard overflow
+spills into follow-on sub-steps instead of failing the stream.
+
+The engine inherits the single-chip engine's run loop, feedback-SGD path,
+and feature-cache plumbing; it overrides batch processing (partition →
+sharded step → re-assemble) and state feedback (the terminal table lives
+in owner-partitioned layout: global row = owner * cap_local + local_slot).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from real_time_fraud_detection_system_tpu.config import Config
+from real_time_fraud_detection_system_tpu.core.batch import (
+    US_PER_DAY,
+    fold_key,
+    make_batch,
+)
+from real_time_fraud_detection_system_tpu.core.batch import bucket_size
+from real_time_fraud_detection_system_tpu.features.online import (
+    apply_feedback_at_slot,
+    init_feature_state,
+)
+from real_time_fraud_detection_system_tpu.features.spec import N_FEATURES
+from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+from real_time_fraud_detection_system_tpu.ops.dedup import latest_wins_mask_np
+from real_time_fraud_detection_system_tpu.parallel.mesh import (
+    make_mesh,
+    shard_feature_state,
+)
+from real_time_fraud_detection_system_tpu.parallel.step import (
+    make_sharded_step,
+    partition_batch_spill,
+)
+from real_time_fraud_detection_system_tpu.runtime.engine import (
+    BatchResult,
+    ScoringEngine,
+    loss_fn_for,
+    predict_fn_for,
+)
+
+
+class ShardedScoringEngine(ScoringEngine):
+    """Streaming engine over an n-device mesh.
+
+    Same interface as :class:`ScoringEngine` (``process_batch`` /
+    ``run`` / ``apply_feedback`` / ``apply_state_feedback`` / checkpoint
+    state), so sources, sinks, the feedback loop, and
+    :func:`~.faults.run_with_recovery` compose unchanged.
+
+    ``rows_per_shard`` fixes the per-device step width (static shapes keep
+    the jit cache to ONE entry); a micro-batch is absorbed as
+    ceil(max_shard_load / rows_per_shard) sub-steps.
+    """
+
+    def __init__(
+        self,
+        cfg: Config,
+        kind: str,
+        params,
+        scaler: Scaler,
+        mesh: Optional[Mesh] = None,
+        n_devices: int = 0,
+        rows_per_shard: int = 0,
+        axis: "str | tuple" = "data",
+        online_lr: float = 0.0,
+        feature_cache=None,
+    ):
+        super().__init__(
+            cfg, kind, params, scaler, online_lr=online_lr,
+            feature_cache=feature_cache,
+        )
+        self.mesh = mesh if mesh is not None else make_mesh(n_devices)
+        self.axis = axis
+        self.n_dev = int(self.mesh.devices.size)
+        if cfg.features.customer_capacity % self.n_dev:
+            raise ValueError("customer_capacity must divide by n_devices")
+        if cfg.features.terminal_capacity % self.n_dev:
+            raise ValueError("terminal_capacity must divide by n_devices")
+        # Default: 2× the balanced per-device load, so ordinary partition
+        # imbalance stays in ONE chunk (a spill chunk only sees prior
+        # chunks' in-batch state updates — same semantics as a follow-on
+        # micro-batch, but a needless divergence from the single-chip
+        # scatter-then-gather when the skew is mild).
+        self.rows_per_shard = rows_per_shard or max(
+            2 * -(-cfg.runtime.max_batch_rows // self.n_dev), 16
+        )
+        self.state.feature_state = shard_feature_state(
+            init_feature_state(cfg.features), self.mesh, axis=self.axis
+        )
+        self._sharded_build = make_sharded_step(
+            cfg,
+            predict_fn_for(kind),
+            loss_fn=loss_fn_for(kind),
+            online_lr=online_lr,
+            mesh=self.mesh,
+            axis=self.axis,
+        )
+        self._sharded_step = None  # built on first batch (needs templates)
+        self._sharded_sf = None
+
+    # -- sharding upkeep ---------------------------------------------------
+
+    def _ensure_sharded(self) -> None:
+        """Re-place the feature state after an external restore.
+
+        ``Checkpointer.restore`` rebuilds leaves as plain device arrays;
+        the sharded step wants them laid out over the mesh (jit would
+        auto-reshard every call otherwise — correct but wasteful)."""
+        leaf = self.state.feature_state.customer.count
+        sh = getattr(leaf, "sharding", None)
+        if not (isinstance(sh, NamedSharding) and sh.mesh.shape
+                == self.mesh.shape):
+            self.state.feature_state = shard_feature_state(
+                self.state.feature_state, self.mesh, axis=self.axis
+            )
+
+    # -- the sharded hot path ----------------------------------------------
+
+    def process_batch(self, cols: dict) -> BatchResult:
+        """One micro-batch: dedup → partition (spill) → sharded step(s) →
+        re-assemble in input order."""
+        t0 = time.perf_counter()
+        keep = latest_wins_mask_np(cols["tx_id"], cols["kafka_ts_ms"])
+        cols = {k: v[keep] for k, v in cols.items()}
+        n = len(cols["tx_id"])
+        self._ensure_sharded()
+
+        probs_np = np.zeros(n, dtype=np.float32)
+        feats_np = np.zeros((n, N_FEATURES), dtype=np.float32)
+        chunks = partition_batch_spill(
+            cols, self.n_dev, self.rows_per_shard
+        ) if n else []
+        for part_cols, rows, pos in chunks:
+            batch = make_batch(
+                customer_id=part_cols["customer_id"],
+                terminal_id=part_cols["terminal_id"],
+                tx_datetime_us=part_cols["tx_datetime_us"],
+                amount_cents=part_cols["tx_amount_cents"],
+                label=np.where(
+                    part_cols["__valid__"],
+                    part_cols.get(
+                        "label",
+                        np.full(len(part_cols["__valid__"]), -1, np.int64),
+                    ),
+                    -1,
+                ),
+            )
+            batch = batch._replace(valid=part_cols["__valid__"])
+            jbatch = jax.tree.map(jnp.asarray, batch)
+            if self._sharded_step is None:
+                self._sharded_step = self._sharded_build(
+                    self.state.feature_state, self.state.params,
+                    self.state.scaler, jbatch,
+                )
+            fstate, params, probs, feats = self._sharded_step(
+                self.state.feature_state, self.state.params,
+                self.state.scaler, jbatch,
+            )
+            self.state.feature_state = fstate
+            self.state.params = params
+            probs_np[rows] = np.asarray(probs)[pos]
+            feats_np[rows] = np.asarray(feats)[pos]
+
+        if self.feature_cache is not None and n:
+            in_band = cols.get("label")
+            self.feature_cache.put_batch(
+                cols["tx_id"], feats_np,
+                terminal_ids=cols["terminal_id"],
+                days=(cols["tx_datetime_us"] // US_PER_DAY).astype(np.int32),
+                labeled=(np.asarray(in_band) >= 0)
+                if in_band is not None else None,
+            )
+        self.state.batches_done += 1
+        self.state.rows_done += n
+        return BatchResult(
+            tx_id=cols["tx_id"],
+            tx_datetime_us=cols["tx_datetime_us"],
+            customer_id=cols["customer_id"],
+            terminal_id=cols["terminal_id"],
+            amount_cents=cols["tx_amount_cents"],
+            features=feats_np,
+            probs=probs_np,
+            latency_s=time.perf_counter() - t0,
+        )
+
+    # -- feedback into the owner-partitioned terminal table ----------------
+
+    def apply_state_feedback(
+        self,
+        terminal_ids: np.ndarray,
+        days: np.ndarray,
+        labels: np.ndarray,
+    ) -> None:
+        """Land delayed fraud labels in the sharded terminal risk windows.
+
+        The sharded layout places terminal key k at global row
+        ``(k % n_dev) * cap_local + ((k // n_dev) & (cap_local - 1))``
+        (owner shard × local slot, mirroring ``parallel/step.py``). The
+        scatter runs as a plain jitted global-array op — GSPMD inserts the
+        (off-hot-path) collectives."""
+        labels = np.asarray(labels)
+        mask = labels >= 0
+        if not mask.any():
+            return
+        self._ensure_sharded()
+        n_dev = self.n_dev
+        cap_local = self.cfg.features.terminal_capacity // n_dev
+        key = fold_key(np.asarray(terminal_ids)[mask]).astype(np.uint32)
+        gslot = (
+            (key % np.uint32(n_dev)).astype(np.int64) * cap_local
+            + ((key // np.uint32(n_dev)) & np.uint32(cap_local - 1))
+        ).astype(np.int32)
+        if self._sharded_sf is None:
+            self._sharded_sf = jax.jit(
+                apply_feedback_at_slot, donate_argnums=(0,)
+            )
+        d = np.asarray(days)[mask].astype(np.int32)
+        y = labels[mask].astype(np.int32)
+        # Bucket-pad like the single-chip path (engine.py) so a stream of
+        # ever-different label counts hits ONE jit cache entry, not one
+        # compile per length.
+        biggest = max(self.cfg.runtime.batch_buckets)
+        for s in range(0, len(y), biggest):
+            m = len(y[s : s + biggest])
+            pad = bucket_size(m, self.cfg.runtime.batch_buckets)
+            gs = np.zeros(pad, dtype=np.int32)
+            gs[:m] = gslot[s : s + m]
+            dd = np.zeros(pad, dtype=np.int32)
+            dd[:m] = d[s : s + m]
+            yy = np.zeros(pad, dtype=np.int32)
+            yy[:m] = y[s : s + m]
+            valid = np.zeros(pad, dtype=bool)
+            valid[:m] = True
+            self.state.feature_state = self._sharded_sf(
+                self.state.feature_state, jnp.asarray(gs), jnp.asarray(dd),
+                jnp.asarray(yy), jnp.asarray(valid),
+            )

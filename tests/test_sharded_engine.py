@@ -1,0 +1,285 @@
+"""Multi-chip streaming serve: the sharded ScoringEngine.
+
+Round-1 coverage proved single sharded *steps*; these tests run the full
+stream contract — source → partition → sharded step → sink → checkpoint →
+feedback — on the 8-virtual-device CPU mesh, and pin parity with the
+single-chip engine on the same stream (the reference's scaled-out serving
+story, ``fraud_detection.py:204-211`` + SURVEY §2.3 items 1-2).
+"""
+
+import numpy as np
+import pytest
+
+from real_time_fraud_detection_system_tpu.config import (
+    Config,
+    FeatureConfig,
+    RuntimeConfig,
+    TrainConfig,
+)
+from real_time_fraud_detection_system_tpu.io import MemorySink
+from real_time_fraud_detection_system_tpu.io.checkpoint import Checkpointer
+from real_time_fraud_detection_system_tpu.models.logreg import init_logreg
+from real_time_fraud_detection_system_tpu.models.metrics import roc_auc
+from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+from real_time_fraud_detection_system_tpu.parallel.step import (
+    partition_batch_spill,
+)
+from real_time_fraud_detection_system_tpu.runtime import (
+    ReplaySource,
+    ScoringEngine,
+    ShardedScoringEngine,
+)
+
+EPOCH0 = 1_743_465_600
+N_DEV = 8
+
+
+def _cfg(max_rows=1024):
+    return Config(
+        features=FeatureConfig(customer_capacity=512,
+                               terminal_capacity=1024,
+                               cms_width=1 << 10),
+        train=TrainConfig(),
+        runtime=RuntimeConfig(batch_buckets=(max_rows,),
+                              max_batch_rows=max_rows,
+                              trigger_seconds=0.0),
+    )
+
+
+def _model():
+    import jax.numpy as jnp
+
+    params = init_logreg(15)
+    scaler = Scaler(mean=jnp.zeros(15), scale=jnp.ones(15))
+    return params, scaler
+
+
+class TestPartitionSpill:
+    def _cols(self, cust):
+        n = len(cust)
+        return {
+            "customer_id": np.asarray(cust, dtype=np.int64),
+            "x": np.arange(n, dtype=np.int64),
+        }
+
+    def test_balanced_single_chunk(self):
+        chunks = partition_batch_spill(self._cols(np.arange(16)), 4, 4)
+        assert len(chunks) == 1
+        out, rows, pos = chunks[0]
+        assert out["__valid__"].all()
+        np.testing.assert_array_equal(np.sort(rows), np.arange(16))
+        # row i landed at pos[i]; payload column follows
+        np.testing.assert_array_equal(out["x"][pos], rows)
+
+    def test_hot_key_spills(self):
+        # every row hits shard 1: 10 rows / capacity 4 → 3 chunks
+        chunks = partition_batch_spill(self._cols(np.full(10, 5)), 4, 4)
+        assert len(chunks) == 3
+        sizes = [len(rows) for _, rows, _ in chunks]
+        assert sizes == [4, 4, 2]
+        # every input row appears exactly once across chunks
+        all_rows = np.concatenate([rows for _, rows, _ in chunks])
+        np.testing.assert_array_equal(np.sort(all_rows), np.arange(10))
+        # payload stays row-aligned in every chunk
+        for out, rows, pos in chunks:
+            np.testing.assert_array_equal(out["x"][pos], rows)
+
+    def test_empty_batch(self):
+        chunks = partition_batch_spill(self._cols(np.array([])), 4, 4)
+        assert len(chunks) == 1
+        assert not chunks[0][0]["__valid__"].any()
+
+
+def test_sharded_engine_matches_single_chip(small_dataset):
+    """Same stream, same model: 8-device serve must reproduce the
+    single-chip probabilities (and hence AUC) exactly."""
+    _, _, _, txs = small_dataset
+    part = txs.slice(slice(0, 6144))
+    cfg = _cfg()
+    params, scaler = _model()
+
+    s1, s8 = MemorySink(), MemorySink()
+    ScoringEngine(cfg, kind="logreg", params=params, scaler=scaler).run(
+        ReplaySource(part, EPOCH0, batch_rows=1024), sink=s1)
+    eng = ShardedScoringEngine(cfg, kind="logreg", params=params,
+                               scaler=scaler, n_devices=N_DEV)
+    stats = eng.run(ReplaySource(part, EPOCH0, batch_rows=1024), sink=s8)
+    assert stats["batches"] > 1  # a real multi-batch stream, not one step
+
+    out1, out8 = s1.concat(), s8.concat()
+    a, b = np.argsort(out1["tx_id"]), np.argsort(out8["tx_id"])
+    np.testing.assert_array_equal(out1["tx_id"][a], out8["tx_id"][b])
+    np.testing.assert_allclose(out1["prediction"][a],
+                               out8["prediction"][b], atol=1e-6)
+    y = part.tx_fraud
+    order = np.argsort(part.tx_id)
+    auc1 = roc_auc(y[order], out1["prediction"][a])
+    auc8 = roc_auc(y[order], out8["prediction"][b])
+    assert auc1 == pytest.approx(auc8, abs=1e-9)
+
+
+def test_sharded_engine_forest_kind(small_dataset):
+    """The flagship forest scorer serves sharded too (replicated params,
+    GEMM classify per shard)."""
+    _, _, _, txs = small_dataset
+    part = txs.slice(slice(0, 2048))
+    cfg = _cfg()
+    from real_time_fraud_detection_system_tpu.models.forest import fit_forest
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (512, 15))
+    yy = (x[:, 0] > 0.5).astype(np.int32)
+    ens = fit_forest(x, yy, n_trees=10, max_depth=4)
+    _, scaler = _model()
+
+    s1, s8 = MemorySink(), MemorySink()
+    ScoringEngine(cfg, kind="forest", params=ens, scaler=scaler).run(
+        ReplaySource(part, EPOCH0, batch_rows=1024), sink=s1)
+    ShardedScoringEngine(cfg, kind="forest", params=ens, scaler=scaler,
+                         n_devices=N_DEV).run(
+        ReplaySource(part, EPOCH0, batch_rows=1024), sink=s8)
+    out1, out8 = s1.concat(), s8.concat()
+    a, b = np.argsort(out1["tx_id"]), np.argsort(out8["tx_id"])
+    np.testing.assert_allclose(out1["prediction"][a],
+                               out8["prediction"][b], atol=1e-6)
+
+
+def test_sharded_engine_absorbs_hot_key(small_dataset):
+    """A single dominant customer (shard overflow) must spill into extra
+    sub-steps, not kill the stream."""
+    _, _, _, txs = small_dataset
+    cfg = _cfg(max_rows=512)
+    params, scaler = _model()
+    n = 512
+    cols = {
+        "tx_id": np.arange(n, dtype=np.int64),
+        "tx_datetime_us": (20200 * 86_400_000_000
+                           + np.arange(n, dtype=np.int64) * 1_000_000),
+        "customer_id": np.full(n, 3, dtype=np.int64),  # ONE hot customer
+        "terminal_id": (np.arange(n) % 7).astype(np.int64),
+        "tx_amount_cents": np.full(n, 1000, dtype=np.int64),
+        "kafka_ts_ms": np.zeros(n, dtype=np.int64),
+    }
+    eng = ShardedScoringEngine(cfg, kind="logreg", params=params,
+                               scaler=scaler, n_devices=N_DEV)
+    res = eng.process_batch(cols)
+    assert len(res.probs) == n
+    assert np.isfinite(res.probs).all()
+    # the hot shard's load (512 rows) far exceeds rows_per_shard (128×2)
+    assert eng.rows_per_shard < n
+
+
+def test_sharded_engine_checkpoint_roundtrip(small_dataset, tmp_path):
+    """Crash-resume: restore re-shards the state and the stream continues
+    to the same outputs as an uninterrupted run."""
+    _, _, _, txs = small_dataset
+    part = txs.slice(slice(0, 3072))
+    cfg = _cfg()
+    params, scaler = _model()
+
+    clean = MemorySink()
+    ShardedScoringEngine(cfg, kind="logreg", params=params, scaler=scaler,
+                         n_devices=N_DEV).run(
+        ReplaySource(part, EPOCH0, batch_rows=1024), sink=clean)
+
+    # Run 1: stop after 1 batch, checkpoint.
+    ck = Checkpointer(str(tmp_path / "ck"))
+    sink = MemorySink()
+    eng = ShardedScoringEngine(cfg, kind="logreg", params=params,
+                               scaler=scaler, n_devices=N_DEV)
+    src = ReplaySource(part, EPOCH0, batch_rows=1024)
+    eng.run(src, sink=sink, checkpointer=ck, max_batches=1)
+    ck.save(eng.state)
+
+    # Run 2: fresh engine, restore, finish the stream.
+    eng2 = ShardedScoringEngine(cfg, kind="logreg", params=params,
+                                scaler=scaler, n_devices=N_DEV)
+    assert ck.restore(eng2.state) is not None
+    src2 = ReplaySource(part, EPOCH0, batch_rows=1024)
+    src2.seek(eng2.state.offsets)
+    eng2.run(src2, sink=sink)
+
+    out, ref = sink.concat(), clean.concat()
+    a, b = np.argsort(out["tx_id"]), np.argsort(ref["tx_id"])
+    assert len(out["tx_id"]) == len(ref["tx_id"])
+    np.testing.assert_allclose(out["prediction"][a], ref["prediction"][b],
+                               atol=1e-6)
+
+
+def test_sharded_engine_feedback_loop(small_dataset):
+    """The labeled-feedback topic composes with the sharded engine: late
+    fraud labels raise the (owner-partitioned) terminal risk windows."""
+    from real_time_fraud_detection_system_tpu.core.batch import US_PER_DAY
+    from real_time_fraud_detection_system_tpu.features.spec import (
+        FEATURE_NAMES,
+    )
+    from real_time_fraud_detection_system_tpu.runtime import (
+        FEEDBACK_TOPIC,
+        FeatureCache,
+        FeedbackLoop,
+        InProcBroker,
+    )
+    from real_time_fraud_detection_system_tpu.runtime import (
+        encode_feedback_envelopes,
+    )
+
+    cfg = _cfg(max_rows=512)
+    params, scaler = _model()
+    cache = FeatureCache(capacity=1 << 10)
+    eng = ShardedScoringEngine(cfg, kind="logreg", params=params,
+                               scaler=scaler, n_devices=N_DEV,
+                               feature_cache=cache)
+    delay = cfg.features.delay_days
+    day0 = 20200
+    n = 8
+
+    def cols_for(day, tx0):
+        return {
+            "tx_id": np.arange(tx0, tx0 + n, dtype=np.int64),
+            "tx_datetime_us": np.full(n, day, np.int64) * US_PER_DAY + 1,
+            "customer_id": np.arange(n, dtype=np.int64),
+            "terminal_id": np.full(n, 7, dtype=np.int64),
+            "tx_amount_cents": np.full(n, 1000, dtype=np.int64),
+            "kafka_ts_ms": np.zeros(n, dtype=np.int64),
+        }
+
+    eng.process_batch(cols_for(day0, 0))
+    broker = InProcBroker(2)
+    broker.produce_many(
+        FEEDBACK_TOPIC, [b""] * n,
+        encode_feedback_envelopes(np.arange(n), np.ones(n, np.int64)),
+    )
+    assert FeedbackLoop(eng, broker).poll_and_apply() == n
+    res = eng.process_batch(cols_for(day0 + delay + 1, 100))
+    risk_cols = [i for i, nm in enumerate(FEATURE_NAMES) if "RISK" in nm]
+    assert res.features[:, risk_cols].max() > 0
+    # risk is a fraction: n frauds / n transactions at that terminal = 1
+    assert res.features[:, risk_cols].max() <= 1.0 + 1e-6
+
+
+def test_sharded_engine_online_sgd_updates_params(small_dataset):
+    """In-band labels drive the psum'd online-SGD path: params move and
+    stay replicated across the mesh."""
+    _, _, _, txs = small_dataset
+    part = txs.slice(slice(0, 1024))
+    cfg = _cfg()
+    params, scaler = _model()
+    eng = ShardedScoringEngine(cfg, kind="logreg", params=params,
+                               scaler=scaler, n_devices=N_DEV,
+                               online_lr=1e-2)
+    w0 = np.asarray(params.w).copy()
+    eng.run(ReplaySource(part, EPOCH0, batch_rows=1024, with_labels=True))
+    w1 = np.asarray(eng.state.params.w)
+    assert not np.allclose(w0, w1)  # learning happened
+    assert np.isfinite(w1).all()
+
+
+def test_sharded_engine_rejects_indivisible_capacity():
+    cfg = Config(
+        features=FeatureConfig(customer_capacity=500,  # not /8
+                               terminal_capacity=1024),
+    )
+    params, scaler = _model()
+    with pytest.raises(ValueError, match="customer_capacity"):
+        ShardedScoringEngine(cfg, kind="logreg", params=params,
+                             scaler=scaler, n_devices=N_DEV)
