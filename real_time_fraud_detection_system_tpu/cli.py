@@ -133,7 +133,10 @@ def cmd_score(args) -> int:
     from real_time_fraud_detection_system_tpu.utils import get_logger
 
     log = get_logger("score")
-    txs = load_transactions(args.data)
+    if args.source != "kafka" and not args.data:
+        log.error("--data is required unless --source kafka")
+        return 2
+    txs = load_transactions(args.data) if args.data else None
     model = load_model(args.model_file)
     cfg = Config()
     cpu_model = None
@@ -170,40 +173,83 @@ def cmd_score(args) -> int:
             online_lr=args.online_lr,
         )
 
-    source = ReplaySource(
-        txs,
-        _start_epoch_s(args.start_date),
-        batch_rows=args.batch_rows,
-        mode=args.mode,
-        with_labels=args.online_lr > 0,
-    )
+    source_factory = None
+    if args.source == "kafka":
+        from real_time_fraud_detection_system_tpu.runtime.sources import (
+            make_kafka_source,
+        )
+
+        def source_factory():
+            # Fresh consumer per incarnation: a zombie session's partitions
+            # are fenced off by the broker's group generation.
+            return make_kafka_source(
+                args.bootstrap, topic=args.topic,
+                batch_rows=args.batch_rows,
+                idle_timeout_s=args.idle_timeout or None,
+            )
+
+        source = source_factory()
+    else:
+        source = ReplaySource(
+            txs,
+            _start_epoch_s(args.start_date),
+            batch_rows=args.batch_rows,
+            mode=args.mode,
+            with_labels=args.online_lr > 0,
+        )
     ckpt = Checkpointer(args.checkpoint_dir) if args.checkpoint_dir else None
     sink = ParquetSink(args.out) if args.out else None
+    raw_table = None
+    if args.raw_table:
+        from real_time_fraud_detection_system_tpu.io import (
+            RawTransactionsTable,
+        )
+        from real_time_fraud_detection_system_tpu.io.sink import FanoutSink
+
+        raw_table = RawTransactionsTable(args.raw_table,
+                                         flush_every_batches=64)
+        sink = FanoutSink(sink, raw_table)
     if args.max_restarts > 0 and ckpt is None:
         log.error("--max-restarts requires --checkpoint-dir "
                   "(there is nothing to recover from without checkpoints)")
         return 2
-    if ckpt is not None and args.max_restarts > 0:
-        # Supervised mode: restart-on-failure with checkpoint replay
-        # (the compose `restart: on-failure` + Spark checkpoint contract).
-        from real_time_fraud_detection_system_tpu.runtime.faults import (
-            run_with_recovery,
-        )
+    if args.stall_timeout > 0 and not (args.max_restarts > 0 and ckpt):
+        log.error("--stall-timeout requires supervised mode "
+                  "(--max-restarts with --checkpoint-dir); without it the "
+                  "watchdog has no restart path to escalate into")
+        return 2
+    try:
+        if ckpt is not None and args.max_restarts > 0:
+            # Supervised mode: restart-on-failure with checkpoint replay
+            # (the compose `restart: on-failure` + Spark checkpoint
+            # contract).
+            from real_time_fraud_detection_system_tpu.runtime.faults import (
+                run_with_recovery,
+            )
 
-        stats = run_with_recovery(
-            make_engine, source, ckpt, sink=sink,
-            max_restarts=args.max_restarts, max_batches=args.max_batches,
-            resume=args.resume,
-        )
-    else:
-        engine = make_engine()
-        if ckpt is not None and args.resume:
-            restored = ckpt.restore(engine.state)
-            if restored is not None:
-                source.seek(engine.state.offsets)
-                log.info("resumed from batch %d", engine.state.batches_done)
-        stats = engine.run(source, sink=sink, checkpointer=ckpt,
-                           max_batches=args.max_batches)
+            stats = run_with_recovery(
+                make_engine, source, ckpt, sink=sink,
+                max_restarts=args.max_restarts, max_batches=args.max_batches,
+                resume=args.resume, stall_timeout_s=args.stall_timeout,
+                make_source=source_factory,
+            )
+        else:
+            engine = make_engine()
+            if ckpt is not None and args.resume:
+                restored = ckpt.restore(engine.state)
+                if restored is not None:
+                    source.seek(engine.state.offsets)
+                    log.info("resumed from batch %d",
+                             engine.state.batches_done)
+            stats = engine.run(source, sink=sink, checkpointer=ckpt,
+                               max_batches=args.max_batches)
+    finally:
+        close = getattr(source, "close", None)
+        if close is not None:
+            close()
+    if raw_table is not None:
+        raw_table.flush()
+        stats["raw_tx_rows"] = len(raw_table)
     log.info("done: %s", stats)
     print(_json_line({"scorer": args.scorer, **stats}))
     return 0
@@ -326,11 +372,25 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("score", help="stream-score a table through the engine")
-    p.add_argument("--data", required=True)
+    p.add_argument("--data", default="",
+                   help="transactions .npz (required unless --source kafka)")
     p.add_argument("--model-file", required=True)
     p.add_argument("--scorer", default="tpu", choices=["cpu", "tpu"])
     p.add_argument("--mode", default="columnar", choices=["columnar", "envelope"])
+    p.add_argument("--source", default="replay", choices=["replay", "kafka"],
+                   help="replay a generated table, or consume the Debezium "
+                        "transaction topic from a real Kafka cluster")
+    p.add_argument("--bootstrap", default="localhost:9092",
+                   help="Kafka bootstrap servers (--source kafka)")
+    p.add_argument("--topic", default="debezium.payment.transactions")
+    p.add_argument("--idle-timeout", type=float, default=0.0,
+                   help="stop when the Kafka topic is idle this long "
+                        "(0 = serve forever)")
     p.add_argument("--out", default="")
+    p.add_argument("--raw-table", default="",
+                   help="also land raw transactions in a day-partitioned "
+                        "parquet table at this directory (the reference's "
+                        "nessie.payment.transactions)")
     p.add_argument("--batch-rows", type=int, default=4096)
     p.add_argument("--start-date", default="2025-04-01")
     p.add_argument("--checkpoint-dir", default="")
@@ -340,6 +400,10 @@ def main(argv=None) -> int:
     p.add_argument("--max-restarts", type=int, default=0,
                    help="supervised mode: restart-on-failure with "
                         "checkpoint replay (requires --checkpoint-dir)")
+    p.add_argument("--stall-timeout", type=float, default=0.0,
+                   help="watchdog: restart the engine if it makes no "
+                        "progress for this many seconds (supervised mode "
+                        "only; 0 = off)")
     p.add_argument("--devices", type=int, default=1,
                    help="serve on an N-device mesh (sharded engine: "
                         "customer-partitioned rows, all_to_all terminal "

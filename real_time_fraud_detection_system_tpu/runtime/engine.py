@@ -371,8 +371,13 @@ class ScoringEngine:
         max_batches: int = 0,
         checkpointer=None,
         trigger_seconds: Optional[float] = None,
+        heartbeat=None,
     ) -> dict:
         """Stream until the source is exhausted (or max_batches).
+
+        ``heartbeat`` (a :class:`~.faults.Heartbeat`) is beaten once per
+        loop pass — including idle polls — so a watchdog can tell a quiet
+        stream from a silently hung source or device step.
 
         Returns run stats (rows, batches, throughput, latency percentiles).
         """
@@ -384,11 +389,20 @@ class ScoringEngine:
         latencies: List[float] = []
         t_start = time.perf_counter()
         while True:
+            if heartbeat is not None:
+                heartbeat.beat()
             if max_batches and self.state.batches_done >= max_batches:
                 break
             cols = source.poll_batch()
             if cols is None:
                 break
+            if len(next(iter(cols.values()), ())) == 0:
+                # Idle live source (e.g. KafkaSource on a quiet topic):
+                # not a batch — no sink append, no step, no checkpoint
+                # cadence, no max_batches consumption. Just wait a trigger.
+                if trigger > 0:
+                    time.sleep(trigger)
+                continue
             res = self.process_batch(cols)
             self.state.offsets = list(source.offsets)
             latencies.append(res.latency_s)
@@ -401,6 +415,13 @@ class ScoringEngine:
                 == 0
             ):
                 checkpointer.save(self.state)
+                # Broker-side offsets (sources that have them, e.g. Kafka)
+                # are committed only AFTER the framework checkpoint lands:
+                # they trail it, never lead, so a crash replays — never
+                # skips — rows.
+                commit = getattr(source, "commit", None)
+                if commit is not None:
+                    commit()
             if trigger > 0:
                 time.sleep(max(0.0, trigger - res.latency_s))
         wall = time.perf_counter() - t_start
