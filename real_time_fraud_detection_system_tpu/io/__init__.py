@@ -1,8 +1,14 @@
 from real_time_fraud_detection_system_tpu.io.sink import (  # noqa: F401
     ConsoleSink,
+    IcebergSink,
     MemorySink,
     ParquetSink,
+    make_iceberg_sink,
 )
 from real_time_fraud_detection_system_tpu.io.checkpoint import (  # noqa: F401
     Checkpointer,
+)
+from real_time_fraud_detection_system_tpu.io.tables import (  # noqa: F401
+    RawTransactionsTable,
+    UpsertTable,
 )

@@ -47,6 +47,24 @@ def _result_to_columns(res) -> dict:
     return cols
 
 
+class FanoutSink:
+    """Append to several sinks; ``flush()`` propagates to those that have it
+    (the raw-transactions table needs a flush; Parquet/memory don't)."""
+
+    def __init__(self, *sinks):
+        self.sinks = [s for s in sinks if s is not None]
+
+    def append(self, res) -> None:
+        for s in self.sinks:
+            s.append(res)
+
+    def flush(self) -> None:
+        for s in self.sinks:
+            f = getattr(s, "flush", None)
+            if f is not None:
+                f()
+
+
 class MemorySink:
     def __init__(self):
         self.batches: List[dict] = []
@@ -116,14 +134,109 @@ class ParquetSink:
         return {c: table[c].to_numpy() for c in table.column_names}
 
 
-def make_iceberg_sink(*args, **kwargs):  # pragma: no cover - gated
-    """Iceberg catalog append (pyiceberg not present in this image)."""
-    try:
-        import pyiceberg  # noqa: F401
-    except ImportError as e:
-        raise ImportError(
-            "pyiceberg is not installed; ParquetSink output is Iceberg-"
-            "compatible (add files to a table via any catalog), or install "
-            "pyiceberg in production images."
-        ) from e
-    raise NotImplementedError
+class IcebergSink:
+    """Append scored rows to an Iceberg ``analyzed_transactions`` table.
+
+    The reference's scorer streams into ``nessie.payment.
+    analyzed_transactions`` (DDL at ``fraud_detection.py:136-163``,
+    appended at ``:204-211``), which Trino/Superset read. This sink
+    appends the same column layout through a pyiceberg catalog:
+    timestamps as µs-precision Arrow timestamps, amount/prediction as
+    doubles, window counts as int32.
+
+    ``catalog`` is injectable (duck-typed ``load_table``/``create_table``)
+    so tests run against a fake without pyiceberg; production use goes
+    through :func:`make_iceberg_sink`, which builds a real catalog from
+    ``pyiceberg.catalog.load_catalog``.
+    """
+
+    TABLE_DEFAULT = "payment.analyzed_transactions"
+
+    def __init__(self, catalog, table_name: str = TABLE_DEFAULT):
+        self.catalog = catalog
+        self.table_name = table_name
+        self.table = self._load_or_create(catalog, table_name)
+
+    @staticmethod
+    def arrow_schema():
+        import pyarrow as pa
+
+        fields = [
+            ("tx_id", pa.int64()),
+            ("tx_datetime", pa.timestamp("us")),
+            ("customer_id", pa.int64()),
+            ("terminal_id", pa.int64()),
+            ("tx_amount", pa.float64()),
+        ]
+        for name in FEATURE_NAMES:
+            if name == "TX_AMOUNT":
+                continue
+            t = (
+                pa.int32()
+                if ("NB_TX" in name or "DURING" in name)
+                else pa.float64()
+            )
+            fields.append((name.lower(), t))
+        fields += [
+            ("processed_at", pa.timestamp("us")),
+            ("prediction", pa.float64()),
+        ]
+        return pa.schema(fields)
+
+    def _load_or_create(self, catalog, name: str):
+        exists = getattr(catalog, "table_exists", None)
+        if exists is not None and not exists(name):
+            return catalog.create_table(name, schema=self.arrow_schema())
+        try:
+            return catalog.load_table(name)
+        except Exception as e:
+            # Only a missing table warrants create; transient catalog
+            # errors (network/auth) must surface, not turn into a
+            # confusing create-conflict downstream.
+            if type(e).__name__ in ("NoSuchTableError", "KeyError"):
+                return catalog.create_table(name, schema=self.arrow_schema())
+            raise
+
+    def _to_arrow(self, res):
+        import pyarrow as pa
+
+        cols = _result_to_columns(res)
+        arrays, names = [], []
+        for field in self.arrow_schema():
+            if field.name == "tx_datetime":
+                v = cols["tx_datetime_us"]
+            elif field.name == "processed_at":
+                v = cols["processed_at_us"]
+            else:
+                v = cols[field.name]
+            arrays.append(pa.array(v).cast(field.type))
+            names.append(field.name)
+        return pa.table(dict(zip(names, arrays)))
+
+    def append(self, res) -> None:
+        self.table.append(self._to_arrow(res))
+
+
+def make_iceberg_sink(
+    table_name: str = IcebergSink.TABLE_DEFAULT,
+    catalog_name: str = "default",
+    catalog: Optional[object] = None,
+    **catalog_props,
+) -> IcebergSink:
+    """Production Iceberg sink factory (import-gated on pyiceberg).
+
+    ``catalog_props`` go straight to ``pyiceberg.catalog.load_catalog``
+    (URI, warehouse, credentials — the values the reference spreads over
+    ``docker-compose.yml:58-68`` and every SparkConf block).
+    """
+    if catalog is None:
+        try:
+            from pyiceberg.catalog import load_catalog
+        except ImportError as e:
+            raise ImportError(
+                "pyiceberg is not installed; ParquetSink output is Iceberg-"
+                "compatible (add files to a table via any catalog), or "
+                "install pyiceberg in production images."
+            ) from e
+        catalog = load_catalog(catalog_name, **catalog_props)
+    return IcebergSink(catalog, table_name)

@@ -162,26 +162,34 @@ def run_demo(
         engine.run(warm_src)  # state warm-up, scores discarded
 
     sink = None
+    raw_table = None
     if out_dir is not None:
+        import os
+
         from real_time_fraud_detection_system_tpu.io.sink import ParquetSink
+        from real_time_fraud_detection_system_tpu.io.tables import (
+            RawTransactionsTable,
+        )
 
         sink = ParquetSink(out_dir)
-    from real_time_fraud_detection_system_tpu.io.sink import MemorySink
+        # The persistent raw-transactions table (the reference's
+        # day-partitioned nessie.payment.transactions) lands beside the
+        # analyzed output.
+        raw_table = RawTransactionsTable(os.path.join(out_dir, "transactions"))
+    from real_time_fraud_detection_system_tpu.io.sink import (
+        FanoutSink,
+        MemorySink,
+    )
 
     mem = MemorySink()
-
-    class _Tee:
-        def append(self, res):
-            mem.append(res)
-            if sink is not None:
-                sink.append(res)
+    tee = FanoutSink(mem, sink, raw_table)
 
     src = ReplaySource(
         stream, epoch0, batch_rows=batch_rows, mode="envelope",
         n_partitions=cfg.runtime.n_partitions,
     )
     rows_before = engine.state.rows_done
-    stats = engine.run(src, sink=_Tee())
+    stats = engine.run(src, sink=tee)
     streamed_rows = int(stats["rows"]) - int(rows_before)
     rows_per_s = streamed_rows / stats["wall_s"] if stats["wall_s"] > 0 else 0.0
 
@@ -208,9 +216,12 @@ def run_demo(
 
     auc = roc_auc(stream_labels[pos_c[ok]], probs[ok])
 
+    tee.flush()
+
     summary = {
         "customers": len(customer_table),
         "terminals": len(terminal_table),
+        "raw_tx_rows": len(raw_table) if raw_table is not None else 0,
         "warm_rows": int(warm.n),
         "streamed_rows": streamed_rows,
         "rows_per_s": float(rows_per_s),

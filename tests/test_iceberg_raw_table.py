@@ -1,0 +1,218 @@
+"""IcebergSink (fake catalog) and RawTransactionsTable tests.
+
+The reference lands every scored row in ``nessie.payment.
+analyzed_transactions`` (``fraud_detection.py:134-163,204-211``) and keeps
+a day-partitioned raw ``nessie.payment.transactions``
+(``load_initial_data.py:231``). pyiceberg is not in this image, so the
+sink is tested against a duck-typed fake catalog — the production code
+path (schema build, arrow conversion, create-vs-load) runs unmodified.
+"""
+
+import numpy as np
+import pytest
+
+from real_time_fraud_detection_system_tpu.io.sink import IcebergSink
+from real_time_fraud_detection_system_tpu.io.tables import (
+    RawTransactionsTable,
+)
+from real_time_fraud_detection_system_tpu.runtime.engine import BatchResult
+
+US_PER_DAY = 86400 * 1_000_000
+
+
+def _mk_result(n=16, seed=0, day0=20200):
+    rng = np.random.default_rng(seed)
+    t_us = (
+        day0 * US_PER_DAY
+        + rng.integers(0, 3 * US_PER_DAY, n).astype(np.int64)
+    )
+    return BatchResult(
+        tx_id=np.arange(n, dtype=np.int64) + seed * 1000,
+        tx_datetime_us=t_us,
+        customer_id=rng.integers(0, 50, n).astype(np.int64),
+        terminal_id=rng.integers(0, 80, n).astype(np.int64),
+        amount_cents=rng.integers(100, 30000, n).astype(np.int64),
+        features=rng.normal(0, 1, (n, 15)).astype(np.float32),
+        probs=rng.uniform(0, 1, n),
+        latency_s=0.001,
+    )
+
+
+class FakeTable:
+    def __init__(self, name, schema):
+        self.name = name
+        self.schema = schema
+        self.appended = []
+
+    def append(self, arrow_table):
+        assert arrow_table.schema.equals(self.schema)
+        self.appended.append(arrow_table)
+
+
+class FakeCatalog:
+    def __init__(self):
+        self.tables = {}
+
+    def table_exists(self, name):
+        return name in self.tables
+
+    def create_table(self, name, schema):
+        assert name not in self.tables
+        t = FakeTable(name, schema)
+        self.tables[name] = t
+        return t
+
+    def load_table(self, name):
+        return self.tables[name]
+
+
+def test_iceberg_sink_creates_and_appends():
+    import pyarrow as pa
+
+    cat = FakeCatalog()
+    sink = IcebergSink(cat)
+    assert "payment.analyzed_transactions" in cat.tables
+    res = _mk_result(n=20)
+    sink.append(res)
+    sink.append(_mk_result(n=8, seed=1))
+    t = cat.tables["payment.analyzed_transactions"]
+    assert sum(a.num_rows for a in t.appended) == 28
+    # Column layout matches the reference DDL: µs timestamps, f64 money.
+    schema = t.appended[0].schema
+    assert schema.field("tx_datetime").type == pa.timestamp("us")
+    assert schema.field("processed_at").type == pa.timestamp("us")
+    assert schema.field("tx_amount").type == pa.float64()
+    assert schema.field("prediction").type == pa.float64()
+    assert schema.field("customer_id_nb_tx_7day_window").type == pa.int32()
+    got = t.appended[0]["tx_amount"].to_numpy()
+    np.testing.assert_allclose(got, res.amount_cents / 100.0)
+
+
+def test_iceberg_sink_loads_existing_table():
+    cat = FakeCatalog()
+    s1 = IcebergSink(cat)
+    s1.append(_mk_result())
+    s2 = IcebergSink(cat)  # restart: must load, not clobber
+    assert s2.table is s1.table
+    s2.append(_mk_result(seed=2))
+    assert len(s1.table.appended) == 2
+
+
+def test_make_iceberg_sink_gated_without_pyiceberg():
+    from real_time_fraud_detection_system_tpu.io.sink import (
+        make_iceberg_sink,
+    )
+
+    with pytest.raises(ImportError, match="pyiceberg"):
+        make_iceberg_sink()
+    # Injected catalog bypasses the gate.
+    sink = make_iceberg_sink(catalog=FakeCatalog())
+    assert isinstance(sink, IcebergSink)
+
+
+def test_raw_table_day_partitions_roundtrip(tmp_path):
+    d = str(tmp_path / "transactions")
+    tab = RawTransactionsTable(d)
+    res = _mk_result(n=64)
+    tab.append(res)
+    assert tab.flush() >= 1
+    files = sorted(p.name for p in (tmp_path / "transactions").iterdir())
+    assert all(f.startswith("tx_date=2025-") for f in files)
+    back = tab.read_all()
+    assert sorted(back["tx_id"].tolist()) == sorted(res.tx_id.tolist())
+    order_a = np.argsort(back["tx_id"])
+    order_b = np.argsort(res.tx_id)
+    np.testing.assert_array_equal(
+        back["tx_amount_cents"][order_a], res.amount_cents[order_b]
+    )
+    # Partition pruning: each file holds only its day's rows.
+    import pyarrow.parquet as pq
+
+    for f in (tmp_path / "transactions").glob("tx_date=*/part-*.parquet"):
+        t = pq.read_table(str(f))
+        days = t["tx_datetime_us"].to_numpy() // US_PER_DAY
+        assert len(np.unique(days)) == 1
+
+
+def test_raw_table_replay_is_idempotent(tmp_path):
+    tab = RawTransactionsTable(str(tmp_path / "t"))
+    res = _mk_result(n=32)
+    tab.append(res)
+    n1 = len(tab)
+    tab.append(res)  # checkpoint-restore replay of the same batch
+    assert len(tab) == n1
+    tab.flush()
+    assert len(tab.read_all()["tx_id"]) == n1
+
+
+def test_raw_table_merge_latest_wins(tmp_path):
+    tab = RawTransactionsTable(str(tmp_path / "t"))
+    cols = {
+        "tx_id": np.array([1, 2], dtype=np.int64),
+        "tx_datetime_us": np.array([10 * US_PER_DAY] * 2, dtype=np.int64),
+        "customer_id": np.array([5, 6], dtype=np.int64),
+        "terminal_id": np.array([7, 8], dtype=np.int64),
+        "tx_amount_cents": np.array([100, 200], dtype=np.int64),
+    }
+    tab.merge(cols, ts=np.array([1, 1], dtype=np.int64))
+    upd = dict(cols)
+    upd["tx_amount_cents"] = np.array([999, 888], dtype=np.int64)
+    tab.merge(upd, ts=np.array([2, 0], dtype=np.int64))  # tx 2 is stale
+    tab.flush()
+    back = tab.read_all()
+    amounts = dict(zip(back["tx_id"].tolist(),
+                       back["tx_amount_cents"].tolist()))
+    assert amounts == {1: 999, 2: 200}
+
+
+def test_raw_table_incremental_parts(tmp_path):
+    """Each flush writes only the delta; earlier parts are never
+    rewritten (O(rows) streaming cost, not O(rows²))."""
+    import pyarrow.parquet as pq
+
+    tab = RawTransactionsTable(str(tmp_path / "t"))
+    tab.append(_mk_result(n=100, seed=0))
+    tab.flush()
+    first = {f: f.stat().st_mtime_ns
+             for f in (tmp_path / "t").glob("tx_date=*/part-*.parquet")}
+    assert first
+    tab.append(_mk_result(n=100, seed=5))  # disjoint tx_ids
+    tab.flush()
+    after = list((tmp_path / "t").glob("tx_date=*/part-*.parquet"))
+    assert len(after) > len(first)
+    for f, mtime in first.items():  # old parts untouched
+        assert f.stat().st_mtime_ns == mtime
+    new_rows = sum(pq.read_table(str(f)).num_rows
+                   for f in after if f not in first)
+    assert new_rows == 100  # delta only, no rewrite of the first 100
+    assert len(tab.read_all()["tx_id"]) == 200
+
+
+def test_raw_table_update_across_flushes_latest_wins(tmp_path):
+    tab = RawTransactionsTable(str(tmp_path / "t"))
+    cols = {
+        "tx_id": np.array([7], dtype=np.int64),
+        "tx_datetime_us": np.array([10 * US_PER_DAY], dtype=np.int64),
+        "customer_id": np.array([1], dtype=np.int64),
+        "terminal_id": np.array([2], dtype=np.int64),
+        "tx_amount_cents": np.array([100], dtype=np.int64),
+    }
+    tab.merge(cols, ts=np.array([1], dtype=np.int64))
+    tab.flush()
+    upd = dict(cols)
+    upd["tx_amount_cents"] = np.array([555], dtype=np.int64)
+    tab.merge(upd, ts=np.array([2], dtype=np.int64))
+    tab.flush()
+    parts = list((tmp_path / "t").glob("tx_date=*/part-*.parquet"))
+    assert len(parts) == 2  # both versions on disk (merge-on-read)
+    back = tab.read_all()
+    assert back["tx_id"].tolist() == [7]
+    assert back["tx_amount_cents"].tolist() == [555]
+
+
+def test_raw_table_auto_flush(tmp_path):
+    tab = RawTransactionsTable(str(tmp_path / "t"), flush_every_batches=2)
+    tab.append(_mk_result(n=8, seed=0))
+    assert not list((tmp_path / "t").glob("tx_date=*"))
+    tab.append(_mk_result(n=8, seed=1))
+    assert list((tmp_path / "t").glob("tx_date=*"))
