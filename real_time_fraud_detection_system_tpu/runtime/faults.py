@@ -37,6 +37,10 @@ class TransientError(RuntimeError):
     """An injected or genuinely transient failure — safe to retry."""
 
 
+class StallError(TransientError):
+    """The watchdog found no engine progress within the stall budget."""
+
+
 @dataclass(frozen=True)
 class RetryPolicy:
     """Exponential backoff: delay = base * multiplier^attempt (capped)."""
@@ -80,11 +84,12 @@ def with_retries(
 class Heartbeat:
     """Progress-based failure detector (the compose healthcheck role).
 
-    ``beat()`` on every processed batch (:func:`run_with_recovery` wires
-    this automatically when given a heartbeat); ``healthy()`` is False once
-    ``timeout_s`` passes with no beat. Checking is the job of an external
-    monitor thread — the supervisor loop itself is synchronous and can only
-    react to crashes, not silent stalls.
+    ``beat()`` on every engine loop pass (:func:`run_with_recovery` wires
+    the heartbeat into ``engine.run``); ``healthy()`` is False once
+    ``timeout_s`` passes with no beat. :func:`run_with_recovery` watches it
+    from a supervisor thread and escalates a stall into the restart path
+    (:class:`StallError`) — a silently hung source or device step is
+    recovered from like a crash, not waited on forever.
     """
 
     def __init__(self, timeout_s: float = 60.0,
@@ -103,6 +108,43 @@ class Heartbeat:
 
     def seconds_since_beat(self) -> float:
         return self._clock() - self._last
+
+
+class HangingSource:
+    """Wraps a source; scripted poll indices HANG (block silently) instead
+    of raising — the failure mode retries can't see and only a watchdog
+    catches (a dead TPU tunnel, a wedged Kafka client, a stuck NFS read).
+
+    Each scripted hang fires once: the incarnation that hit it stays
+    blocked (until ``release`` or ``max_hang_s``), and the restarted
+    incarnation's polls proceed — modeling a connection that is re-opened
+    by the restart while the old one stays wedged.
+    """
+
+    def __init__(self, inner, hang_at: Sequence[int] = (),
+                 max_hang_s: float = 60.0):
+        import threading
+
+        self.inner = inner
+        self.hang_at = set(int(i) for i in hang_at)
+        self.max_hang_s = max_hang_s
+        self.release = threading.Event()
+        self._polls = 0
+
+    def poll_batch(self):
+        i = self._polls
+        self._polls += 1
+        if i in self.hang_at:
+            self.hang_at.discard(i)
+            self.release.wait(timeout=self.max_hang_s)  # silent stall
+        return self.inner.poll_batch()
+
+    @property
+    def offsets(self):
+        return self.inner.offsets
+
+    def seek(self, offsets):
+        self.inner.seek(offsets)
 
 
 class FlakySource:
@@ -214,26 +256,166 @@ class _FencedCheckpointer:
         return getattr(self.inner, name)
 
 
+class _AbandonFence:
+    """Shared flag: flipped when the watchdog abandons an incarnation."""
+
+    def __init__(self):
+        self.abandoned = False
+
+    def check(self) -> None:
+        if self.abandoned:
+            raise StallError("incarnation abandoned by the watchdog")
+
+
+class _FenceGuard:
+    """Proxy that cuts a zombie incarnation off from shared objects.
+
+    Every attribute access (method call, ``offsets`` property, heartbeat
+    ``beat``) first checks the fence: once the watchdog abandons the
+    incarnation, the zombie's next interaction with the source, sink,
+    checkpointer, or heartbeat raises :class:`StallError` inside the
+    zombie thread — it cannot steal batches from the restarted
+    incarnation, overwrite the live checkpoint with stale state, append
+    stale results, or mask real stalls by beating the shared heartbeat.
+    (Whole checkpoints are atomic snapshots, so a save that *completes*
+    just before abandonment is still consistent.)
+    """
+
+    def __init__(self, inner, fence: _AbandonFence):
+        object.__setattr__(self, "_inner", inner)
+        object.__setattr__(self, "_fence", fence)
+
+    def __getattr__(self, name):
+        fence = object.__getattribute__(self, "_fence")
+        inner = object.__getattribute__(self, "_inner")
+        attr = getattr(inner, name)
+        if callable(attr):
+            def _guarded(*a, **k):
+                fence.check()
+                return attr(*a, **k)
+
+            return _guarded
+        fence.check()
+        return attr
+
+
+class _GuardedSource(_FenceGuard):
+    """Source fence with a post-poll check.
+
+    Beyond the inherited pre-access checks, a poll that was already in
+    flight when the watchdog abandoned this incarnation needs one more
+    check AFTER it returns: when the hang finally releases, the zombie's
+    poll may have consumed rows from a source SHARED with the restarted
+    incarnation. The post-check drops that batch and kills the zombie —
+    at-most-one-batch loss in that double-fault race, never a mis-seek.
+    The clean fix is not sharing the cursor at all: pass ``make_source``
+    to :func:`run_with_recovery` so each incarnation owns a fresh source
+    session (what a real Kafka deployment gets from consumer-group
+    generation fencing: a zombie consumer's partitions are revoked, its
+    late poll cannot commit).
+    """
+
+    def poll_batch(self):
+        fence = object.__getattribute__(self, "_fence")
+        inner = object.__getattribute__(self, "_inner")
+        fence.check()
+        cols = inner.poll_batch()
+        fence.check()  # in-flight poll that outlived abandonment: drop
+        return cols
+
+
+def _run_watched(engine, source, sink, checkpointer, max_batches,
+                 heartbeat: Heartbeat):
+    """Run one engine incarnation under a stall watchdog.
+
+    The engine loop runs in a worker thread beating the heartbeat each
+    pass; this (supervisor) thread polls ``healthy()``. On a stall the
+    worker is ABANDONED — a thread blocked in a hung syscall/device call
+    cannot be killed — and :class:`StallError` escalates into the restart
+    path. The abandoned worker is fenced (:class:`_FenceGuard`): when its
+    hang eventually releases, its first touch of the shared source, sink,
+    checkpointer, or heartbeat raises and the zombie dies, instead of
+    corrupting the restarted incarnation's stream.
+    """
+    import threading
+
+    box: dict = {}
+    fence = _AbandonFence()
+    g_source = _GuardedSource(source, fence)
+    g_sink = _FenceGuard(sink, fence) if sink is not None else None
+    g_ckpt = _FenceGuard(checkpointer, fence) if checkpointer is not None \
+        else None
+    g_heartbeat = _FenceGuard(heartbeat, fence)
+
+    def _target():
+        try:
+            box["stats"] = engine.run(
+                g_source, sink=g_sink, checkpointer=g_ckpt,
+                max_batches=max_batches, heartbeat=g_heartbeat,
+            )
+        except BaseException as e:  # report into the supervisor thread
+            box["err"] = e
+
+    heartbeat.beat()  # incarnation start = progress
+    worker = threading.Thread(target=_target, daemon=True,
+                              name="engine-incarnation")
+    worker.start()
+    poll = min(max(heartbeat.timeout_s / 4.0, 0.01), 1.0)
+    while worker.is_alive():
+        worker.join(poll)
+        if worker.is_alive() and not heartbeat.healthy():
+            fence.abandoned = True
+            raise StallError(
+                f"no engine progress for "
+                f"{heartbeat.seconds_since_beat():.1f}s (stall budget "
+                f"{heartbeat.timeout_s:.1f}s); abandoning hung incarnation"
+            )
+    if "err" in box:
+        raise box["err"]
+    return box["stats"]
+
+
 def run_with_recovery(
     make_engine: Callable[[], object],
-    source,
-    checkpointer,
+    source=None,
+    checkpointer=None,
     sink=None,
     max_restarts: int = 3,
     max_batches: int = 0,
     heartbeat: Optional[Heartbeat] = None,
+    stall_timeout_s: float = 0.0,
     resume: bool = True,
+    make_source: Optional[Callable[[], object]] = None,
     recover_on: Tuple[Type[BaseException], ...] = (
         TransientError, OSError, ConnectionError,
     ),
 ) -> dict:
-    """Supervisor loop: run → on crash, restore last checkpoint and resume.
+    """Supervisor loop: run → on crash OR stall, restore checkpoint, resume.
 
     ``make_engine`` builds a fresh engine (state template) per incarnation;
     the checkpointer restores (offsets, feature state, params, scaler) into
     it and the source seeks to the checkpointed offsets, so every committed
     micro-batch is processed exactly once and uncommitted ones are replayed
     — Spark's checkpointLocation recovery contract (SURVEY §5.4).
+
+    Stall watchdog: pass ``stall_timeout_s`` (or a pre-built ``heartbeat``)
+    and each incarnation runs in a worker thread beating the heartbeat per
+    loop pass while the supervisor watches ``healthy()`` — a silently hung
+    source or device step (the failure retries can't see: no exception is
+    ever raised) is detected within the stall budget and recovered like a
+    crash. Without either, the loop is synchronous and reacts to
+    exceptions only.
+
+    ``make_source``: factory for a FRESH source per incarnation (the
+    restart re-seeks it to the checkpointed offsets). Strongly preferred
+    with the watchdog: an abandoned incarnation then owns a dead private
+    session and can never touch the live stream — the analogue of Kafka's
+    consumer-group generation fencing. With a single shared ``source``,
+    the fence still blocks a zombie's future accesses, but a poll that
+    was in flight at abandonment and later returns has already consumed
+    its rows: that batch is dropped (at-most-one-batch loss in a rare
+    double-fault race). At least one of ``source``/``make_source`` is
+    required.
 
     The sink must tolerate replayed batches (idempotent append by tx_id or
     latest-wins MERGE downstream, as in the reference's MERGE INTO).
@@ -246,22 +428,31 @@ def run_with_recovery(
     the exception types treated as recoverable; anything else propagates
     immediately (engine bugs should crash loudly, not restart-loop).
     """
+    if source is None and make_source is None:
+        raise ValueError("run_with_recovery needs a source or make_source")
     restarts = 0
+    if source is None:
+        source = make_source()
     initial_offsets = list(source.offsets)
     if not resume:
         checkpointer = _FencedCheckpointer(checkpointer)
-    if heartbeat is not None:
-        inner_sink = sink
-
-        class _BeatSink:
-            def append(self, res):
-                heartbeat.beat()
-                if inner_sink is not None:
-                    inner_sink.append(res)
-
-        sink = _BeatSink()
+    if heartbeat is None and stall_timeout_s > 0:
+        heartbeat = Heartbeat(timeout_s=stall_timeout_s)
+    last_was_stall = False
     while True:
         engine = make_engine()
+        if restarts > 0 and make_source is not None:
+            # Fresh source session per incarnation: the previous (possibly
+            # zombie) session is cut loose. Closed best-effort only after a
+            # CRASH — after a stall the zombie thread may still be blocked
+            # inside it and close() could hang the supervisor too.
+            close = getattr(source, "close", None)
+            if close is not None and not last_was_stall:
+                try:
+                    close()
+                except Exception:  # a dying session may not close cleanly
+                    pass
+            source = make_source()
         restored = None
         if resume or restarts > 0:
             # With resume=False the fence makes this a no-op until the
@@ -277,16 +468,26 @@ def run_with_recovery(
             # to the new (empty) feature state.
             source.seek(initial_offsets)
         try:
-            stats = engine.run(
-                source, sink=sink, checkpointer=checkpointer,
-                max_batches=max_batches,
-            )
+            if heartbeat is not None:
+                stats = _run_watched(
+                    engine, source, sink, checkpointer, max_batches,
+                    heartbeat,
+                )
+            else:
+                stats = engine.run(
+                    source, sink=sink, checkpointer=checkpointer,
+                    max_batches=max_batches,
+                )
             # Final checkpoint so a clean exit never replays.
             checkpointer.save(engine.state)
+            commit = getattr(source, "commit", None)
+            if commit is not None:
+                commit()
             stats["restarts"] = restarts
             return stats
         except recover_on as e:
             restarts += 1
+            last_was_stall = isinstance(e, StallError)
             log.warning("engine crashed (%s); restart %d/%d",
                         e, restarts, max_restarts)
             if restarts > max_restarts:
