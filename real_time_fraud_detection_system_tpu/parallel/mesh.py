@@ -47,19 +47,47 @@ def make_mesh(n_devices: int = 0, axis: str = "data") -> Mesh:
 def shard_feature_state(
     state: FeatureState, mesh: Mesh, axis: "str | tuple[str, ...]" = "data"
 ) -> FeatureState:
-    """Place window tables sharded along the slot axis, CMS replicated.
+    """Place window tables sharded along the slot axis; CMS sharded by
+    customer owner.
+
+    The sketch gets a leading device axis ([n_dev, ND, depth, width]):
+    rows are partitioned by ``customer_id % n_dev``, so each device keeps
+    a private sketch of ITS customers — updates and queries are purely
+    device-local (zero collectives on the hot path) and each sketch sees
+    ~1/n_dev of the key universe, so collisions (the CMS error term)
+    shrink as the mesh grows. A rank-base sketch (single-chip layout,
+    e.g. a restored single-chip checkpoint) is broadcast to every device
+    as a warm start — estimates stay valid upper bounds.
 
     ``axis`` may be one mesh axis name or a tuple (hybrid DCN×ICI meshes,
     see :mod:`.distributed`)."""
     row_sharded = NamedSharding(mesh, P(axis, None))
-    repl = NamedSharding(mesh, P())
+    dev_sharded = NamedSharding(mesh, P(axis))
 
     def place_windows(ws):
         return jax.tree.map(lambda a: jax.device_put(a, row_sharded), ws)
 
     cms = state.cms
     if cms is not None:
-        cms = jax.tree.map(lambda a: jax.device_put(a, repl), cms)
+        n_dev = int(mesh.devices.size)
+        if cms.slice_day.ndim == 1:  # single-chip layout: add device axis
+            # Build the per-device replicas shard-by-shard: each device
+            # materializes ONE [1, ...] copy of the base sketch — never
+            # n_dev copies on a single device (a production sketch is
+            # hundreds of MB; broadcasting would OOM exactly when the
+            # feature matters).
+            def _expand(leaf):
+                base = np.asarray(leaf)[None]
+                return jax.make_array_from_callback(
+                    (n_dev,) + leaf.shape, dev_sharded,
+                    lambda idx, b=base: b,
+                )
+
+            cms = jax.tree.map(_expand, cms)
+        else:
+            cms = jax.tree.map(
+                lambda a: jax.device_put(a, dev_sharded), cms
+            )
     return FeatureState(
         customer=place_windows(state.customer),
         terminal=place_windows(state.terminal),

@@ -46,21 +46,25 @@ from real_time_fraud_detection_system_tpu.ops.windows import (
 def partition_batch_spill(
     cols: dict, n_dev: int, rows_per_shard: int
 ) -> "list[Tuple[dict, np.ndarray, np.ndarray]]":
-    """Host-side partitioner with hot-key spill: one or more
-    [n_dev × rows_per_shard] layouts.
+    """Host-side partitioner with densely-packed hot-key spill: one or
+    more [n_dev × rows_per_shard] layouts.
 
     Partition of a row is ``customer_id % n_dev`` — the broker's key-hash
-    analogue, sticky per customer. A skewed key distribution can put more
-    than ``rows_per_shard`` rows on one shard; instead of failing, the
-    overflow **spills** into follow-on sub-batches (rank r within a shard
-    goes to chunk ``r // rows_per_shard``), so the stream absorbs hot keys
-    at the cost of extra steps rather than dying.
+    analogue, sticky per customer. Rows that fit their shard's budget form
+    chunk 0, laid out owner-locally (``__routed__ = False``): customer
+    state is touched with zero collectives. A skewed key distribution can
+    put more than ``rows_per_shard`` rows on one shard; the overflow is
+    **re-packed densely** across ALL shards into follow-on chunks
+    (``__routed__ = True``): every device carries an equal share of the
+    hot key's rows, and the step routes customers to their owner over ICI
+    exactly like terminals — utilization stays ~100% instead of
+    collapsing to 1/n_dev right when load spikes.
 
     Returns a list of (columns dict with every array length
-    n_dev*rows_per_shard plus a ``__valid__`` mask, input_rows, pos):
-    ``input_rows[j]`` is the original row index of the chunk's j-th
-    occupied slot and ``pos[j]`` its position in the chunk layout — for
-    re-assembling results in input order.
+    n_dev*rows_per_shard plus ``__valid__`` mask and ``__routed__`` flag,
+    input_rows, pos): ``input_rows[j]`` is the original row index of the
+    chunk's j-th occupied slot and ``pos[j]`` its position in the chunk
+    layout — for re-assembling results in input order.
     """
     cust = cols["customer_id"]
     n = len(cust)
@@ -72,13 +76,9 @@ def partition_batch_spill(
     )
     rank = np.empty(n, dtype=np.int64)
     rank[order] = rank_sorted
-    chunk_of = rank // rows_per_shard
-    n_chunks = int(chunk_of.max()) + 1 if n else 1
     total = n_dev * rows_per_shard
-    chunks = []
-    for c in range(n_chunks):
-        rows = np.flatnonzero(chunk_of == c)
-        pos = part[rows] * rows_per_shard + (rank[rows] - c * rows_per_shard)
+
+    def _mk_chunk(rows, pos, routed):
         out = {}
         for k, v in cols.items():
             buf = np.zeros(total, dtype=v.dtype)
@@ -87,7 +87,21 @@ def partition_batch_spill(
         valid = np.zeros(total, dtype=bool)
         valid[pos] = True
         out["__valid__"] = valid
-        chunks.append((out, rows, pos))
+        out["__routed__"] = routed
+        return out, rows, pos
+
+    fits = rank < rows_per_shard
+    rows0 = np.flatnonzero(fits)
+    pos0 = part[rows0] * rows_per_shard + rank[rows0]
+    chunks = [_mk_chunk(rows0, pos0, False)]
+    overflow = np.flatnonzero(~fits)  # original order preserved
+    for s in range(0, len(overflow), total):
+        rows = overflow[s : s + total]
+        i = np.arange(len(rows), dtype=np.int64)
+        # Row-robin across devices so even a partial final chunk spreads
+        # its rows over the whole mesh.
+        pos = (i % n_dev) * rows_per_shard + i // n_dev
+        chunks.append(_mk_chunk(rows, pos, True))
     return chunks
 
 
@@ -142,6 +156,7 @@ def make_sharded_step(
     online_lr: float = 0.0,
     mesh: Optional[Mesh] = None,
     axis: "str | Tuple[str, ...]" = "data",
+    route_customers: bool = False,
 ):
     """Build the jitted multi-chip step.
 
@@ -152,45 +167,31 @@ def make_sharded_step(
     ``("dcn", "ici")`` from :func:`.distributed.make_hybrid_mesh`): rows
     shard over the flattened super-axis and every collective runs over the
     pair — cross-host hops ride DCN, intra-host ICI.
+
+    ``route_customers=False`` (the common case) assumes rows are placed on
+    their customer-owner device (:func:`partition_batch_spill` chunk 0):
+    customer state is touched with zero collectives. ``True`` builds the
+    densely-packed spill variant: rows sit on ANY device and customers are
+    routed to their owner over ICI exactly like terminals — one extra
+    ``all_to_all`` round buys full-mesh utilization under hot keys.
     """
     assert mesh is not None
-    if cfg.features.customer_source != "table":
-        raise NotImplementedError(
-            "sharded step serves customer windows from the sharded dense "
-            "table; customer_source='cms' is single-chip only for now"
-        )
     n_dev = mesh.devices.size
     fcfg = cfg.features
+    use_cms = fcfg.customer_source == "cms"
     windows = tuple(fcfg.windows)
     nw = len(windows)
     c_cap_local = fcfg.customer_capacity // n_dev
     t_cap_local = fcfg.terminal_capacity // n_dev
 
     def local_step(fstate: FeatureState, params, scaler: Scaler, batch: TxBatch):
+        from real_time_fraud_detection_system_tpu.ops.cms import (
+            cms_query,
+            cms_update,
+        )
+
         bl = batch.customer_key.shape[0]
         fraud = jnp.maximum(batch.label, 0).astype(jnp.float32)
-
-        # ---- customer windows: purely local (rows partitioned by customer)
-        c_slot = ((batch.customer_key // jnp.uint32(n_dev))
-                  & jnp.uint32(c_cap_local - 1)).astype(jnp.int32)
-        customer = update_windows(
-            fstate.customer, c_slot, batch.day, batch.amount, fraud, batch.valid
-        )
-        c_count, c_amount, _ = query_windows(customer, c_slot, batch.day, windows)
-
-        # ---- terminal windows: route to owner over ICI
-        dest = (batch.terminal_key % jnp.uint32(n_dev)).astype(jnp.int32)
-        send_pos, _rank = _route(dest, batch.valid, n_dev)
-
-        def scatter(x, fill=0):
-            buf = jnp.full((n_dev * bl,), fill, dtype=x.dtype)
-            return buf.at[send_pos].set(x)
-
-        s_key = scatter(batch.terminal_key)
-        s_day = scatter(batch.day)
-        s_amount = scatter(batch.amount)
-        s_fraud = scatter(fraud)
-        s_valid = scatter(batch.valid, fill=False)
 
         def xchg(x):
             return jax.lax.all_to_all(
@@ -198,12 +199,75 @@ def make_sharded_step(
                 tiled=False,
             ).reshape(n_dev * bl)
 
-        r_key = xchg(s_key)
-        r_day = xchg(s_day)
-        r_amount = xchg(s_amount)
-        r_fraud = xchg(s_fraud)
-        r_valid = xchg(s_valid)
+        def owner_exchange(key):
+            """Route (key, day, amount, fraud, valid) to the key's owner
+            device; returns received fields + a ``back`` that routes
+            per-row [*, NW] aggregates to the sending rows."""
+            dest = (key % jnp.uint32(n_dev)).astype(jnp.int32)
+            send_pos, _rank = _route(dest, batch.valid, n_dev)
 
+            def scatter(x, fill=0):
+                buf = jnp.full((n_dev * bl,), fill, dtype=x.dtype)
+                return buf.at[send_pos].set(x)
+
+            r_key = xchg(scatter(key))
+            r_day = xchg(scatter(batch.day))
+            r_amount = xchg(scatter(batch.amount))
+            r_fraud = xchg(scatter(fraud))
+            r_valid = xchg(scatter(batch.valid, fill=False))
+
+            def back(mat):
+                b = jnp.stack(
+                    [xchg(mat[:, i]) for i in range(mat.shape[1])], axis=1
+                )
+                return b[send_pos]
+
+            return r_key, r_day, r_amount, r_fraud, r_valid, back
+
+        # ---- customer velocity ------------------------------------------
+        # Owner-local (chunk 0: rows placed by customer % n_dev) or routed
+        # (dense spill chunks: rows anywhere, owner reached over ICI).
+        cms = fstate.cms
+        local_cms = (
+            jax.tree.map(lambda x: jnp.squeeze(x, 0), cms)
+            if cms is not None
+            else None
+        )
+        if route_customers:
+            c_key, c_day, c_amt, c_fraud, c_valid, c_back = owner_exchange(
+                batch.customer_key
+            )
+        else:
+            c_key, c_day, c_amt, c_fraud, c_valid = (
+                batch.customer_key, batch.day, batch.amount, fraud,
+                batch.valid,
+            )
+        if cms is not None:
+            local_cms = cms_update(local_cms, c_key, c_amt, c_day, c_valid)
+            cms = jax.tree.map(lambda x: x[None], local_cms)
+        if use_cms:
+            # BASELINE config 3 × config 5: unbounded-key velocity from the
+            # per-device sketch (each sketch holds only this device's
+            # customers — fewer collisions than one global sketch).
+            customer = fstate.customer
+            cc, ca = cms_query(local_cms, c_key, c_day, windows)
+        else:
+            c_slot = ((c_key // jnp.uint32(n_dev))
+                      & jnp.uint32(c_cap_local - 1)).astype(jnp.int32)
+            customer = update_windows(
+                fstate.customer, c_slot, c_day, c_amt, c_fraud, c_valid
+            )
+            cc, ca, _ = query_windows(customer, c_slot, c_day, windows)
+        if route_customers:
+            c_count = c_back(cc)
+            c_amount = c_back(ca)
+        else:
+            c_count, c_amount = cc, ca
+
+        # ---- terminal windows: always routed to owner over ICI ----------
+        r_key, r_day, r_amount, r_fraud, r_valid, t_back = owner_exchange(
+            batch.terminal_key
+        )
         t_slot = ((r_key // jnp.uint32(n_dev))
                   & jnp.uint32(t_cap_local - 1)).astype(jnp.int32)
         terminal = update_windows(
@@ -212,11 +276,8 @@ def make_sharded_step(
         t_count, _, t_fraud = query_windows(
             terminal, t_slot, r_day, windows, delay=fcfg.delay_days
         )
-        # route aggregates back (inverse = same all_to_all on the buffers)
-        t_count_b = jnp.stack([xchg(t_count[:, i]) for i in range(nw)], axis=1)
-        t_fraud_b = jnp.stack([xchg(t_fraud[:, i]) for i in range(nw)], axis=1)
-        t_count_l = t_count_b[send_pos]
-        t_fraud_l = t_fraud_b[send_pos]
+        t_count_l = t_back(t_count)
+        t_fraud_l = t_back(t_fraud)
 
         # ---- assemble the 15-feature matrix (order = features/spec.py)
         c_avg = jnp.where(c_count > 0, c_amount / jnp.maximum(c_count, 1.0), 0.0)
@@ -248,7 +309,7 @@ def make_sharded_step(
                                   params, g)
 
         new_state = FeatureState(customer=customer, terminal=terminal,
-                                 cms=fstate.cms)
+                                 cms=cms)
         return new_state, params, probs, feats
 
     try:
@@ -272,7 +333,8 @@ def make_sharded_step(
             FeatureState(
                 customer=spec_like(fstate_template.customer, P(axis, None)),
                 terminal=spec_like(fstate_template.terminal, P(axis, None)),
-                cms=spec_like(fstate_template.cms, P())
+                # Owner-sharded sketch: leading device axis (mesh.py).
+                cms=spec_like(fstate_template.cms, P(axis))
                 if fstate_template.cms is not None
                 else None,
             ),

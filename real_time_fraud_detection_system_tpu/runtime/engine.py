@@ -194,8 +194,14 @@ class ScoringEngine:
 
         self._step = jax.jit(step, donate_argnums=(0,))
 
-    def process_batch(self, cols: dict) -> BatchResult:
-        """One micro-batch: dedup → pad → device step → host result."""
+    def _start_batch(self, cols: dict) -> dict:
+        """Host prep + async device dispatch (does NOT block on results).
+
+        The returned handle holds device futures; :meth:`_finish_batch`
+        materializes them. Splitting the two lets :meth:`run` stage batch
+        N+1's H2D transfer and dispatch while batch N still computes —
+        the double-buffered overlap of SURVEY §2.3 item 3.
+        """
         t0 = time.perf_counter()
         # Latest-wins dedup by tx_id (reference ROW_NUMBER/MERGE semantics,
         # kafka_s3_sink_transactions.py:173-222) on host — tx_ids are int64.
@@ -217,8 +223,29 @@ class ScoringEngine:
         )
         self.state.feature_state = fstate
         self.state.params = params
+        return {"cols": cols, "n": n, "probs": probs, "feats": feats,
+                "t0": t0}
 
-        feats_np = np.asarray(feats)[:n]
+    def _finish_batch(self, handle: dict) -> BatchResult:
+        """Block on the handle's device futures; build the BatchResult."""
+        n = handle["n"]
+        feats_np = np.asarray(handle["feats"])[:n]
+        if self.scorer == "cpu":
+            # parity/baseline oracle: host-side pipeline on the same features
+            # (sklearn pipeline, or a TrainedModel's pure-NumPy path)
+            fn = getattr(self.cpu_model, "predict_proba_np", None) or (
+                self.cpu_model.predict_proba
+            )
+            probs_np = fn(feats_np.astype(np.float64))
+        else:
+            probs_np = np.asarray(handle["probs"])[:n]
+        return self._emit_result(handle, probs_np, feats_np)
+
+    def _emit_result(self, handle: dict, probs_np: np.ndarray,
+                     feats_np: np.ndarray) -> BatchResult:
+        """Shared result tail: feature-cache put, counters, BatchResult."""
+        cols = handle["cols"]
+        n = handle["n"]
         if self.feature_cache is not None and n:
             from real_time_fraud_detection_system_tpu.core.batch import (
                 US_PER_DAY,
@@ -234,15 +261,6 @@ class ScoringEngine:
                 labeled=(np.asarray(in_band) >= 0)
                 if in_band is not None else None,
             )
-        if self.scorer == "cpu":
-            # parity/baseline oracle: host-side pipeline on the same features
-            # (sklearn pipeline, or a TrainedModel's pure-NumPy path)
-            fn = getattr(self.cpu_model, "predict_proba_np", None) or (
-                self.cpu_model.predict_proba
-            )
-            probs_np = fn(feats_np.astype(np.float64))
-        else:
-            probs_np = np.asarray(probs)[:n]
         self.state.batches_done += 1
         self.state.rows_done += n
         return BatchResult(
@@ -253,8 +271,15 @@ class ScoringEngine:
             amount_cents=cols["tx_amount_cents"],
             features=feats_np,
             probs=probs_np,
-            latency_s=time.perf_counter() - t0,
+            latency_s=(
+                time.perf_counter() - handle["t0"]
+                - handle.get("waited", 0.0)
+            ),
         )
+
+    def process_batch(self, cols: dict) -> BatchResult:
+        """One micro-batch: dedup → pad → device step → host result."""
+        return self._finish_batch(self._start_batch(cols))
 
     @property
     def supports_online_sgd(self) -> bool:
@@ -375,6 +400,13 @@ class ScoringEngine:
     ) -> dict:
         """Stream until the source is exhausted (or max_batches).
 
+        The loop is double-buffered: batch N+1 is polled, host-prepped,
+        ``device_put`` and dispatched while batch N's device step still
+        runs — H2D overlaps compute (SURVEY §2.3 item 3). The pipeline
+        drains to depth 0 before every checkpoint save, so a saved
+        (offsets, state) pair never includes an in-flight batch's effects
+        (a replay after restore would double-apply them otherwise).
+
         ``heartbeat`` (a :class:`~.faults.Heartbeat`) is beaten once per
         loop pass — including idle polls — so a watchdog can tell a quiet
         stream from a silently hung source or device step.
@@ -386,34 +418,18 @@ class ScoringEngine:
             if trigger_seconds is None
             else trigger_seconds
         )
+        every = self.cfg.runtime.checkpoint_every_batches
         latencies: List[float] = []
         t_start = time.perf_counter()
-        while True:
-            if heartbeat is not None:
-                heartbeat.beat()
-            if max_batches and self.state.batches_done >= max_batches:
-                break
-            cols = source.poll_batch()
-            if cols is None:
-                break
-            if len(next(iter(cols.values()), ())) == 0:
-                # Idle live source (e.g. KafkaSource on a quiet topic):
-                # not a batch — no sink append, no step, no checkpoint
-                # cadence, no max_batches consumption. Just wait a trigger.
-                if trigger > 0:
-                    time.sleep(trigger)
-                continue
-            res = self.process_batch(cols)
-            self.state.offsets = list(source.offsets)
+        pending: Optional[dict] = None
+
+        def _finish(handle: dict) -> None:
+            res = self._finish_batch(handle)
+            self.state.offsets = handle["source_offsets"]
             latencies.append(res.latency_s)
             if sink is not None:
                 sink.append(res)
-            if (
-                checkpointer is not None
-                and self.state.batches_done
-                % self.cfg.runtime.checkpoint_every_batches
-                == 0
-            ):
+            if checkpointer is not None and self.state.batches_done % every == 0:
                 checkpointer.save(self.state)
                 # Broker-side offsets (sources that have them, e.g. Kafka)
                 # are committed only AFTER the framework checkpoint lands:
@@ -424,6 +440,54 @@ class ScoringEngine:
                     commit()
             if trigger > 0:
                 time.sleep(max(0.0, trigger - res.latency_s))
+
+        while True:
+            if heartbeat is not None:
+                heartbeat.beat()
+            started = self.state.batches_done + (1 if pending else 0)
+            if max_batches and started >= max_batches:
+                break
+            t_poll = time.perf_counter()
+            cols = source.poll_batch()
+            if pending is not None:
+                # Waiting for the NEXT batch to arrive is not part of the
+                # pending batch's processing latency — subtract it so the
+                # reported percentiles (and trigger pacing) measure the
+                # pipeline, not source quiescence.
+                pending["waited"] = (
+                    pending.get("waited", 0.0)
+                    + time.perf_counter() - t_poll
+                )
+            if cols is None:
+                break
+            if len(next(iter(cols.values()), ())) == 0:
+                # Idle live source (e.g. KafkaSource on a quiet topic):
+                # not a batch — no sink append, no step, no checkpoint
+                # cadence, no max_batches consumption. Flush the pending
+                # batch (its results must not wait for future traffic),
+                # then wait a trigger.
+                if pending is not None:
+                    _finish(pending)
+                    pending = None
+                if trigger > 0:
+                    time.sleep(trigger)
+                continue
+            if (
+                pending is not None
+                and checkpointer is not None
+                and (self.state.batches_done + 1) % every == 0
+            ):
+                # The pending batch's completion will checkpoint: drain
+                # first so no newer batch is in flight at save time.
+                _finish(pending)
+                pending = None
+            handle = self._start_batch(cols)
+            handle["source_offsets"] = list(source.offsets)
+            if pending is not None:
+                _finish(pending)
+            pending = handle
+        if pending is not None:
+            _finish(pending)
         wall = time.perf_counter() - t_start
         lat = np.asarray(latencies) if latencies else np.zeros(1)
         return {

@@ -117,7 +117,19 @@ class ShardedScoringEngine(ScoringEngine):
             mesh=self.mesh,
             axis=self.axis,
         )
+        # Dense-spill variant (customers routed to owner like terminals);
+        # compiled lazily on the first hot-key overflow.
+        self._sharded_build_routed = make_sharded_step(
+            cfg,
+            predict_fn_for(kind),
+            loss_fn=loss_fn_for(kind),
+            online_lr=online_lr,
+            mesh=self.mesh,
+            axis=self.axis,
+            route_customers=True,
+        )
         self._sharded_step = None  # built on first batch (needs templates)
+        self._sharded_step_routed = None
         self._sharded_sf = None
 
     # -- sharding upkeep ---------------------------------------------------
@@ -138,20 +150,24 @@ class ShardedScoringEngine(ScoringEngine):
 
     # -- the sharded hot path ----------------------------------------------
 
-    def process_batch(self, cols: dict) -> BatchResult:
-        """One micro-batch: dedup → partition (spill) → sharded step(s) →
-        re-assemble in input order."""
+    def _start_batch(self, cols: dict) -> dict:
+        """Dedup → partition (spill) → launch sharded step(s), async.
+
+        Device results stay futures in the handle; :meth:`_finish_batch`
+        materializes and re-assembles them in input order — so
+        :meth:`~.engine.ScoringEngine.run`'s double-buffering overlaps the
+        next batch's partition + H2D with this batch's mesh compute.
+        """
         t0 = time.perf_counter()
         keep = latest_wins_mask_np(cols["tx_id"], cols["kafka_ts_ms"])
         cols = {k: v[keep] for k, v in cols.items()}
         n = len(cols["tx_id"])
         self._ensure_sharded()
 
-        probs_np = np.zeros(n, dtype=np.float32)
-        feats_np = np.zeros((n, N_FEATURES), dtype=np.float32)
         chunks = partition_batch_spill(
             cols, self.n_dev, self.rows_per_shard
         ) if n else []
+        parts = []
         for part_cols, rows, pos in chunks:
             batch = make_batch(
                 customer_id=part_cols["customer_id"],
@@ -169,41 +185,37 @@ class ShardedScoringEngine(ScoringEngine):
             )
             batch = batch._replace(valid=part_cols["__valid__"])
             jbatch = jax.tree.map(jnp.asarray, batch)
-            if self._sharded_step is None:
-                self._sharded_step = self._sharded_build(
-                    self.state.feature_state, self.state.params,
-                    self.state.scaler, jbatch,
-                )
-            fstate, params, probs, feats = self._sharded_step(
+            if part_cols.get("__routed__", False):
+                if self._sharded_step_routed is None:
+                    self._sharded_step_routed = self._sharded_build_routed(
+                        self.state.feature_state, self.state.params,
+                        self.state.scaler, jbatch,
+                    )
+                step = self._sharded_step_routed
+            else:
+                if self._sharded_step is None:
+                    self._sharded_step = self._sharded_build(
+                        self.state.feature_state, self.state.params,
+                        self.state.scaler, jbatch,
+                    )
+                step = self._sharded_step
+            fstate, params, probs, feats = step(
                 self.state.feature_state, self.state.params,
                 self.state.scaler, jbatch,
             )
             self.state.feature_state = fstate
             self.state.params = params
+            parts.append((rows, pos, probs, feats))
+        return {"cols": cols, "n": n, "parts": parts, "t0": t0}
+
+    def _finish_batch(self, handle: dict) -> BatchResult:
+        n = handle["n"]
+        probs_np = np.zeros(n, dtype=np.float32)
+        feats_np = np.zeros((n, N_FEATURES), dtype=np.float32)
+        for rows, pos, probs, feats in handle["parts"]:
             probs_np[rows] = np.asarray(probs)[pos]
             feats_np[rows] = np.asarray(feats)[pos]
-
-        if self.feature_cache is not None and n:
-            in_band = cols.get("label")
-            self.feature_cache.put_batch(
-                cols["tx_id"], feats_np,
-                terminal_ids=cols["terminal_id"],
-                days=(cols["tx_datetime_us"] // US_PER_DAY).astype(np.int32),
-                labeled=(np.asarray(in_band) >= 0)
-                if in_band is not None else None,
-            )
-        self.state.batches_done += 1
-        self.state.rows_done += n
-        return BatchResult(
-            tx_id=cols["tx_id"],
-            tx_datetime_us=cols["tx_datetime_us"],
-            customer_id=cols["customer_id"],
-            terminal_id=cols["terminal_id"],
-            amount_cents=cols["tx_amount_cents"],
-            features=feats_np,
-            probs=probs_np,
-            latency_s=time.perf_counter() - t0,
-        )
+        return self._emit_result(handle, probs_np, feats_np)
 
     # -- feedback into the owner-partitioned terminal table ----------------
 

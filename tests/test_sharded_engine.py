@@ -71,18 +71,30 @@ class TestPartitionSpill:
         # row i landed at pos[i]; payload column follows
         np.testing.assert_array_equal(out["x"][pos], rows)
 
-    def test_hot_key_spills(self):
-        # every row hits shard 1: 10 rows / capacity 4 → 3 chunks
+    def test_hot_key_spills_densely(self):
+        # every row hits shard 1: 10 rows / capacity 4 → owner-local chunk
+        # of 4 + ONE dense routed chunk of 6 (not ceil(10/4)=3 chunks with
+        # 1/n_dev occupancy)
         chunks = partition_batch_spill(self._cols(np.full(10, 5)), 4, 4)
-        assert len(chunks) == 3
+        assert len(chunks) == 2
         sizes = [len(rows) for _, rows, _ in chunks]
-        assert sizes == [4, 4, 2]
+        assert sizes == [4, 6]
+        assert chunks[0][0]["__routed__"] is False
+        assert chunks[1][0]["__routed__"] is True
+        # the dense chunk spreads over ALL shards, not just the hot one
+        _, _, pos1 = chunks[1]
+        assert len(np.unique(pos1 // 4)) == 4
         # every input row appears exactly once across chunks
         all_rows = np.concatenate([rows for _, rows, _ in chunks])
         np.testing.assert_array_equal(np.sort(all_rows), np.arange(10))
         # payload stays row-aligned in every chunk
         for out, rows, pos in chunks:
             np.testing.assert_array_equal(out["x"][pos], rows)
+
+    def test_balanced_stays_local(self):
+        chunks = partition_batch_spill(self._cols(np.arange(16)), 4, 4)
+        assert len(chunks) == 1
+        assert chunks[0][0]["__routed__"] is False
 
     def test_empty_batch(self):
         chunks = partition_batch_spill(self._cols(np.array([])), 4, 4)
@@ -283,3 +295,182 @@ def test_sharded_engine_rejects_indivisible_capacity():
     with pytest.raises(ValueError, match="customer_capacity"):
         ShardedScoringEngine(cfg, kind="logreg", params=params,
                              scaler=scaler, n_devices=N_DEV)
+
+
+def _cms_cfg(max_rows=1024):
+    return Config(
+        features=FeatureConfig(customer_capacity=512,
+                               terminal_capacity=1024,
+                               customer_source="cms",
+                               cms_depth=4, cms_width=1 << 12),
+        train=TrainConfig(),
+        runtime=RuntimeConfig(batch_buckets=(max_rows,),
+                              max_batch_rows=max_rows,
+                              trigger_seconds=0.0),
+    )
+
+
+def test_sharded_cms_matches_single_chip(small_dataset):
+    """BASELINE config 3 (CMS velocity) × config 5 (8-way serve) compose:
+    with collision-free sketches both paths are exact, so the sharded
+    probabilities must equal the single-chip ones."""
+    _, _, _, txs = small_dataset
+    part = txs.slice(slice(0, 4096))
+    cfg = _cms_cfg()
+    params, scaler = _model()
+
+    s1, s8 = MemorySink(), MemorySink()
+    ScoringEngine(cfg, kind="logreg", params=params, scaler=scaler).run(
+        ReplaySource(part, EPOCH0, batch_rows=1024), sink=s1)
+    eng = ShardedScoringEngine(cfg, kind="logreg", params=params,
+                               scaler=scaler, n_devices=N_DEV)
+    stats = eng.run(ReplaySource(part, EPOCH0, batch_rows=1024), sink=s8)
+    assert stats["batches"] > 1
+
+    out1, out8 = s1.concat(), s8.concat()
+    a, b = np.argsort(out1["tx_id"]), np.argsort(out8["tx_id"])
+    np.testing.assert_array_equal(out1["tx_id"][a], out8["tx_id"][b])
+    np.testing.assert_allclose(out1["prediction"][a],
+                               out8["prediction"][b], atol=1e-6)
+
+
+def test_sharded_cms_estimates_are_upper_bounds(small_dataset):
+    """Per-device sketches keep the CMS guarantee: estimated window counts
+    never undercount the exact (dense-table) ones, even with narrow,
+    collision-heavy sketches."""
+    from real_time_fraud_detection_system_tpu.features.spec import (
+        FEATURE_NAMES,
+    )
+
+    _, _, _, txs = small_dataset
+    part = txs.slice(slice(0, 2048))
+    params, scaler = _model()
+    narrow = Config(
+        features=FeatureConfig(customer_capacity=512,
+                               terminal_capacity=1024,
+                               customer_source="cms",
+                               cms_depth=2, cms_width=1 << 6),
+        runtime=RuntimeConfig(batch_buckets=(1024,), max_batch_rows=1024,
+                              trigger_seconds=0.0),
+    )
+    exact_cfg = _cfg()
+
+    s_cms, s_exact = MemorySink(), MemorySink()
+    ShardedScoringEngine(narrow, kind="logreg", params=params,
+                         scaler=scaler, n_devices=N_DEV).run(
+        ReplaySource(part, EPOCH0, batch_rows=1024), sink=s_cms)
+    ShardedScoringEngine(exact_cfg, kind="logreg", params=params,
+                         scaler=scaler, n_devices=N_DEV).run(
+        ReplaySource(part, EPOCH0, batch_rows=1024), sink=s_exact)
+
+    cms_out, exact_out = s_cms.concat(), s_exact.concat()
+    a = np.argsort(cms_out["tx_id"])
+    b = np.argsort(exact_out["tx_id"])
+    count_cols = [nm.lower() for nm in FEATURE_NAMES
+                  if "CUSTOMER_ID_NB_TX" in nm]
+    for col in count_cols:
+        assert (cms_out[col][a] >= exact_out[col][b] - 1e-5).all(), col
+
+
+def test_sharded_cms_hot_key_spill(small_dataset):
+    """CMS mode survives a hot-key spill (one customer dominating)."""
+    cfg = _cms_cfg(max_rows=512)
+    params, scaler = _model()
+    n = 512
+    cols = {
+        "tx_id": np.arange(n, dtype=np.int64),
+        "tx_datetime_us": (20200 * 86_400_000_000
+                           + np.arange(n, dtype=np.int64) * 1_000_000),
+        "customer_id": np.full(n, 3, dtype=np.int64),
+        "terminal_id": (np.arange(n) % 7).astype(np.int64),
+        "tx_amount_cents": np.full(n, 1000, dtype=np.int64),
+        "kafka_ts_ms": np.zeros(n, dtype=np.int64),
+    }
+    eng = ShardedScoringEngine(cfg, kind="logreg", params=params,
+                               scaler=scaler, n_devices=N_DEV)
+    res = eng.process_batch(cols)
+    assert len(res.probs) == n
+    assert np.isfinite(res.probs).all()
+
+
+def test_sharded_cms_checkpoint_roundtrip(small_dataset, tmp_path):
+    """The owner-sharded sketch checkpoints and restores (re-sharded) to
+    the same continuation outputs."""
+    _, _, _, txs = small_dataset
+    part = txs.slice(slice(0, 3072))
+    cfg = _cms_cfg()
+    params, scaler = _model()
+
+    clean = MemorySink()
+    ShardedScoringEngine(cfg, kind="logreg", params=params, scaler=scaler,
+                         n_devices=N_DEV).run(
+        ReplaySource(part, EPOCH0, batch_rows=1024), sink=clean)
+
+    ck = Checkpointer(str(tmp_path / "ck"))
+    sink = MemorySink()
+    eng = ShardedScoringEngine(cfg, kind="logreg", params=params,
+                               scaler=scaler, n_devices=N_DEV)
+    src = ReplaySource(part, EPOCH0, batch_rows=1024)
+    eng.run(src, sink=sink, checkpointer=ck, max_batches=1)
+    ck.save(eng.state)
+
+    eng2 = ShardedScoringEngine(cfg, kind="logreg", params=params,
+                                scaler=scaler, n_devices=N_DEV)
+    assert ck.restore(eng2.state) is not None
+    src2 = ReplaySource(part, EPOCH0, batch_rows=1024)
+    src2.seek(eng2.state.offsets)
+    eng2.run(src2, sink=sink)
+
+    out, ref = sink.concat(), clean.concat()
+    a, b = np.argsort(out["tx_id"]), np.argsort(ref["tx_id"])
+    assert len(out["tx_id"]) == len(ref["tx_id"])
+    np.testing.assert_allclose(out["prediction"][a], ref["prediction"][b],
+                               atol=1e-6)
+
+
+@pytest.mark.parametrize("source", ["table", "cms"])
+def test_dense_spill_matches_single_chip(source):
+    """The routed spill path (customers exchanged to owner like terminals)
+    reproduces single-chip results exactly, for both the dense table and
+    the CMS velocity source — chunk boundaries aligned so in-batch
+    visibility semantics match."""
+    from real_time_fraud_detection_system_tpu.core.batch import US_PER_DAY
+
+    n, rps, n_dev = 128, 16, N_DEV
+    rng = np.random.default_rng(3)
+    cols = {
+        "tx_id": np.arange(n, dtype=np.int64),
+        "tx_datetime_us": np.full(n, 20200, np.int64) * US_PER_DAY
+        + np.arange(n, dtype=np.int64) * 1_000_000,
+        "customer_id": np.full(n, 3, dtype=np.int64),  # ONE hot customer
+        "terminal_id": (np.arange(n) % 13).astype(np.int64),
+        "tx_amount_cents": rng.integers(100, 30000, n).astype(np.int64),
+        "kafka_ts_ms": np.zeros(n, dtype=np.int64),
+    }
+    fc = FeatureConfig(customer_capacity=512, terminal_capacity=1024,
+                       customer_source=source,
+                       cms_depth=4, cms_width=1 << 12)
+    cfg = Config(features=fc,
+                 runtime=RuntimeConfig(batch_buckets=(rps, n - rps),
+                                       max_batch_rows=n,
+                                       trigger_seconds=0.0))
+    params, scaler = _model()
+
+    # Single-chip reference, batched exactly like the sharded chunks:
+    # chunk 0 = first rps rows (owner-local), spill chunk = the rest.
+    single = ScoringEngine(cfg, kind="logreg", params=params, scaler=scaler)
+    r1 = single.process_batch({k: v[:rps] for k, v in cols.items()})
+    r2 = single.process_batch({k: v[rps:] for k, v in cols.items()})
+    probs_single = np.concatenate([r1.probs, r2.probs])
+
+    eng = ShardedScoringEngine(cfg, kind="logreg", params=params,
+                               scaler=scaler, n_devices=n_dev,
+                               rows_per_shard=rps)
+    res = eng.process_batch(cols)
+    assert eng._sharded_step_routed is not None  # spill path exercised
+    np.testing.assert_allclose(res.probs, probs_single, atol=1e-6)
+    # rtol accommodates fp32 accumulation-order differences in the window
+    # sums (the exchange changes reduction order, not semantics).
+    np.testing.assert_allclose(res.features,
+                               np.concatenate([r1.features, r2.features]),
+                               rtol=1e-5, atol=1e-4)
