@@ -1,0 +1,48 @@
+# Make targets mirroring the reference UX (reference Makefile:1-58 drives
+# docker compose + spark-submit; here every target is the in-process CLI).
+#
+#   make demo        — full E2E: datagen → CDC envelopes → sinks → scorer
+#   make datagen     — generate a transactions table        (≈ datagen)
+#   make train       — offline training                     (≈ notebooks)
+#   make score       — stream-score through the engine      (≈ make fraud_detection)
+#   make run-all     — datagen + train + score              (≈ make run-all)
+#   make bench       — benchmark harness (one JSON line)
+#   make test        — pytest on a virtual 8-device CPU mesh
+#   make install     — editable install incl. the `rtfds` console script
+
+PY ?= python
+CLI = $(PY) -m real_time_fraud_detection_system_tpu.cli
+OUT ?= out
+
+demo:
+	$(CLI) demo --out $(OUT)/analyzed
+
+datagen:
+	$(CLI) datagen --out $(OUT)/txs.npz
+
+train:
+	$(CLI) train --data $(OUT)/txs.npz --model forest --out-model $(OUT)/model.npz
+
+score:
+	$(CLI) score --data $(OUT)/txs.npz --model-file $(OUT)/model.npz \
+	    --scorer tpu --mode envelope --out $(OUT)/analyzed \
+	    --raw-table $(OUT)/transactions --checkpoint-dir $(OUT)/ck
+
+run-all: datagen train score
+
+query:
+	$(CLI) query --data $(OUT)/analyzed --report summary
+
+bench:
+	$(PY) bench.py
+
+test:
+	$(PY) -m pytest tests/ -q
+
+install:
+	$(PY) -m pip install -e .
+
+clean:
+	rm -rf $(OUT)
+
+.PHONY: demo datagen train score run-all query bench test install clean
