@@ -23,7 +23,10 @@ from real_time_fraud_detection_system_tpu.models.scaler import Scaler
 from real_time_fraud_detection_system_tpu.models.train import TrainedModel
 
 
-def save_model(path: str, model: TrainedModel) -> None:
+def dump_model_bytes(model: TrainedModel) -> bytes:
+    """Serialize a model to npz bytes (pickle-free)."""
+    import io as _io
+
     arrays = {
         "scaler_mean": np.asarray(model.scaler.mean),
         "scaler_scale": np.asarray(model.scaler.scale),
@@ -53,59 +56,121 @@ def save_model(path: str, model: TrainedModel) -> None:
             arrays[f"b{i}"] = np.asarray(b)
     else:
         raise ValueError(f"unknown model kind {model.kind}")
+    buf = _io.BytesIO()
+    np.savez(buf, __meta__=json.dumps(meta), **arrays)
+    return buf.getvalue()
+
+
+def _split_s3_url(path: str):
+    """``s3://bucket/some/key`` → ("s3://bucket/some", "key").
+
+    Rejects bucket-only URLs: silently writing a local directory named
+    ``s3:`` (which a naive rpartition would do) is worse than an error.
+    """
+    rest = path[len("s3://"):]
+    bucket, _, key = rest.partition("/")
+    if not bucket or not key:
+        raise ValueError(
+            f"object-store URL needs s3://<bucket>/<key>, got {path!r}"
+        )
+    url, _, name = path.rpartition("/")
+    return url, name
+
+
+def save_model(path: str, model: TrainedModel) -> None:
+    """Save to a local path or an object-store URL (``s3://…``)."""
+    if path.startswith("s3://"):
+        from real_time_fraud_detection_system_tpu.io.store import make_store
+
+        url, key = _split_s3_url(path)  # validate before serializing
+        make_store(url).put(key, dump_model_bytes(model))
+        return
+    data = dump_model_bytes(model)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
-        np.savez(f, __meta__=json.dumps(meta), **arrays)
+        f.write(data)
     os.replace(tmp, path)
 
 
+def load_model_bytes(data: bytes) -> TrainedModel:
+    import io as _io
+
+    return _load_model_npz(np.load(_io.BytesIO(data), allow_pickle=False))
+
+
 def load_model(path: str) -> TrainedModel:
+    """Load from a local path or an object-store URL (``s3://…``)."""
+    if path.startswith("s3://"):
+        from real_time_fraud_detection_system_tpu.io.store import make_store
+
+        url, key = _split_s3_url(path)
+        return load_model_bytes(make_store(url).get(key))
     with np.load(path, allow_pickle=False) as z:
-        meta = json.loads(str(z["__meta__"]))
-        kind = meta["kind"]
-        scaler = Scaler(
-            mean=jnp.asarray(z["scaler_mean"]), scale=jnp.asarray(z["scaler_scale"])
+        return _load_model_npz(z)
+
+
+def upload_model(store, key: str, model: TrainedModel) -> None:
+    """The reference's artifact upload (``load_initial_data.py:269-287``)."""
+    store.put(key, dump_model_bytes(model))
+
+
+def download_model(store, key: str, default=None):
+    """404-tolerant model download (``fraud_detection.py:59-82``): a
+    missing artifact returns ``default`` instead of crashing — the scorer
+    can start before the first training run has published a model."""
+    try:
+        data = store.get(key)
+    except KeyError:
+        return default
+    return load_model_bytes(data)
+
+
+def _load_model_npz(z) -> TrainedModel:
+    meta = json.loads(str(z["__meta__"]))
+    kind = meta["kind"]
+    scaler = Scaler(
+        mean=jnp.asarray(z["scaler_mean"]), scale=jnp.asarray(z["scaler_scale"])
+    )
+    if kind == "logreg":
+        params = LogRegParams(w=jnp.asarray(z["w"]), b=jnp.asarray(z["b"]))
+    elif kind == "mlp":
+        params = [
+            (jnp.asarray(z[f"w{i}"]), jnp.asarray(z[f"b{i}"]))
+            for i in range(meta["n_layers"])
+        ]
+    elif kind in ("tree", "forest", "gbt"):
+        trees = TreeEnsemble(
+            feat=jnp.asarray(z["feat"]),
+            thresh=jnp.asarray(z["thresh"]),
+            left=jnp.asarray(z["left"]),
+            right=jnp.asarray(z["right"]),
+            prob=jnp.asarray(z["prob"]),
+            max_depth=int(meta["max_depth"]),
         )
-        if kind == "logreg":
-            params = LogRegParams(w=jnp.asarray(z["w"]), b=jnp.asarray(z["b"]))
-        elif kind == "mlp":
-            params = [
-                (jnp.asarray(z[f"w{i}"]), jnp.asarray(z[f"b{i}"]))
-                for i in range(meta["n_layers"])
-            ]
-        elif kind in ("tree", "forest", "gbt"):
-            trees = TreeEnsemble(
-                feat=jnp.asarray(z["feat"]),
-                thresh=jnp.asarray(z["thresh"]),
-                left=jnp.asarray(z["left"]),
-                right=jnp.asarray(z["right"]),
-                prob=jnp.asarray(z["prob"]),
-                max_depth=int(meta["max_depth"]),
-            )
-            if kind == "gbt":
-                from real_time_fraud_detection_system_tpu.models.gbt import (
-                    GBTModel,
-                )
-
-                params = GBTModel(
-                    trees=trees, base_score=jnp.asarray(z["base_score"])
-                )
-            else:
-                params = trees
-        elif kind == "autoencoder":
-            from real_time_fraud_detection_system_tpu.models.autoencoder import (
-                AutoencoderParams,
+        if kind == "gbt":
+            from real_time_fraud_detection_system_tpu.models.gbt import (
+                GBTModel,
             )
 
-            params = AutoencoderParams(
-                layers=[
-                    (jnp.asarray(z[f"w{i}"]), jnp.asarray(z[f"b{i}"]))
-                    for i in range(meta["n_layers"])
-                ],
-                err_scale=jnp.asarray(z["err_scale"]),
+            params = GBTModel(
+                trees=trees, base_score=jnp.asarray(z["base_score"])
             )
         else:
-            raise ValueError(f"unknown model kind {kind}")
+            params = trees
+    elif kind == "autoencoder":
+        from real_time_fraud_detection_system_tpu.models.autoencoder import (
+            AutoencoderParams,
+        )
+
+        params = AutoencoderParams(
+            layers=[
+                (jnp.asarray(z[f"w{i}"]), jnp.asarray(z[f"b{i}"]))
+                for i in range(meta["n_layers"])
+            ],
+            err_scale=jnp.asarray(z["err_scale"]),
+        )
+    else:
+        raise ValueError(f"unknown model kind {kind}")
     return TrainedModel(kind=kind, scaler=scaler, params=params)
 
 

@@ -1,0 +1,171 @@
+"""Object-store abstraction — the MinIO/S3 artifact layer.
+
+The reference keeps model artifacts and the lakehouse warehouse in MinIO
+(S3 API): ``load_initial_data.py:269-287`` uploads ``trained_model.pkl``
+with boto3, ``fraud_detection.py:59-82`` downloads it at scorer startup
+and **tolerates a 404** (serves without a model rather than crashing).
+This module provides that role behind one tiny interface:
+
+- :class:`LocalStore` — filesystem-backed (dev/test; also what a mounted
+  volume looks like);
+- :class:`S3Store` — boto3-gated S3/MinIO client (the client object is
+  injectable, so tests run against a fake without boto3);
+- :func:`make_store` — ``"s3://bucket/prefix"`` → :class:`S3Store`,
+  anything else → :class:`LocalStore`.
+
+Missing keys raise ``KeyError`` everywhere; callers that tolerate absence
+(the reference's 404 path) catch it — see
+:func:`..io.artifacts.download_model`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+
+class LocalStore:
+    """Filesystem object store rooted at a directory."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        root = os.path.normpath(self.root)
+        p = os.path.normpath(os.path.join(root, key))
+        if os.path.commonpath([root, p]) != root:
+            raise ValueError(f"key escapes store root: {key!r}")
+        return p
+
+    def put(self, key: str, data: bytes) -> None:
+        p = self._path(key)
+        os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
+        tmp = p + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, p)
+
+    def get(self, key: str) -> bytes:
+        try:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise KeyError(key) from None
+
+    def exists(self, key: str) -> bool:
+        return os.path.isfile(self._path(key))
+
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def list(self, prefix: str = "") -> List[str]:
+        out = []
+        for dirpath, _, files in os.walk(self.root):
+            for f in files:
+                if f.endswith(".tmp"):
+                    continue
+                key = os.path.relpath(os.path.join(dirpath, f), self.root)
+                key = key.replace(os.sep, "/")
+                if key.startswith(prefix):
+                    out.append(key)
+        return sorted(out)
+
+
+_MISSING_CODES = ("404", "NoSuchKey", "NotFound")
+
+
+def _is_missing(exc: Exception) -> bool:
+    """True when an S3-client exception means 'key does not exist'.
+
+    Recognizes botocore's ClientError shape (``.response["Error"]["Code"]``)
+    duck-typed, so fakes work without botocore installed."""
+    err = getattr(exc, "response", None)
+    if isinstance(err, dict):
+        return err.get("Error", {}).get("Code") in _MISSING_CODES
+    return False
+
+
+class S3Store:
+    """S3/MinIO object store (boto3-gated; client injectable for tests).
+
+    ``client_kwargs`` pass straight to ``boto3.client("s3", ...)`` —
+    ``endpoint_url``, credentials, region; the values the reference
+    hard-codes in every job (``load_initial_data.py:269-287``)."""
+
+    def __init__(self, bucket: str, prefix: str = "", client=None,
+                 **client_kwargs):
+        if client is None:
+            try:
+                import boto3
+            except ImportError as e:
+                raise ImportError(
+                    "boto3 is not installed; use LocalStore for dev, or "
+                    "install boto3 (pip install boto3) in production "
+                    "images."
+                ) from e
+            client = boto3.client("s3", **client_kwargs)
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        self.client = client
+
+    def _key(self, key: str) -> str:
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    def put(self, key: str, data: bytes) -> None:
+        self.client.put_object(Bucket=self.bucket, Key=self._key(key),
+                               Body=data)
+
+    def get(self, key: str) -> bytes:
+        try:
+            obj = self.client.get_object(Bucket=self.bucket,
+                                         Key=self._key(key))
+        except Exception as e:
+            if _is_missing(e):
+                raise KeyError(key) from None
+            raise
+        body = obj["Body"]
+        return body.read() if hasattr(body, "read") else body
+
+    def exists(self, key: str) -> bool:
+        try:
+            self.client.head_object(Bucket=self.bucket, Key=self._key(key))
+            return True
+        except Exception as e:
+            if _is_missing(e):
+                return False
+            raise
+
+    def delete(self, key: str) -> None:
+        self.client.delete_object(Bucket=self.bucket, Key=self._key(key))
+
+    def list(self, prefix: str = "") -> List[str]:
+        full = self._key(prefix)
+        keys: List[str] = []
+        token: Optional[str] = None
+        while True:
+            kw = {"Bucket": self.bucket, "Prefix": full}
+            if token:
+                kw["ContinuationToken"] = token
+            resp = self.client.list_objects_v2(**kw)
+            for item in resp.get("Contents", []):
+                k = item["Key"]
+                if self.prefix:
+                    k = k[len(self.prefix) + 1:]
+                keys.append(k)
+            if not resp.get("IsTruncated"):
+                break
+            token = resp.get("NextContinuationToken")
+        return sorted(keys)
+
+
+def make_store(url: str, **kwargs):
+    """``s3://bucket[/prefix]`` → :class:`S3Store`; else :class:`LocalStore`."""
+    if url.startswith("s3://"):
+        rest = url[len("s3://"):]
+        bucket, _, prefix = rest.partition("/")
+        return S3Store(bucket, prefix=prefix, **kwargs)
+    return LocalStore(url)

@@ -1,0 +1,170 @@
+"""Object-store artifact layer: LocalStore, S3Store (fake client),
+404-tolerant model download (reference ``load_initial_data.py:269-287``
+upload + ``fraud_detection.py:59-82`` tolerant download)."""
+
+import numpy as np
+import pytest
+
+from real_time_fraud_detection_system_tpu.io.store import (
+    LocalStore,
+    S3Store,
+    make_store,
+)
+
+
+class _ClientError(Exception):
+    def __init__(self, code):
+        super().__init__(code)
+        self.response = {"Error": {"Code": code}}
+
+
+class FakeS3Client:
+    """Dict-backed stand-in for boto3's S3 client (botocore-free)."""
+
+    def __init__(self):
+        self.objects = {}
+
+    def put_object(self, Bucket, Key, Body):
+        self.objects[(Bucket, Key)] = bytes(Body)
+
+    def get_object(self, Bucket, Key):
+        try:
+            return {"Body": self.objects[(Bucket, Key)]}
+        except KeyError:
+            raise _ClientError("NoSuchKey") from None
+
+    def head_object(self, Bucket, Key):
+        if (Bucket, Key) not in self.objects:
+            raise _ClientError("404")
+        return {}
+
+    def delete_object(self, Bucket, Key):
+        self.objects.pop((Bucket, Key), None)
+
+    def list_objects_v2(self, Bucket, Prefix="", ContinuationToken=None):
+        keys = sorted(k for b, k in self.objects
+                      if b == Bucket and k.startswith(Prefix))
+        # Exercise pagination: one key per page.
+        start = int(ContinuationToken or 0)
+        page = keys[start:start + 1]
+        truncated = start + 1 < len(keys)
+        resp = {"Contents": [{"Key": k} for k in page],
+                "IsTruncated": truncated}
+        if truncated:
+            resp["NextContinuationToken"] = str(start + 1)
+        return resp
+
+
+@pytest.fixture(params=["local", "s3"])
+def store(request, tmp_path):
+    if request.param == "local":
+        return LocalStore(str(tmp_path / "store"))
+    return S3Store("commerce", prefix="artifacts", client=FakeS3Client())
+
+
+def test_store_roundtrip(store):
+    store.put("models/trained_model.npz", b"abc")
+    assert store.get("models/trained_model.npz") == b"abc"
+    assert store.exists("models/trained_model.npz")
+    assert not store.exists("models/other.npz")
+    store.put("models/b.npz", b"b")
+    assert store.list("models/") == ["models/b.npz",
+                                     "models/trained_model.npz"]
+    store.delete("models/b.npz")
+    assert not store.exists("models/b.npz")
+
+
+def test_store_missing_key_raises_keyerror(store):
+    with pytest.raises(KeyError):
+        store.get("nope")
+
+
+def test_make_store_dispatch(tmp_path, monkeypatch):
+    local = make_store(str(tmp_path / "x"))
+    assert isinstance(local, LocalStore)
+    s3 = make_store("s3://commerce/warehouse", client=FakeS3Client())
+    assert isinstance(s3, S3Store)
+    assert s3.bucket == "commerce" and s3.prefix == "warehouse"
+
+
+def test_local_store_rejects_escaping_keys(tmp_path):
+    st = LocalStore(str(tmp_path / "s"))
+    with pytest.raises(ValueError):
+        st.put("../outside", b"x")
+
+
+def test_model_upload_download_roundtrip(store):
+    from real_time_fraud_detection_system_tpu.io.artifacts import (
+        download_model,
+        upload_model,
+    )
+    from real_time_fraud_detection_system_tpu.models.logreg import (
+        init_logreg,
+    )
+    from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+    from real_time_fraud_detection_system_tpu.models.train import (
+        TrainedModel,
+    )
+
+    import jax.numpy as jnp
+
+    model = TrainedModel(
+        kind="logreg",
+        scaler=Scaler(mean=jnp.arange(15.0), scale=jnp.ones(15)),
+        params=init_logreg(15),
+    )
+    # 404 tolerance BEFORE the first publish: scorer starts modelless.
+    assert download_model(store, "trained_model.npz") is None
+    upload_model(store, "trained_model.npz", model)
+    back = download_model(store, "trained_model.npz")
+    assert back.kind == "logreg"
+    np.testing.assert_allclose(np.asarray(back.scaler.mean),
+                               np.arange(15.0))
+    np.testing.assert_allclose(np.asarray(back.params.w),
+                               np.asarray(model.params.w))
+
+
+def test_save_load_model_via_s3_url(monkeypatch):
+    """save_model/load_model accept s3:// URLs (CLI --out-model s3://…)."""
+    import real_time_fraud_detection_system_tpu.io.store as store_mod
+    from real_time_fraud_detection_system_tpu.io.artifacts import (
+        load_model,
+        save_model,
+    )
+    from real_time_fraud_detection_system_tpu.models.logreg import (
+        init_logreg,
+    )
+    from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+    from real_time_fraud_detection_system_tpu.models.train import (
+        TrainedModel,
+    )
+
+    import jax.numpy as jnp
+
+    client = FakeS3Client()
+    real_make = store_mod.make_store
+    monkeypatch.setattr(
+        store_mod, "make_store",
+        lambda url, **kw: real_make(url, client=client, **kw),
+    )
+    model = TrainedModel(kind="logreg",
+                         scaler=Scaler(mean=jnp.zeros(15),
+                                       scale=jnp.ones(15)),
+                         params=init_logreg(15))
+    save_model("s3://commerce/models/m.npz", model)
+    assert ("commerce", "models/m.npz") in client.objects
+    back = load_model("s3://commerce/models/m.npz")
+    assert back.kind == "logreg"
+
+
+def test_local_store_sibling_root_not_escapable(tmp_path):
+    st = LocalStore(str(tmp_path / "store"))
+    with pytest.raises(ValueError):
+        st.put("../store-backup/secret", b"x")
+
+
+def test_bucket_only_s3_url_rejected():
+    from real_time_fraud_detection_system_tpu.io.artifacts import save_model
+
+    with pytest.raises(ValueError, match="s3://<bucket>/<key>"):
+        save_model("s3://commerce", None)
