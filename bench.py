@@ -317,12 +317,42 @@ def _child_main(args) -> None:
         )
         eng = ScoringEngine(ecfg, kind="forest", params=params,
                             scaler=scaler)
+        eng.run(_RandSource(1, serve_rows, seed=3), trigger_seconds=0.0)
         st = eng.run(_RandSource(n_eng, serve_rows), trigger_seconds=0.0)
         engine_stats = {
             "rows_per_s": round(st["rows_per_s"], 1),
             "latency_p50_ms": round(st["latency_p50_ms"], 3),
             "latency_p99_ms": round(st["latency_p99_ms"], 3),
         }
+        if on_cpu and skl is not None:
+            # The CPU serving path users actually get (--scorer cpu):
+            # framework feature engine + host-side sklearn classify. This
+            # is the loop to compare with cpu_sklearn_txns_per_sec — the
+            # GEMM loop above is a TPU kernel interpreted on CPU.
+            _progress("cpu-oracle engine loop")
+
+            class _SklOracle:
+                def __init__(self, inner):
+                    self._inner = inner
+
+                def predict_proba(self, x):
+                    return self._inner.predict_proba(x)[:, 1]
+
+            oeng = ScoringEngine(ecfg, kind="forest", params=params,
+                                 scaler=scaler, scorer="cpu",
+                                 cpu_model=_SklOracle(skl))
+            oeng.run(_RandSource(1, serve_rows, seed=3),
+                     trigger_seconds=0.0)  # jit warmup outside the stats
+            ost = oeng.run(_RandSource(n_eng, serve_rows),
+                           trigger_seconds=0.0)
+            engine_stats = {
+                "gemm_on_cpu": engine_stats,
+                "cpu_oracle": {
+                    "rows_per_s": round(ost["rows_per_s"], 1),
+                    "latency_p50_ms": round(ost["latency_p50_ms"], 3),
+                    "latency_p99_ms": round(ost["latency_p99_ms"], 3),
+                },
+            }
 
     # ---- MFU (model FLOPs only, bf16 peak denominator: a lower bound) ---
     flops_row = _model_flops_per_row(params)

@@ -69,7 +69,9 @@ def _split_s3_url(path: str):
     """
     rest = path[len("s3://"):]
     bucket, _, key = rest.partition("/")
-    if not bucket or not key:
+    if not bucket or not key or key.endswith("/"):
+        # Trailing slash = empty object basename: a silent upload under
+        # key "" is worse than an error.
         raise ValueError(
             f"object-store URL needs s3://<bucket>/<key>, got {path!r}"
         )

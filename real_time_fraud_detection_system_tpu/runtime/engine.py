@@ -177,6 +177,13 @@ class ScoringEngine:
                     params.w, params.b,
                 )
                 x = transform(scaler, feats)
+            elif self.scorer == "cpu":
+                # Oracle serving: the classifier runs host-side on the
+                # returned features (process_batch), so don't burn device
+                # time on a predict whose output is discarded.
+                fstate, feats = update_and_featurize(fstate, batch, fcfg)
+                x = transform(scaler, feats)
+                probs = jnp.zeros(batch.valid.shape, jnp.float32)
             else:
                 fstate, feats = update_and_featurize(fstate, batch, fcfg)
                 x = transform(scaler, feats)
@@ -421,6 +428,8 @@ class ScoringEngine:
         every = self.cfg.runtime.checkpoint_every_batches
         latencies: List[float] = []
         t_start = time.perf_counter()
+        rows0 = self.state.rows_done  # report THIS run's throughput, not
+        batches0 = self.state.batches_done  # lifetime totals (warmup runs)
         pending: Optional[dict] = None
 
         def _finish(handle: dict) -> None:
@@ -491,10 +500,12 @@ class ScoringEngine:
         wall = time.perf_counter() - t_start
         lat = np.asarray(latencies) if latencies else np.zeros(1)
         return {
-            "rows": self.state.rows_done,
-            "batches": self.state.batches_done,
+            "rows": self.state.rows_done - rows0,
+            "batches": self.state.batches_done - batches0,
             "wall_s": wall,
-            "rows_per_s": self.state.rows_done / wall if wall > 0 else 0.0,
+            "rows_per_s": (
+                (self.state.rows_done - rows0) / wall if wall > 0 else 0.0
+            ),
             "latency_p50_ms": float(np.percentile(lat, 50) * 1e3),
             "latency_p99_ms": float(np.percentile(lat, 99) * 1e3),
         }

@@ -484,6 +484,12 @@ def run_with_recovery(
             if commit is not None:
                 commit()
             stats["restarts"] = restarts
+            # Whole-session totals: engine.run reports per-run deltas, but
+            # a recovered session's caller wants rows across restarts —
+            # the engine's lifetime counters (checkpoint-restored + this
+            # incarnation) are exactly that.
+            stats["rows"] = engine.state.rows_done
+            stats["batches"] = engine.state.batches_done
             return stats
         except recover_on as e:
             restarts += 1

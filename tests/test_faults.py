@@ -398,3 +398,17 @@ def test_watchdog_escalates_permanent_hang(small_dataset, tmp_path):
                               max_restarts=2, stall_timeout_s=0.4)
     finally:
         _drain_zombies(src.release)
+
+
+def test_recovery_stats_report_whole_session(small_dataset, tmp_path):
+    """A recovered session's stats cover ALL rows scored across restarts,
+    not just the last incarnation's delta."""
+    cfg, txs, make_engine = _mk(small_dataset, tmp_path)
+    part = txs.slice(slice(0, 1024))
+    ckpt = Checkpointer(str(tmp_path / "ck_tot"))
+    src = FlakySource(ReplaySource(part, EPOCH0, batch_rows=256),
+                      fail_at=(3,))
+    stats = run_with_recovery(make_engine, src, ckpt, sink=MemorySink(),
+                              max_restarts=2)
+    assert stats["restarts"] == 1
+    assert stats["rows"] >= 1024  # replays may add, never subtract

@@ -168,3 +168,10 @@ def test_bucket_only_s3_url_rejected():
 
     with pytest.raises(ValueError, match="s3://<bucket>/<key>"):
         save_model("s3://commerce", None)
+
+
+def test_trailing_slash_s3_url_rejected():
+    from real_time_fraud_detection_system_tpu.io.artifacts import save_model
+
+    with pytest.raises(ValueError, match="s3://<bucket>/<key>"):
+        save_model("s3://commerce/models/", None)
