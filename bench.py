@@ -354,6 +354,28 @@ def _child_main(args) -> None:
                 },
             }
 
+    # ---- host ingress: Debezium envelope decode rate --------------------
+    # SURVEY's hard part: 1M txns/s of JSON envelopes bottlenecks on parse
+    # before the TPU; the C++ scanner is the line-rate path.
+    _progress("ingest decode rate")
+    from real_time_fraud_detection_system_tpu.core import native
+    from real_time_fraud_detection_system_tpu.core.envelope import (
+        decode_transaction_envelopes_fast,
+        encode_transaction_envelopes,
+    )
+
+    n_env = 20_000 if args.quick or on_cpu else 100_000
+    env_cols = _make_batch_cols(rng, n_env)
+    msgs = encode_transaction_envelopes(
+        np.arange(n_env, dtype=np.int64), env_cols["tx_datetime_us"],
+        env_cols["customer_id"], env_cols["terminal_id"],
+        env_cols["amount_cents"],
+    )
+    decode_transaction_envelopes_fast(msgs[:256])  # warm (builds C++ lib)
+    t0 = time.perf_counter()
+    decode_transaction_envelopes_fast(msgs)
+    ingest_rate = n_env / (time.perf_counter() - t0)
+
     # ---- MFU (model FLOPs only, bf16 peak denominator: a lower bound) ---
     flops_row = _model_flops_per_row(params)
     peak = _peak_flops(dev.device_kind)
@@ -391,6 +413,9 @@ def _child_main(args) -> None:
         "device": str(dev),
         "device_kind": dev.device_kind,
         "backend": jax.default_backend(),
+        "ingest_envelopes_per_sec": round(ingest_rate, 1),
+        "ingest_decoder": "native" if native.native_available() else
+        "python",
     }
     if cpu_tps is not None:
         detail["cpu_sklearn_txns_per_sec"] = round(cpu_tps, 1)

@@ -1,7 +1,9 @@
 from real_time_fraud_detection_system_tpu.runtime.sources import (  # noqa: F401
     InProcBroker,
+    KafkaSource,
     ReplaySource,
     SyntheticSource,
+    make_kafka_source,
 )
 from real_time_fraud_detection_system_tpu.runtime.engine import (  # noqa: F401
     EngineState,
@@ -12,8 +14,10 @@ from real_time_fraud_detection_system_tpu.runtime.sharded_engine import (  # noq
 )
 from real_time_fraud_detection_system_tpu.runtime.faults import (  # noqa: F401
     FlakySource,
+    HangingSource,
     Heartbeat,
     RetryPolicy,
+    StallError,
     TransientError,
     corrupt_messages,
     run_with_recovery,
@@ -26,6 +30,7 @@ from real_time_fraud_detection_system_tpu.runtime.feedback import (  # noqa: F40
     FEEDBACK_TOPIC,
     FeatureCache,
     FeedbackLoop,
+    KafkaFeedbackSource,
     decode_feedback_envelopes,
     encode_feedback_envelopes,
 )

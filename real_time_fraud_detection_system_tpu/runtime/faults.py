@@ -439,6 +439,7 @@ def run_with_recovery(
     if heartbeat is None and stall_timeout_s > 0:
         heartbeat = Heartbeat(timeout_s=stall_timeout_s)
     last_was_stall = False
+    t_session = time.monotonic()
     while True:
         engine = make_engine()
         if restarts > 0 and make_source is not None:
@@ -487,9 +488,16 @@ def run_with_recovery(
             # Whole-session totals: engine.run reports per-run deltas, but
             # a recovered session's caller wants rows across restarts —
             # the engine's lifetime counters (checkpoint-restored + this
-            # incarnation) are exactly that.
+            # incarnation) are exactly that. wall_s/rows_per_s are made
+            # consistent with them: session wall clock, not the last
+            # incarnation's.
             stats["rows"] = engine.state.rows_done
             stats["batches"] = engine.state.batches_done
+            stats["wall_s"] = time.monotonic() - t_session
+            stats["rows_per_s"] = (
+                stats["rows"] / stats["wall_s"] if stats["wall_s"] > 0
+                else 0.0
+            )
             return stats
         except recover_on as e:
             restarts += 1

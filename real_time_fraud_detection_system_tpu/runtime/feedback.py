@@ -173,6 +173,67 @@ class FeatureCache:
                 self._labeled[sel])
 
 
+class KafkaFeedbackSource:
+    """Production feedback-topic ingress (confluent-kafka, import-gated).
+
+    Exposes ``poll_messages(max_events) → list[bytes]`` — the transport
+    hook :class:`FeedbackLoop` uses when present — over a consumer-group
+    subscription. Delivery is at-least-once: auto-commit is DISABLED and
+    :class:`FeedbackLoop` calls :meth:`commit` only after a drained batch
+    has been applied, so a crash between poll and apply replays the
+    labels instead of dropping them; the loop's idempotence
+    (``mark_labeled`` + latest-wins dedup) absorbs the replays. Transient
+    broker errors raise ``ConnectionError`` (the same escalation policy
+    as the transaction :class:`~.sources.KafkaSource`) — a dead broker
+    must not masquerade as a quiet topic.
+    """
+
+    def __init__(self, bootstrap_servers: str, topic: str = FEEDBACK_TOPIC,
+                 group_id: str = "rtfds-feedback",
+                 poll_timeout_s: float = 0.2, config: dict = None,
+                 consumer_factory=None):
+        import confluent_kafka as ck
+
+        self._ck = ck
+        self.topic = topic
+        conf = {
+            "bootstrap.servers": bootstrap_servers,
+            "group.id": group_id,
+            "enable.auto.commit": False,
+            "auto.offset.reset": "earliest",
+            **(config or {}),
+        }
+        factory = consumer_factory or ck.Consumer
+        self._consumer = factory(conf)
+        self._consumer.subscribe([topic])
+        self.poll_timeout_s = poll_timeout_s
+
+    def poll_messages(self, max_events: int) -> List[bytes]:
+        from real_time_fraud_detection_system_tpu.runtime.sources import (
+            raise_for_kafka_error,
+        )
+
+        out: List[bytes] = []
+        while len(out) < max_events:
+            msg = self._consumer.poll(self.poll_timeout_s if not out else 0.0)
+            if msg is None:
+                break
+            err = msg.error()
+            if err is not None:
+                raise_for_kafka_error(self._ck, err)  # EOF → skip
+                continue
+            if msg.value() is not None:
+                out.append(msg.value())
+        return out
+
+    def commit(self) -> None:
+        """Commit consumed positions (called by the loop AFTER apply)."""
+        self._consumer.commit(asynchronous=False)
+
+    def close(self) -> None:
+        self._consumer.close()
+
+
 class FeedbackLoop:
     """Polls the feedback topic and applies SGD updates to the engine.
 
@@ -180,6 +241,10 @@ class FeedbackLoop:
     loop, BETWEEN micro-batches. The engine's state is not synchronized —
     calling from another thread races with ``process_batch``'s
     read-modify-write of ``state.params`` and can silently drop updates.
+
+    ``broker`` is either an :class:`~.sources.InProcBroker` (dev/test) or
+    any object with ``poll_messages(max_events) → list[bytes]`` — e.g.
+    :class:`KafkaFeedbackSource` in production.
 
     ``cache`` defaults to the engine's own ``feature_cache``.
     """
@@ -196,22 +261,41 @@ class FeedbackLoop:
             )
         self.topic = topic
         self.max_events = max_events
-        self._offsets = [0] * broker.n_partitions
+        self._offsets = (
+            [0] * broker.n_partitions
+            if hasattr(broker, "n_partitions") else []
+        )
         # Decomposition: events == duplicates + missed + (cache hits);
         # applied ⊆ hits (the rest were already labeled or label < 0).
         self.stats = {"events": 0, "applied": 0, "missed": 0,
                       "duplicates": 0}
 
-    def poll_and_apply(self) -> int:
-        """Drain available label events; returns number of rows learned."""
+    def _drain(self) -> List[bytes]:
+        poll_messages = getattr(self.broker, "poll_messages", None)
+        if poll_messages is not None:
+            return poll_messages(self.max_events)
         msgs: List[bytes] = []
         for p in range(self.broker.n_partitions):
             recs = self.broker.poll(self.topic, p, self._offsets[p],
                                     self.max_events)
             self._offsets[p] += len(recs)
             msgs += [r.value for r in recs]
+        return msgs
+
+    def poll_and_apply(self) -> int:
+        """Drain available label events; returns number of rows learned."""
+        msgs = self._drain()
         if not msgs:
             return 0
+        applied = self._apply(msgs)
+        # At-least-once transports (KafkaFeedbackSource) commit only after
+        # apply succeeded: a crash in between replays, never drops.
+        commit = getattr(self.broker, "commit", None)
+        if commit is not None:
+            commit()
+        return applied
+
+    def _apply(self, msgs: List[bytes]) -> int:
         tx_ids, labels, ts_ms = decode_feedback_envelopes(msgs)
         self.stats["events"] += len(tx_ids)
         if len(tx_ids):

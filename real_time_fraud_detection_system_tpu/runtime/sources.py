@@ -222,6 +222,24 @@ class SyntheticSource:
         self._replay.seek(offsets)
 
 
+def raise_for_kafka_error(ck, err) -> bool:
+    """Shared poll-error policy for all Kafka consumers in this runtime.
+
+    Returns True for the end-of-partition marker (caller skips it);
+    raises ``ConnectionError`` for retriable transport/broker errors (the
+    type :func:`~.faults.run_with_recovery`'s default ``recover_on``
+    restarts through — and an honest signal for un-supervised callers,
+    who must not mistake a dead broker for a quiet topic); raises
+    ``KafkaException`` for fatal errors (auth, config)."""
+    if getattr(err, "code", lambda: None)() == getattr(
+        ck.KafkaError, "_PARTITION_EOF", -191
+    ):
+        return True
+    if getattr(err, "retriable", lambda: False)():
+        raise ConnectionError(f"kafka transient error: {err}")
+    raise ck.KafkaException(err)
+
+
 class KafkaSource:
     """Real Kafka consumer → columnar micro-batches.
 
@@ -377,13 +395,7 @@ class KafkaSource:
                     # batch; a persistent error re-surfaces on the next
                     # poll with an empty buffer.
                     break
-                if getattr(err, "retriable", lambda: False)():
-                    # Transient transport/broker errors surface as
-                    # ConnectionError so run_with_recovery's default
-                    # recover_on restarts through them; fatal errors
-                    # (auth, config) crash loudly below.
-                    raise ConnectionError(f"kafka transient error: {err}")
-                raise self._ck.KafkaException(err)
+                raise_for_kafka_error(self._ck, err)
             if msg.value() is None:
                 # Tombstone (CDC delete). Deletes of transactions don't
                 # re-score anything; advance past it.

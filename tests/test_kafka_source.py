@@ -493,3 +493,108 @@ def test_engine_scores_kafka_stream(fake_kafka):
     stats = eng.run(src)
     assert stats["rows"] == 64
     assert eng.state.offsets == [len(logs[0]), len(logs[1])]
+
+
+def test_kafka_feedback_source_drives_loop(fake_kafka):
+    """Production feedback ingress: KafkaFeedbackSource feeds the
+    FeedbackLoop through poll_messages, labels land in the engine."""
+    from real_time_fraud_detection_system_tpu.config import (
+        Config,
+        FeatureConfig,
+        RuntimeConfig,
+    )
+    from real_time_fraud_detection_system_tpu.core.batch import US_PER_DAY
+    from real_time_fraud_detection_system_tpu.models.logreg import init_logreg
+    from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+    from real_time_fraud_detection_system_tpu.runtime import (
+        FEEDBACK_TOPIC,
+        FeatureCache,
+        FeedbackLoop,
+        encode_feedback_envelopes,
+    )
+    from real_time_fraud_detection_system_tpu.runtime.engine import (
+        ScoringEngine,
+    )
+    from real_time_fraud_detection_system_tpu.runtime.feedback import (
+        KafkaFeedbackSource,
+    )
+
+    import jax.numpy as jnp
+
+    n = 8
+    cfg = Config(
+        features=FeatureConfig(customer_capacity=256, terminal_capacity=256),
+        runtime=RuntimeConfig(batch_buckets=(n,), max_batch_rows=n,
+                              trigger_seconds=0.0),
+    )
+    eng = ScoringEngine(cfg, kind="logreg", params=init_logreg(15),
+                        scaler=Scaler(mean=jnp.zeros(15),
+                                      scale=jnp.ones(15)),
+                        feature_cache=FeatureCache(capacity=256))
+    day0 = 20200
+    eng.process_batch({
+        "tx_id": np.arange(n, dtype=np.int64),
+        "tx_datetime_us": np.full(n, day0, np.int64) * US_PER_DAY + 1,
+        "customer_id": np.arange(n, dtype=np.int64),
+        "terminal_id": np.full(n, 7, dtype=np.int64),
+        "tx_amount_cents": np.full(n, 1000, dtype=np.int64),
+        "kafka_ts_ms": np.zeros(n, dtype=np.int64),
+    })
+
+    events = encode_feedback_envelopes(np.arange(n), np.ones(n, np.int64))
+    logs = {0: [fake_kafka._Msg(FEEDBACK_TOPIC, 0, i, b"", m, 1)
+                for i, m in enumerate(events)]}
+
+    def factory(conf):
+        c = fake_kafka.Consumer(conf)
+        c.inject(FEEDBACK_TOPIC, logs)
+        return c
+
+    src = KafkaFeedbackSource("broker:9092", consumer_factory=factory)
+    loop = FeedbackLoop(eng, src)
+    assert loop.poll_and_apply() == n
+    assert loop.poll_and_apply() == 0  # drained; idempotent
+    src.close()
+
+
+def test_feedback_source_at_least_once_commit(fake_kafka):
+    """Auto-commit is off; the loop commits only AFTER applying labels."""
+    from real_time_fraud_detection_system_tpu.runtime.feedback import (
+        FEEDBACK_TOPIC,
+        KafkaFeedbackSource,
+    )
+
+    events = [fake_kafka._Msg(FEEDBACK_TOPIC, 0, 0, b"", b'{"tx_id":1,"label":1}', 1)]
+    holder = {}
+
+    def factory(conf):
+        c = fake_kafka.Consumer(conf)
+        c.inject(FEEDBACK_TOPIC, {0: events})
+        holder["c"] = c
+        return c
+
+    src = KafkaFeedbackSource("b:9092", consumer_factory=factory)
+    assert holder["c"].conf["enable.auto.commit"] is False
+    assert src.poll_messages(10) == [b'{"tx_id":1,"label":1}']
+    assert holder["c"].committed == []  # nothing until the loop applies
+    src.commit()
+    assert len(holder["c"].committed) == 1
+
+
+def test_feedback_source_transient_error_raises(fake_kafka):
+    from real_time_fraud_detection_system_tpu.runtime.feedback import (
+        FEEDBACK_TOPIC,
+        KafkaFeedbackSource,
+    )
+
+    bad = fake_kafka._Msg(FEEDBACK_TOPIC, 0, 0, None, None, 0,
+                          err=fake_kafka.KafkaError(-195, retriable=True))
+
+    def factory(conf):
+        c = fake_kafka.Consumer(conf)
+        c.inject(FEEDBACK_TOPIC, {0: [bad]})
+        return c
+
+    src = KafkaFeedbackSource("b:9092", consumer_factory=factory)
+    with pytest.raises(ConnectionError, match="transient"):
+        src.poll_messages(10)
