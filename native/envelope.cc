@@ -147,6 +147,67 @@ bool parse_str(const char* p, const char* e, const char** s, const char** e2) {
   return true;
 }
 
+// Parse one envelope [m, e) into row i of the output columns.
+// Returns 1 when the row is valid.
+static int parse_envelope(
+    const char* m, const char* e, int64_t i,
+    int64_t* tx_id, int64_t* t_us, int64_t* cust, int64_t* term,
+    int64_t* cents, int8_t* op, uint8_t* valid) {
+  tx_id[i] = t_us[i] = cust[i] = term[i] = cents[i] = 0;
+  op[i] = 0;
+  valid[i] = 0;
+
+  const char* p = ws(m, e);
+  if (p >= e || *p != '{') return 0;
+  const char* payload = find_key(p, e, "payload");
+  if (!payload || is_null(payload, e)) return 0;
+  payload = ws(payload, e);
+  if (payload >= e || *payload != '{') return 0;
+
+  // op code (optional; default 'c')
+  const char* opv = find_key(payload, e, "op");
+  if (opv) {
+    const char *s, *se;
+    if (parse_str(opv, e, &s, &se) && se > s) {
+      switch (*s) {
+        case 'c': op[i] = 0; break;
+        case 'u': op[i] = 1; break;
+        case 'd': op[i] = 2; break;
+        case 'r': op[i] = 3; break;
+        default: op[i] = 0; break;
+      }
+    }
+  }
+
+  const char* row = find_key(payload, e, "after");
+  if (!row || is_null(row, e)) row = find_key(payload, e, "before");
+  if (!row || is_null(row, e)) return 0;
+  row = ws(row, e);
+  if (row >= e || *row != '{') return 0;
+
+  const char* v;
+  if (!(v = find_key(row, e, "tx_id")) || !parse_int(v, e, &tx_id[i]))
+    return 0;
+  if (!(v = find_key(row, e, "tx_datetime")) || !parse_int(v, e, &t_us[i]))
+    return 0;
+  if (!(v = find_key(row, e, "customer_id")) || !parse_int(v, e, &cust[i]))
+    return 0;
+  if (!(v = find_key(row, e, "terminal_id")) || !parse_int(v, e, &term[i]))
+    return 0;
+  v = find_key(row, e, "tx_amount");
+  if (v) {
+    if (is_null(v, e)) {
+      cents[i] = 0;
+    } else {
+      const char *s, *se;
+      if (!parse_str(v, e, &s, &se) || !b64_to_cents(s, se, &cents[i]))
+        return 0;
+    }
+  }
+  valid[i] = 1;
+  return 1;
+}
+
 }  // namespace
 
 extern "C" {
@@ -159,61 +220,8 @@ int64_t decode_envelopes(
     int64_t* cents, int8_t* op, uint8_t* valid) {
   int64_t nvalid = 0;
   for (int64_t i = 0; i < n; ++i) {
-    const char* m = buf + offsets[i];
-    const char* e = buf + offsets[i + 1];
-    tx_id[i] = t_us[i] = cust[i] = term[i] = cents[i] = 0;
-    op[i] = 0;
-    valid[i] = 0;
-
-    const char* p = ws(m, e);
-    if (p >= e || *p != '{') continue;
-    const char* payload = find_key(p, e, "payload");
-    if (!payload || is_null(payload, e)) continue;
-    payload = ws(payload, e);
-    if (payload >= e || *payload != '{') continue;
-
-    // op code (optional; default 'c')
-    const char* opv = find_key(payload, e, "op");
-    if (opv) {
-      const char *s, *se;
-      if (parse_str(opv, e, &s, &se) && se > s) {
-        switch (*s) {
-          case 'c': op[i] = 0; break;
-          case 'u': op[i] = 1; break;
-          case 'd': op[i] = 2; break;
-          case 'r': op[i] = 3; break;
-          default: op[i] = 0; break;
-        }
-      }
-    }
-
-    const char* row = find_key(payload, e, "after");
-    if (!row || is_null(row, e)) row = find_key(payload, e, "before");
-    if (!row || is_null(row, e)) continue;
-    row = ws(row, e);
-    if (row >= e || *row != '{') continue;
-
-    const char* v;
-    if (!(v = find_key(row, e, "tx_id")) || !parse_int(v, e, &tx_id[i]))
-      continue;
-    if (!(v = find_key(row, e, "tx_datetime")) || !parse_int(v, e, &t_us[i]))
-      continue;
-    if (!(v = find_key(row, e, "customer_id")) || !parse_int(v, e, &cust[i]))
-      continue;
-    if (!(v = find_key(row, e, "terminal_id")) || !parse_int(v, e, &term[i]))
-      continue;
-    v = find_key(row, e, "tx_amount");
-    if (v) {
-      if (is_null(v, e)) {
-        cents[i] = 0;
-      } else {
-        const char *s, *se;
-        if (!parse_str(v, e, &s, &se) || !b64_to_cents(s, se, &cents[i]))
-          continue;
-      }
-    }
-    valid[i] = 1;
-    ++nvalid;
+    nvalid += parse_envelope(buf + offsets[i], buf + offsets[i + 1], i,
+                             tx_id, t_us, cust, term, cents, op, valid);
   }
   return nvalid;
 }

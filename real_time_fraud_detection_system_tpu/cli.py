@@ -321,9 +321,19 @@ def cmd_query(args) -> int:
     """Dashboard reports over analyzed output (the Trino/Superset role)."""
     from real_time_fraud_detection_system_tpu.io.query import (
         load_analyzed,
+        raw_transactions_report,
         report,
     )
 
+    if args.report == "transactions":
+        # Raw-table report: --data is the day-partitioned table directory
+        # (e.g. <demo-out>/transactions).
+        try:
+            print(_json_line(raw_transactions_report(args.data)))
+        except FileNotFoundError as e:
+            print(_json_line({"error": str(e)}))
+            return 2
+        return 0
     cols = load_analyzed(args.data)
     out = report(cols, kind=args.report, threshold=args.threshold,
                  k=args.top_k, bucket=args.bucket)
@@ -430,10 +440,12 @@ def main(argv=None) -> int:
     p = sub.add_parser("query",
                        help="dashboard reports over analyzed parquet output")
     p.add_argument("--data", required=True,
-                   help="analyzed output directory (ParquetSink)")
+                   help="analyzed output directory (ParquetSink); for "
+                        "--report transactions, the raw day-partitioned "
+                        "table directory (tx_date=*/ layout)")
     p.add_argument("--report", default="summary",
                    choices=["summary", "timeseries", "terminals",
-                            "customers", "alerts"])
+                            "customers", "alerts", "transactions"])
     p.add_argument("--threshold", type=float, default=0.5)
     p.add_argument("--top-k", type=int, default=10)
     p.add_argument("--bucket", default="day", choices=["hour", "day"])

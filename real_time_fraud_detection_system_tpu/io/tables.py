@@ -246,7 +246,9 @@ class RawTransactionsTable:
         if directory is not None:
             import os as _os
 
-            _os.makedirs(directory, exist_ok=True)
+            # Resume the part sequence; directory creation is deferred to
+            # the first flush so read-only uses (query reports) never
+            # create paths as a side effect.
             for f in _glob_parts(directory):
                 seq = int(_os.path.basename(f).split("-")[1].split(".")[0])
                 self._flush_seq = max(self._flush_seq, seq + 1)

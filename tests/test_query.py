@@ -102,3 +102,36 @@ def test_report_dispatch_and_cli(analyzed, tmp_path):
     )
     assert out.returncode == 0, out.stderr
     assert json.loads(out.stdout.strip().splitlines()[-1])["transactions"] == 8
+
+
+def test_raw_transactions_report(tmp_path):
+    """Engine-written raw rows read back through the query layer (the
+    reference's queryable day-partitioned transactions table)."""
+    import numpy as np
+
+    from real_time_fraud_detection_system_tpu.io.query import (
+        raw_transactions_report,
+    )
+    from real_time_fraud_detection_system_tpu.io.tables import (
+        RawTransactionsTable,
+    )
+
+    d = str(tmp_path / "transactions")
+    tab = RawTransactionsTable(d)
+    us = 86400 * 1_000_000
+    tab.merge({
+        "tx_id": np.arange(6, dtype=np.int64),
+        "tx_datetime_us": np.array(
+            [20200, 20200, 20200, 20201, 20201, 20202], np.int64) * us + 7,
+        "customer_id": np.array([1, 2, 1, 3, 1, 2], np.int64),
+        "terminal_id": np.array([10, 11, 10, 12, 10, 11], np.int64),
+        "tx_amount_cents": np.array([100, 200, 300, 400, 500, 600],
+                                    np.int64),
+    })
+    tab.flush()
+    rep = raw_transactions_report(d)
+    assert rep["transactions"] == 6
+    assert rep["customers"] == 3
+    assert rep["total_amount"] == 21.0
+    assert [x["transactions"] for x in rep["days"]] == [3, 2, 1]
+    assert rep["days"][0]["day"].startswith("2025-")
