@@ -189,7 +189,7 @@ def raw_transactions_report(directory: str) -> dict:
         "total_amount": round(float(cols["tx_amount_cents"].sum()) / 100.0,
                               2),
         "days": [
-            {"day": RawTransactionsTable._day_str(int(d)),
+            {"day": RawTransactionsTable.day_str(int(d)),
              "transactions": int(c), "amount": round(float(a), 2)}
             for d, c, a in zip(uniq, counts, amounts)
         ],

@@ -204,6 +204,14 @@ class UpsertTable:
             name: self._cols[name][live] for name, _ in self.schema.fields
         }
 
+    def rows_at(self, slots: np.ndarray) -> Dict[str, np.ndarray]:
+        """Live rows at the given slot indices (dead slots dropped) —
+        the incremental-persistence read used with ``last_merged_slots``."""
+        slots = slots[self._live[slots]]
+        return {
+            name: self._cols[name][slots] for name, _ in self.schema.fields
+        }
+
 
 _US_PER_DAY = 86400 * 1_000_000
 
@@ -257,7 +265,7 @@ class RawTransactionsTable:
         return len(self._table)
 
     @staticmethod
-    def _day_str(day: int) -> str:
+    def day_str(day: int) -> str:
         import datetime
 
         return (
@@ -304,12 +312,8 @@ class RawTransactionsTable:
 
         slots = np.fromiter(self._pending, dtype=np.int64,
                             count=len(self._pending))
-        live = self._table._live[slots]
-        slots = slots[live]  # deletes don't emit parts (CDC tx never dies)
-        rows = {
-            name: self._table._cols[name][slots]
-            for name, _ in self._table.schema.fields
-        }
+        # Dead slots dropped: deletes don't emit parts (CDC tx never dies).
+        rows = self._table.rows_at(slots)
         days = rows["tx_datetime_us"] // _US_PER_DAY
         seq = self._flush_seq
         self._flush_seq += 1
@@ -317,7 +321,7 @@ class RawTransactionsTable:
         for day in np.unique(days):
             sel = np.flatnonzero(days == day)
             part_dir = _os.path.join(
-                self.directory, f"tx_date={self._day_str(int(day))}"
+                self.directory, f"tx_date={self.day_str(int(day))}"
             )
             _os.makedirs(part_dir, exist_ok=True)
             pq.write_table(

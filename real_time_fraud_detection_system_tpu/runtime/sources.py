@@ -408,13 +408,9 @@ class KafkaSource:
         if not msgs:
             if idle_deadline is not None:
                 return None
-            return {
-                name: np.zeros(0, np.int64)
-                for name in (
-                    "tx_id", "tx_datetime_us", "customer_id",
-                    "terminal_id", "tx_amount_cents", "kafka_ts_ms",
-                )
-            }
+            # Zero-row batch with the decoder's exact column contract
+            # (same keys/dtypes as the non-empty path below).
+            return decode_transaction_envelopes_fast([], [])[0]
         cols, invalid = decode_transaction_envelopes_fast(msgs, ts_ms)
         if invalid.any():
             keep = ~invalid
