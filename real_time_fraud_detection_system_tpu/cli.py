@@ -125,7 +125,7 @@ def cmd_score(args) -> int:
         load_model,
         load_transactions,
     )
-    from real_time_fraud_detection_system_tpu.io.checkpoint import Checkpointer
+    from real_time_fraud_detection_system_tpu.io.checkpoint import make_checkpointer
     from real_time_fraud_detection_system_tpu.runtime import (
         ReplaySource,
         ScoringEngine,
@@ -197,7 +197,7 @@ def cmd_score(args) -> int:
             mode=args.mode,
             with_labels=args.online_lr > 0,
         )
-    ckpt = Checkpointer(args.checkpoint_dir) if args.checkpoint_dir else None
+    ckpt = make_checkpointer(args.checkpoint_dir) if args.checkpoint_dir else None
     sink = ParquetSink(args.out) if args.out else None
     raw_table = None
     if args.raw_table:

@@ -7,6 +7,13 @@ from real_time_fraud_detection_system_tpu.io.sink import (  # noqa: F401
 )
 from real_time_fraud_detection_system_tpu.io.checkpoint import (  # noqa: F401
     Checkpointer,
+    StoreCheckpointer,
+    make_checkpointer,
+)
+from real_time_fraud_detection_system_tpu.io.store import (  # noqa: F401
+    LocalStore,
+    S3Store,
+    make_store,
 )
 from real_time_fraud_detection_system_tpu.io.tables import (  # noqa: F401
     RawTransactionsTable,

@@ -13,12 +13,70 @@ together).
 
 from __future__ import annotations
 
+import io as _io
 import json
 import os
 from typing import Optional
 
 import jax
 import numpy as np
+
+
+def write_state_npz(fileobj, engine_state) -> None:
+    """Stream an EngineState (or any object with feature_state/params/
+    scaler/offsets/batches_done/rows_done) as npz into a file object."""
+    leaves_fs, _ = jax.tree_util.tree_flatten(engine_state.feature_state)
+    leaves_p, _ = jax.tree_util.tree_flatten(engine_state.params)
+    leaves_s, _ = jax.tree_util.tree_flatten(engine_state.scaler)
+    arrays = {}
+    for i, leaf in enumerate(leaves_fs):
+        arrays[f"fs_{i}"] = np.asarray(leaf)
+    for i, leaf in enumerate(leaves_p):
+        arrays[f"p_{i}"] = np.asarray(leaf)
+    for i, leaf in enumerate(leaves_s):
+        arrays[f"s_{i}"] = np.asarray(leaf)
+    meta = {
+        "offsets": list(map(int, engine_state.offsets)),
+        "batches_done": int(engine_state.batches_done),
+        "rows_done": int(engine_state.rows_done),
+        "n_fs": len(leaves_fs),
+        "n_p": len(leaves_p),
+        "n_s": len(leaves_s),
+    }
+    np.savez(fileobj, __meta__=json.dumps(meta), **arrays)
+
+
+def state_to_bytes(engine_state) -> bytes:
+    """npz bytes of an EngineState (object-store PUT payload)."""
+    buf = _io.BytesIO()
+    write_state_npz(buf, engine_state)
+    return buf.getvalue()
+
+
+def bytes_to_state(data: bytes, engine_state):
+    """Restore npz bytes into an EngineState template (same shapes);
+    returns the mutated engine_state."""
+    with np.load(_io.BytesIO(data), allow_pickle=False) as z:
+        meta = json.loads(str(z["__meta__"]))
+        fs_leaves = [z[f"fs_{i}"] for i in range(meta["n_fs"])]
+        p_leaves = [z[f"p_{i}"] for i in range(meta["n_p"])]
+        s_leaves = [z[f"s_{i}"] for i in range(meta["n_s"])]
+    _, fs_def = jax.tree_util.tree_flatten(engine_state.feature_state)
+    _, p_def = jax.tree_util.tree_flatten(engine_state.params)
+    _, s_def = jax.tree_util.tree_flatten(engine_state.scaler)
+    engine_state.feature_state = jax.tree_util.tree_unflatten(
+        fs_def, [jax.numpy.asarray(a) for a in fs_leaves]
+    )
+    engine_state.params = jax.tree_util.tree_unflatten(
+        p_def, [jax.numpy.asarray(a) for a in p_leaves]
+    )
+    engine_state.scaler = jax.tree_util.tree_unflatten(
+        s_def, [jax.numpy.asarray(a) for a in s_leaves]
+    )
+    engine_state.offsets = meta["offsets"]
+    engine_state.batches_done = meta["batches_done"]
+    engine_state.rows_done = meta["rows_done"]
+    return engine_state
 
 
 class Checkpointer:
@@ -31,40 +89,41 @@ class Checkpointer:
         return os.path.join(self.directory, f"ckpt-{step:010d}.npz")
 
     def save(self, engine_state) -> str:
-        """Serialize an EngineState (or any object with feature_state/params/
-        scaler/offsets/batches_done/rows_done)."""
-        leaves_fs, _ = jax.tree_util.tree_flatten(engine_state.feature_state)
-        leaves_p, _ = jax.tree_util.tree_flatten(engine_state.params)
-        leaves_s, _ = jax.tree_util.tree_flatten(engine_state.scaler)
-        arrays = {}
-        for i, leaf in enumerate(leaves_fs):
-            arrays[f"fs_{i}"] = np.asarray(leaf)
-        for i, leaf in enumerate(leaves_p):
-            arrays[f"p_{i}"] = np.asarray(leaf)
-        for i, leaf in enumerate(leaves_s):
-            arrays[f"s_{i}"] = np.asarray(leaf)
-        meta = {
-            "offsets": list(map(int, engine_state.offsets)),
-            "batches_done": int(engine_state.batches_done),
-            "rows_done": int(engine_state.rows_done),
-            "n_fs": len(leaves_fs),
-            "n_p": len(leaves_p),
-            "n_s": len(leaves_s),
-        }
         path = self._path(engine_state.batches_done)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
-            np.savez(f, __meta__=json.dumps(meta), **arrays)
+            write_state_npz(f, engine_state)  # streamed, no bytes copy
         os.replace(tmp, path)  # atomic on POSIX
         self._gc()
         return path
 
-    def latest(self) -> Optional[str]:
-        ckpts = sorted(
-            f for f in os.listdir(self.directory)
+    def list_checkpoints(self) -> list:
+        """Live checkpoint paths, oldest → newest (lineage API used by the
+        crash-recovery fence, ``runtime/faults._FencedCheckpointer``)."""
+        return [
+            os.path.join(self.directory, f)
+            for f in sorted(os.listdir(self.directory))
             if f.startswith("ckpt-") and f.endswith(".npz")
-        )
-        return os.path.join(self.directory, ckpts[-1]) if ckpts else None
+        ]
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def quarantine(self, paths, token: str) -> None:
+        """Hide a previous run's lineage from ``latest()``/GC: rename to
+        ``stale-<token>-…`` (bytes preserved). Clears any earlier stash
+        first so repeated fresh runs keep one quarantine, not a pile."""
+        for old in os.listdir(self.directory):
+            if old.startswith("stale-") and old.endswith(".npz"):
+                os.remove(os.path.join(self.directory, old))
+        for p in paths:
+            if os.path.exists(p):
+                d, f = os.path.split(p)
+                os.replace(p, os.path.join(d, f"stale-{token}-{f}"))
+
+    def latest(self) -> Optional[str]:
+        ckpts = self.list_checkpoints()
+        return ckpts[-1] if ckpts else None
 
     def restore(self, engine_state, path: Optional[str] = None):
         """Restore into an EngineState template (same model/config shapes).
@@ -74,32 +133,88 @@ class Checkpointer:
         path = path or self.latest()
         if path is None:
             return None
-        with np.load(path, allow_pickle=False) as z:
-            meta = json.loads(str(z["__meta__"]))
-            fs_leaves = [z[f"fs_{i}"] for i in range(meta["n_fs"])]
-            p_leaves = [z[f"p_{i}"] for i in range(meta["n_p"])]
-            s_leaves = [z[f"s_{i}"] for i in range(meta["n_s"])]
-        _, fs_def = jax.tree_util.tree_flatten(engine_state.feature_state)
-        _, p_def = jax.tree_util.tree_flatten(engine_state.params)
-        _, s_def = jax.tree_util.tree_flatten(engine_state.scaler)
-        engine_state.feature_state = jax.tree_util.tree_unflatten(
-            fs_def, [jax.numpy.asarray(a) for a in fs_leaves]
-        )
-        engine_state.params = jax.tree_util.tree_unflatten(
-            p_def, [jax.numpy.asarray(a) for a in p_leaves]
-        )
-        engine_state.scaler = jax.tree_util.tree_unflatten(
-            s_def, [jax.numpy.asarray(a) for a in s_leaves]
-        )
-        engine_state.offsets = meta["offsets"]
-        engine_state.batches_done = meta["batches_done"]
-        engine_state.rows_done = meta["rows_done"]
-        return engine_state
+        with open(path, "rb") as f:
+            return bytes_to_state(f.read(), engine_state)
 
     def _gc(self) -> None:
-        ckpts = sorted(
-            f for f in os.listdir(self.directory)
-            if f.startswith("ckpt-") and f.endswith(".npz")
-        )
-        for f in ckpts[: -self.keep]:
-            os.remove(os.path.join(self.directory, f))
+        for p in self.list_checkpoints()[: -self.keep]:
+            os.remove(p)
+
+
+class StoreCheckpointer:
+    """Checkpointer over an object store — the reference's
+    ``checkpointLocation`` on s3a (``fraud_detection.py:63``,
+    ``kafka_s3_sink_*.py:11``): streaming state durable in MinIO/S3, not
+    on an ephemeral host disk. Object PUTs are atomic, so no tmp+rename
+    dance is needed. Same save/restore/latest contract as
+    :class:`Checkpointer`; ``store`` is any :mod:`..io.store` object.
+    """
+
+    def __init__(self, store, prefix: str = "checkpoints", keep: int = 3):
+        self.store = store
+        self.prefix = prefix.strip("/")
+        self.keep = keep
+
+    def _key(self, step: int) -> str:
+        name = f"ckpt-{step:010d}.npz"
+        return f"{self.prefix}/{name}" if self.prefix else name
+
+    def _list(self):
+        pre = self.prefix + "/" if self.prefix else ""
+        return [
+            k for k in self.store.list(pre)
+            if k.rsplit("/", 1)[-1].startswith("ckpt-")
+            and k.endswith(".npz")
+        ]
+
+    def save(self, engine_state) -> str:
+        key = self._key(engine_state.batches_done)
+        self.store.put(key, state_to_bytes(engine_state))
+        for old in sorted(self._list())[: -self.keep]:
+            self.store.delete(old)
+        return key
+
+    def list_checkpoints(self) -> list:
+        return sorted(self._list())
+
+    def exists(self, key: str) -> bool:
+        return self.store.exists(key)
+
+    def quarantine(self, keys, token: str) -> None:
+        """Hide a previous run's lineage (fresh-start fence): move keys to
+        ``stale-<token>-…`` names, invisible to ``_list``'s ``ckpt-``
+        filter — so this run's retention GC can't be tricked into deleting
+        its own saves by stale higher-numbered checkpoints, and
+        ``latest()`` never resurrects them. Clears earlier stashes first."""
+        pre = self.prefix + "/" if self.prefix else ""
+        for k in self.store.list(pre):
+            if k.rsplit("/", 1)[-1].startswith("stale-"):
+                self.store.delete(k)
+        for k in keys:
+            if not self.store.exists(k):
+                continue
+            head, _, name = k.rpartition("/")
+            stale = (f"{head}/" if head else "") + f"stale-{token}-{name}"
+            self.store.put(stale, self.store.get(k))
+            self.store.delete(k)
+
+    def latest(self) -> Optional[str]:
+        keys = sorted(self._list())
+        return keys[-1] if keys else None
+
+    def restore(self, engine_state, path: Optional[str] = None):
+        key = path or self.latest()
+        if key is None:
+            return None
+        return bytes_to_state(self.store.get(key), engine_state)
+
+
+def make_checkpointer(path_or_url: str, keep: int = 3):
+    """``s3://bucket/prefix`` → :class:`StoreCheckpointer`; local path →
+    :class:`Checkpointer`."""
+    if path_or_url.startswith("s3://"):
+        from real_time_fraud_detection_system_tpu.io.store import make_store
+
+        return StoreCheckpointer(make_store(path_or_url), prefix="",
+                                 keep=keep)
+    return Checkpointer(path_or_url, keep=keep)

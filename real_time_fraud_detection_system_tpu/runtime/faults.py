@@ -210,43 +210,24 @@ class _FencedCheckpointer:
     def __init__(self, inner):
         self.inner = inner
         self._saved: list = []
-        self._stale: list = []
-        directory = getattr(inner, "directory", None)
-        if directory and os.path.isdir(directory):
-            self._stale = [
-                os.path.join(directory, f)
-                for f in sorted(os.listdir(directory))
-                if f.startswith("ckpt-") and f.endswith(".npz")
-            ]
-
-    def _quarantine_stale(self) -> None:
-        # Retention: one stash only — clear any previous run's stale-*
-        # files first, so repeated resume=False runs on a persistent dir
-        # keep at most `keep` quarantined snapshots, not an unbounded pile.
-        dirs = {os.path.dirname(p) for p in self._stale}
-        for d in dirs:
-            for old in os.listdir(d):
-                if old.startswith("stale-") and old.endswith(".npz"):
-                    os.remove(os.path.join(d, old))
-        token = uuid.uuid4().hex[:8]
-        for p in self._stale:
-            if os.path.exists(p):
-                d, f = os.path.split(p)
-                os.replace(p, os.path.join(d, f"stale-{token}-{f}"))
-        self._stale = []
+        # Lineage API (Checkpointer AND StoreCheckpointer provide it):
+        # record the pre-existing checkpoints to quarantine on first save.
+        self._stale: list = list(inner.list_checkpoints())
 
     def save(self, engine_state):
         if self._stale:
-            self._quarantine_stale()
+            self.inner.quarantine(self._stale, uuid.uuid4().hex[:8])
+            self._stale = []
         path = self.inner.save(engine_state)
         self._saved.append(path)
         return path
 
     def restore(self, engine_state, path=None):
-        import os as _os
-
         if path is None:
-            mine = [p for p in self._saved if _os.path.exists(p)]
+            # inner.exists filters saves the inner's own GC removed —
+            # storage-agnostic (os.path.exists would wrongly drop every
+            # object-store key).
+            mine = [p for p in self._saved if self.inner.exists(p)]
             if not mine:
                 return None
             path = max(mine)

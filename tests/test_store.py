@@ -175,3 +175,61 @@ def test_trailing_slash_s3_url_rejected():
 
     with pytest.raises(ValueError, match="s3://<bucket>/<key>"):
         save_model("s3://commerce/models/", None)
+
+
+def test_store_checkpointer_roundtrip(store):
+    """Streaming state checkpointed to an object store (the reference's
+    checkpointLocation-on-s3a role) restores exactly, with retention."""
+    import jax.numpy as jnp
+
+    from real_time_fraud_detection_system_tpu.io.checkpoint import (
+        StoreCheckpointer,
+    )
+    from real_time_fraud_detection_system_tpu.models.logreg import (
+        init_logreg,
+    )
+    from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+    from real_time_fraud_detection_system_tpu.runtime.engine import (
+        EngineState,
+    )
+
+    def mk_state(batches):
+        return EngineState(
+            feature_state={"w": jnp.arange(4.0) * batches},
+            params=init_logreg(15),
+            scaler=Scaler(mean=jnp.zeros(15), scale=jnp.ones(15)),
+            offsets=[batches, batches * 2],
+            batches_done=batches,
+            rows_done=batches * 100,
+        )
+
+    ck = StoreCheckpointer(store, keep=2)
+    for b in (1, 2, 3, 4):
+        ck.save(mk_state(b))
+    assert len(ck._list()) == 2  # retention
+    assert ck.latest().endswith("ckpt-0000000004.npz")
+
+    tmpl = mk_state(0)
+    out = ck.restore(tmpl)
+    assert out.batches_done == 4
+    assert out.offsets == [4, 8]
+    np.testing.assert_allclose(np.asarray(out.feature_state["w"]),
+                               np.arange(4.0) * 4)
+
+
+def test_make_checkpointer_dispatch(tmp_path, monkeypatch):
+    import real_time_fraud_detection_system_tpu.io.store as store_mod
+    from real_time_fraud_detection_system_tpu.io.checkpoint import (
+        Checkpointer,
+        StoreCheckpointer,
+        make_checkpointer,
+    )
+
+    assert isinstance(make_checkpointer(str(tmp_path / "ck")), Checkpointer)
+    real_make = store_mod.make_store
+    monkeypatch.setattr(
+        store_mod, "make_store",
+        lambda url, **kw: real_make(url, client=FakeS3Client(), **kw),
+    )
+    ck = make_checkpointer("s3://commerce/checkpoints")
+    assert isinstance(ck, StoreCheckpointer)
