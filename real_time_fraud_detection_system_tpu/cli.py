@@ -303,6 +303,7 @@ def cmd_demo(args) -> int:
         model_kind=args.model,
         out_dir=args.out or None,
         batch_rows=args.batch_rows,
+        n_devices=args.devices,
     )
     print(_json_line(summary))
     return 0
@@ -435,6 +436,8 @@ def main(argv=None) -> int:
     p.add_argument("--delta-test", type=int, default=20)
     p.add_argument("--batch-rows", type=int, default=4096)
     p.add_argument("--out", default="")
+    p.add_argument("--devices", type=int, default=1,
+                   help="serve the scoring leg on an N-device mesh")
     p.set_defaults(fn=cmd_demo)
 
     p = sub.add_parser("query",

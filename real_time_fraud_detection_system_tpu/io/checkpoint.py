@@ -56,7 +56,13 @@ def state_to_bytes(engine_state) -> bytes:
 def bytes_to_state(data: bytes, engine_state):
     """Restore npz bytes into an EngineState template (same shapes);
     returns the mutated engine_state."""
-    with np.load(_io.BytesIO(data), allow_pickle=False) as z:
+    return read_state_npz(_io.BytesIO(data), engine_state)
+
+
+def read_state_npz(fileobj, engine_state):
+    """Restore npz from a file object into an EngineState template —
+    streaming (np.load reads arrays directly; no whole-file bytes copy)."""
+    with np.load(fileobj, allow_pickle=False) as z:
         meta = json.loads(str(z["__meta__"]))
         fs_leaves = [z[f"fs_{i}"] for i in range(meta["n_fs"])]
         p_leaves = [z[f"p_{i}"] for i in range(meta["n_p"])]
@@ -134,7 +140,7 @@ class Checkpointer:
         if path is None:
             return None
         with open(path, "rb") as f:
-            return bytes_to_state(f.read(), engine_state)
+            return read_state_npz(f, engine_state)
 
     def _gc(self) -> None:
         for p in self.list_checkpoints()[: -self.keep]:
@@ -160,11 +166,14 @@ class StoreCheckpointer:
         return f"{self.prefix}/{name}" if self.prefix else name
 
     def _list(self):
+        # Flat-directory semantics (matching Checkpointer's os.listdir):
+        # keys nested deeper under the prefix belong to OTHER lineages
+        # (e.g. a sibling job's prefix) and must not be GC'd/restored.
         pre = self.prefix + "/" if self.prefix else ""
         return [
             k for k in self.store.list(pre)
-            if k.rsplit("/", 1)[-1].startswith("ckpt-")
-            and k.endswith(".npz")
+            if k[len(pre):].startswith("ckpt-") and k.endswith(".npz")
+            and "/" not in k[len(pre):]
         ]
 
     def save(self, engine_state) -> str:
@@ -185,18 +194,25 @@ class StoreCheckpointer:
         ``stale-<token>-…`` names, invisible to ``_list``'s ``ckpt-``
         filter — so this run's retention GC can't be tricked into deleting
         its own saves by stale higher-numbered checkpoints, and
-        ``latest()`` never resurrects them. Clears earlier stashes first."""
+        ``latest()`` never resurrects them. Clears earlier stashes first;
+        live bytes are moved (server-side copy on S3), never deleted
+        before the copy lands."""
         pre = self.prefix + "/" if self.prefix else ""
         for k in self.store.list(pre):
-            if k.rsplit("/", 1)[-1].startswith("stale-"):
+            name = k[len(pre):]
+            if name.startswith("stale-") and "/" not in name:
                 self.store.delete(k)
         for k in keys:
             if not self.store.exists(k):
                 continue
             head, _, name = k.rpartition("/")
             stale = (f"{head}/" if head else "") + f"stale-{token}-{name}"
-            self.store.put(stale, self.store.get(k))
-            self.store.delete(k)
+            move = getattr(self.store, "move", None)
+            if move is not None:
+                move(k, stale)
+            else:  # duck-typed store without move: copy-then-delete
+                self.store.put(stale, self.store.get(k))
+                self.store.delete(k)
 
     def latest(self) -> Optional[str]:
         keys = sorted(self._list())

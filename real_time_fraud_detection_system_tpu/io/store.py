@@ -62,6 +62,12 @@ class LocalStore:
         except FileNotFoundError:
             pass
 
+    def move(self, src: str, dst: str) -> None:
+        """Atomic rename within the store."""
+        d = self._path(dst)
+        os.makedirs(os.path.dirname(d) or ".", exist_ok=True)
+        os.replace(self._path(src), d)
+
     def list(self, prefix: str = "") -> List[str]:
         out = []
         for dirpath, _, files in os.walk(self.root):
@@ -141,6 +147,15 @@ class S3Store:
 
     def delete(self, key: str) -> None:
         self.client.delete_object(Bucket=self.bucket, Key=self._key(key))
+
+    def move(self, src: str, dst: str) -> None:
+        """Server-side copy + delete (no byte round-trip through the
+        host; S3 has no native rename)."""
+        self.client.copy_object(
+            Bucket=self.bucket, Key=self._key(dst),
+            CopySource={"Bucket": self.bucket, "Key": self._key(src)},
+        )
+        self.client.delete_object(Bucket=self.bucket, Key=self._key(src))
 
     def list(self, prefix: str = "") -> List[str]:
         full = self._key(prefix)

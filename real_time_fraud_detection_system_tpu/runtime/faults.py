@@ -22,7 +22,6 @@ equivalents plus the missing injection tools:
 
 from __future__ import annotations
 
-import os
 import time
 import uuid
 from dataclasses import dataclass

@@ -89,8 +89,13 @@ def run_demo(
     out_dir: Optional[str] = None,
     stream_days: Optional[int] = None,
     batch_rows: int = 4096,
+    n_devices: int = 1,
 ) -> dict:
-    """Full generate → CDC → sink → score flow; returns a summary dict."""
+    """Full generate → CDC → sink → score flow; returns a summary dict.
+
+    ``n_devices > 1`` serves the scoring leg on the sharded multi-chip
+    engine (customer-partitioned rows, terminal all_to_all) — the same
+    E2E movie at the reference's scaled-out deployment shape."""
     from real_time_fraud_detection_system_tpu.data.generator import (
         generate_dataset,
     )
@@ -154,9 +159,19 @@ def run_demo(
     stream = txs.slice(np.flatnonzero(stream_mask))
     warm = txs.slice(np.flatnonzero(~stream_mask))
 
-    engine = ScoringEngine(
-        cfg, kind=model_kind, params=model.params, scaler=model.scaler
-    )
+    if n_devices > 1:
+        from real_time_fraud_detection_system_tpu.runtime.sharded_engine import (
+            ShardedScoringEngine,
+        )
+
+        engine = ShardedScoringEngine(
+            cfg, kind=model_kind, params=model.params, scaler=model.scaler,
+            n_devices=n_devices,
+        )
+    else:
+        engine = ScoringEngine(
+            cfg, kind=model_kind, params=model.params, scaler=model.scaler
+        )
     if warm.n:
         warm_src = ReplaySource(warm, epoch0, batch_rows=65536)
         engine.run(warm_src)  # state warm-up, scores discarded

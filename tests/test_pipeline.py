@@ -182,3 +182,29 @@ def test_run_demo_end_to_end(tmp_path):
     # Parquet sink landed the analyzed table.
     files = list((tmp_path / "out").glob("*.parquet"))
     assert files
+
+
+def test_run_demo_sharded_matches_single_chip(tmp_path):
+    """The full E2E demo serves on the 8-device mesh (`demo --devices 8`)
+    and reproduces the single-chip stream AUC."""
+    from real_time_fraud_detection_system_tpu.runtime.pipeline import run_demo
+
+    def mk_cfg():
+        return Config(
+            data=DataConfig(n_customers=80, n_terminals=160, n_days=40,
+                            seed=3),
+            features=FeatureConfig(customer_capacity=256,
+                                   terminal_capacity=512,
+                                   cms_width=1 << 10),
+            train=TrainConfig(delta_train_days=15, delta_delay_days=5,
+                              delta_test_days=5, epochs=2, batch_size=512),
+        )
+
+    s1 = run_demo(mk_cfg(), model_kind="logreg", batch_rows=1024)
+    s8 = run_demo(mk_cfg(), model_kind="logreg", batch_rows=1024,
+                  n_devices=8, out_dir=str(tmp_path / "out8"))
+    assert s8["streamed_rows"] == s1["streamed_rows"]
+    assert s8["stream_auc"] == pytest.approx(s1["stream_auc"], abs=1e-6)
+    # Sharded demo landed both the analyzed parquet and the raw table.
+    assert list((tmp_path / "out8").glob("*.parquet"))
+    assert list((tmp_path / "out8" / "transactions").glob("tx_date=*"))

@@ -41,6 +41,12 @@ class FakeS3Client:
     def delete_object(self, Bucket, Key):
         self.objects.pop((Bucket, Key), None)
 
+    def copy_object(self, Bucket, Key, CopySource):
+        src = (CopySource["Bucket"], CopySource["Key"])
+        if src not in self.objects:
+            raise _ClientError("NoSuchKey")
+        self.objects[(Bucket, Key)] = self.objects[src]
+
     def list_objects_v2(self, Bucket, Prefix="", ContinuationToken=None):
         keys = sorted(k for b, k in self.objects
                       if b == Bucket and k.startswith(Prefix))
@@ -233,3 +239,23 @@ def test_make_checkpointer_dispatch(tmp_path, monkeypatch):
     )
     ck = make_checkpointer("s3://commerce/checkpoints")
     assert isinstance(ck, StoreCheckpointer)
+
+
+def test_store_move(store):
+    store.put("a/x.npz", b"payload")
+    store.move("a/x.npz", "a/stale-t-x.npz")
+    assert not store.exists("a/x.npz")
+    assert store.get("a/stale-t-x.npz") == b"payload"
+
+
+def test_store_checkpointer_flat_lineage(store):
+    """Keys nested deeper under the prefix (a sibling job's lineage) are
+    invisible to list/GC/latest — flat-directory semantics."""
+    from real_time_fraud_detection_system_tpu.io.checkpoint import (
+        StoreCheckpointer,
+    )
+
+    ck = StoreCheckpointer(store, prefix="app", keep=2)
+    store.put("app/jobB/ckpt-0000000999.npz", b"other lineage")
+    assert ck.list_checkpoints() == []
+    assert ck.latest() is None
