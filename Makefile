@@ -13,12 +13,21 @@
 PY ?= python
 CLI = $(PY) -m real_time_fraud_detection_system_tpu.cli
 OUT ?= out
+# Dataset scale: moderate default so `make run-all` finishes in minutes on
+# a laptop CPU; reference scale (data_generator.ipynb · cell 34) is
+# `make datagen CUSTOMERS=5000 TERMINALS=10000 DAYS=245`.
+CUSTOMERS ?= 1000
+TERMINALS ?= 2000
+DAYS ?= 120
 
 demo:
+	@mkdir -p $(OUT)
 	$(CLI) demo --out $(OUT)/analyzed
 
 datagen:
-	$(CLI) datagen --out $(OUT)/txs.npz
+	@mkdir -p $(OUT)
+	$(CLI) datagen --out $(OUT)/txs.npz --customers $(CUSTOMERS) \
+	    --terminals $(TERMINALS) --days $(DAYS)
 
 train:
 	$(CLI) train --data $(OUT)/txs.npz --model forest --out-model $(OUT)/model.npz

@@ -598,3 +598,52 @@ def test_feedback_source_transient_error_raises(fake_kafka):
     src = KafkaFeedbackSource("b:9092", consumer_factory=factory)
     with pytest.raises(ConnectionError, match="transient"):
         src.poll_messages(10)
+
+
+def test_cli_score_from_kafka(fake_kafka, tmp_path, monkeypatch):
+    """`rtfds score --source kafka` end-to-end: consume the fake topic,
+    score, land analyzed parquet + raw table, exit on idle."""
+    from real_time_fraud_detection_system_tpu import cli
+    from real_time_fraud_detection_system_tpu.config import (
+        Config,
+        DataConfig,
+        TrainConfig,
+    )
+    from real_time_fraud_detection_system_tpu.data import generate_dataset
+    from real_time_fraud_detection_system_tpu.io.artifacts import save_model
+    from real_time_fraud_detection_system_tpu.models import train_model
+
+    dcfg = DataConfig(n_customers=50, n_terminals=100, n_days=30, seed=9)
+    _, _, txs = generate_dataset(dcfg)
+    cfg = Config(data=dcfg,
+                 train=TrainConfig(delta_train_days=12, delta_delay_days=4,
+                                   delta_test_days=4, epochs=2))
+    model, _ = train_model(txs, cfg, kind="logreg")
+    model_file = str(tmp_path / "m.npz")
+    save_model(model_file, model)
+
+    logs, truth = _make_logs(fake_kafka, n_rows=200)
+
+    real_consumer = fake_kafka.Consumer
+
+    def injecting_consumer(conf):
+        c = real_consumer(conf)
+        c.inject(TOPIC, logs)
+        return c
+
+    monkeypatch.setattr(fake_kafka, "Consumer", injecting_consumer)
+    rc = cli.main([
+        "score", "--source", "kafka", "--bootstrap", "fake:9092",
+        "--model-file", model_file, "--idle-timeout", "0.2",
+        "--batch-rows", "64",
+        "--out", str(tmp_path / "analyzed"),
+        "--raw-table", str(tmp_path / "rawtx"),
+    ])
+    assert rc == 0
+    import pyarrow.parquet as pq
+
+    files = list((tmp_path / "analyzed").glob("*.parquet"))
+    assert files
+    n_out = sum(pq.read_table(str(f)).num_rows for f in files)
+    assert n_out == len(truth["tx_id"])
+    assert list((tmp_path / "rawtx").glob("tx_date=*"))
