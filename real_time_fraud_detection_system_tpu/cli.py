@@ -241,6 +241,9 @@ def cmd_score(args) -> int:
                     source.seek(engine.state.offsets)
                     log.info("resumed from batch %d",
                              engine.state.batches_done)
+                truncate = getattr(sink, "truncate_after", None)
+                if truncate is not None:
+                    truncate(engine.state.batches_done)
             stats = engine.run(source, sink=sink, checkpointer=ckpt,
                                max_batches=args.max_batches)
     finally:

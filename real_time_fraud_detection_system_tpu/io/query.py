@@ -27,7 +27,11 @@ _US_PER_DAY = 24 * _US_PER_HOUR
 
 
 def load_analyzed(directory: str) -> Dict[str, np.ndarray]:
-    """Read every parquet part file of an analyzed output directory."""
+    """Read every parquet part file of an analyzed output directory.
+
+    Latest-wins by ``tx_id`` across parts (file order): a transaction
+    re-scored by a replay/restart counts once — MERGE-on-read, the same
+    contract as the raw-transactions table."""
     import pyarrow as pa
     import pyarrow.parquet as pq
 
@@ -39,7 +43,17 @@ def load_analyzed(directory: str) -> Dict[str, np.ndarray]:
     if not files:
         return {}
     table = pa.concat_tables([pq.read_table(f) for f in files])
-    return {c: table[c].to_numpy() for c in table.column_names}
+    cols = {c: table[c].to_numpy() for c in table.column_names}
+    ids = cols.get("tx_id")
+    if ids is not None and len(ids):
+        from real_time_fraud_detection_system_tpu.ops.dedup import (
+            latest_wins_mask_np,
+        )
+
+        keep = latest_wins_mask_np(ids, np.arange(len(ids)))
+        if not keep.all():
+            cols = {c: v[keep] for c, v in cols.items()}
+    return cols
 
 
 def summary_stats(cols: Dict[str, np.ndarray],

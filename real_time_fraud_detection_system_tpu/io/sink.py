@@ -64,6 +64,12 @@ class FanoutSink:
             if f is not None:
                 f()
 
+    def truncate_after(self, batch_index: int) -> None:
+        for s in self.sinks:
+            f = getattr(s, "truncate_after", None)
+            if f is not None:
+                f(batch_index)
+
 
 class MemorySink:
     def __init__(self):
@@ -100,7 +106,17 @@ class ConsoleSink:
 
 
 class ParquetSink:
-    """One part file per batch: ``<dir>/part-<epoch_ms>-<seq>.parquet``."""
+    """One part file per batch: ``<dir>/part-<batch_index>.parquet``.
+
+    Exactly-once across crash-replay: part files are named by the
+    engine's monotone ``batch_index`` (which survives checkpoint
+    restore), so a replayed batch atomically OVERWRITES its own part
+    instead of appending a duplicate — the role Spark's sink commit
+    protocol plays for the reference's Iceberg append
+    (``fraud_detection.py:204-211``). Writes are tmp+rename, never
+    torn for concurrent readers. Results without an index (direct
+    ``append`` of hand-built batches) fall back to sequence naming.
+    """
 
     def __init__(self, directory: str):
         self.directory = directory
@@ -113,11 +129,30 @@ class ParquetSink:
 
         cols = _result_to_columns(res)
         table = pa.table({k: pa.array(v) for k, v in cols.items()})
-        path = os.path.join(
-            self.directory, f"part-{int(time.time() * 1e3)}-{self._seq:06d}.parquet"
-        )
-        pq.write_table(table, path)
-        self._seq += 1
+        idx = getattr(res, "batch_index", -1)
+        if idx >= 0:
+            name = f"part-{idx:08d}.parquet"
+        else:
+            name = f"part-{int(time.time() * 1e3)}-{self._seq:06d}.parquet"
+            self._seq += 1
+        path = os.path.join(self.directory, name)
+        tmp = path + ".tmp"
+        pq.write_table(table, tmp)
+        os.replace(tmp, path)
+
+    def truncate_after(self, batch_index: int) -> None:
+        """Drop indexed parts beyond ``batch_index`` — the sink-side
+        restore fence. Replay after a checkpoint restore may re-batch the
+        backlog differently (e.g. a Kafka drain coalescing into fewer,
+        larger batches), so parts the replay won't overwrite must go, or
+        their rows would double on disk. A fresh run (restore to 0)
+        clears the whole indexed lineage."""
+        for f in os.listdir(self.directory):
+            if not (f.startswith("part-") and f.endswith(".parquet")):
+                continue
+            stem = f[len("part-"):-len(".parquet")]
+            if stem.isdigit() and int(stem) > batch_index:
+                os.remove(os.path.join(self.directory, f))
 
     def read_all(self) -> dict:
         import pyarrow.parquet as pq

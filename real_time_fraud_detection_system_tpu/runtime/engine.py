@@ -114,6 +114,11 @@ class BatchResult:
     features: np.ndarray  # [n, 15]
     probs: np.ndarray  # [n]
     latency_s: float
+    # Monotone engine batch counter (survives checkpoint restore): a
+    # replayed batch carries the SAME index, so idempotent sinks can
+    # overwrite instead of duplicating (exactly-once sink output — the
+    # role of Spark's sink commit protocol).
+    batch_index: int = -1
 
 
 class ScoringEngine:
@@ -282,6 +287,7 @@ class ScoringEngine:
                 time.perf_counter() - handle["t0"]
                 - handle.get("waited", 0.0)
             ),
+            batch_index=self.state.batches_done,
         )
 
     def process_batch(self, cols: dict) -> BatchResult:

@@ -448,6 +448,13 @@ def run_with_recovery(
             # beginning, or batches polled before the crash would be lost
             # to the new (empty) feature state.
             source.seek(initial_offsets)
+        # Sink-side restore fence: drop indexed output parts beyond the
+        # restored batch counter (0 on a fresh start) — replay may
+        # re-batch the backlog differently, leaving stale parts it never
+        # overwrites (the sink analogue of the checkpoint fence above).
+        truncate = getattr(sink, "truncate_after", None) if sink else None
+        if truncate is not None:
+            truncate(engine.state.batches_done)
         try:
             if heartbeat is not None:
                 stats = _run_watched(

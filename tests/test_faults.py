@@ -463,3 +463,72 @@ def test_recovery_with_store_checkpointer(small_dataset, tmp_path):
     # The stale lineage is quarantined, not current.
     latest = ck.latest()
     assert latest is not None and "ckpt-0000000900" not in latest
+
+
+def test_recovery_parquet_sink_exactly_once(small_dataset, tmp_path):
+    """Crash-replay must not duplicate rows in the analyzed Parquet
+    output: replayed batches overwrite their own part files (batch-index
+    naming), so the landed table equals a clean run's without any
+    read-side dedup."""
+    import pyarrow.parquet as pq
+
+    from real_time_fraud_detection_system_tpu.io.sink import ParquetSink
+
+    cfg, txs, make_engine = _mk(small_dataset, tmp_path)
+    part = txs.slice(slice(0, 1536))
+
+    ckpt = Checkpointer(str(tmp_path / "ck_pq"))
+    sink = ParquetSink(str(tmp_path / "analyzed"))
+    src = FlakySource(ReplaySource(part, EPOCH0, batch_rows=256),
+                      fail_at=(3,))
+    stats = run_with_recovery(make_engine, src, ckpt, sink=sink,
+                              max_restarts=3)
+    assert stats["restarts"] == 1
+
+    files = sorted((tmp_path / "analyzed").glob("part-*.parquet"))
+    total = sum(pq.read_table(str(f)).num_rows for f in files)
+    assert total == 1536  # zero duplicate rows on disk
+    assert len(files) == 6  # one part per batch, replays overwrote
+    back = sink.read_all()
+    assert sorted(back["tx_id"].tolist()) == sorted(part.tx_id.tolist())
+
+
+def test_parquet_sink_truncate_after(tmp_path):
+    from real_time_fraud_detection_system_tpu.io.sink import ParquetSink
+
+    sink = ParquetSink(str(tmp_path / "a"))
+    for i in (1, 2, 3, 4, 5):
+        (tmp_path / "a" / f"part-{i:08d}.parquet").write_bytes(b"x")
+    (tmp_path / "a" / "part-1700000000000-000001.parquet").write_bytes(b"x")
+    sink.truncate_after(2)
+    names = sorted(p.name for p in (tmp_path / "a").iterdir())
+    assert names == ["part-00000001.parquet", "part-00000002.parquet",
+                     "part-1700000000000-000001.parquet"]  # legacy kept
+
+
+def test_recovery_rebatched_replay_no_stale_parts(small_dataset, tmp_path):
+    """Replay that re-batches the backlog differently (bigger polls after
+    restart) must not leave stale higher-index parts double-counting rows
+    on disk — the sink-side restore fence."""
+    import pyarrow.parquet as pq
+
+    from real_time_fraud_detection_system_tpu.io.sink import ParquetSink
+
+    cfg, txs, make_engine = _mk(small_dataset, tmp_path, every=100)
+    part = txs.slice(slice(0, 1024))
+
+    # First (unsupervised) pass writes 8 parts of 128 rows, no checkpoint
+    # ever lands. A later supervised fresh run over the SAME sink dir
+    # re-batches at 256 rows (4 parts) — the fence must clear parts 5..8.
+    sink = ParquetSink(str(tmp_path / "analyzed"))
+    make_engine().run(ReplaySource(part, EPOCH0, batch_rows=128), sink=sink)
+    assert len(list((tmp_path / "analyzed").glob("part-*.parquet"))) == 8
+
+    ckpt = Checkpointer(str(tmp_path / "ck_fence"))
+    run_with_recovery(make_engine,
+                      ReplaySource(part, EPOCH0, batch_rows=256),
+                      ckpt, sink=sink, max_restarts=1, resume=False)
+    files = list((tmp_path / "analyzed").glob("part-*.parquet"))
+    assert len(files) == 4
+    total = sum(pq.read_table(str(f)).num_rows for f in files)
+    assert total == 1024  # zero stale/duplicate rows
