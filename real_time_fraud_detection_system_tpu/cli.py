@@ -149,6 +149,30 @@ def cmd_score(args) -> int:
                   "always scores on-device)")
         return 2
 
+    feature_cache = None
+    make_feedback = None
+    if args.feedback_bootstrap:
+        from real_time_fraud_detection_system_tpu.runtime import (
+            FeatureCache,
+            FeedbackLoop,
+            KafkaFeedbackSource,
+        )
+
+        feature_cache = FeatureCache()
+
+        def make_feedback(engine):
+            # Fresh consumer session per incarnation (group fencing).
+            # Non-blocking polls: the loop runs in the scoring hot path
+            # between batches, and the feedback topic is usually quiet
+            # (labels arrive days late) — a blocking poll would cap
+            # serving throughput.
+            return FeedbackLoop(
+                engine,
+                KafkaFeedbackSource(args.feedback_bootstrap,
+                                    topic=args.feedback_topic,
+                                    poll_timeout_s=0.0),
+            )
+
     def make_engine():
         if args.devices > 1:
             from real_time_fraud_detection_system_tpu.runtime import (
@@ -162,6 +186,7 @@ def cmd_score(args) -> int:
                 scaler=model.scaler,
                 n_devices=args.devices,
                 online_lr=args.online_lr,
+                feature_cache=feature_cache,
             )
         return ScoringEngine(
             cfg,
@@ -171,6 +196,7 @@ def cmd_score(args) -> int:
             scorer=args.scorer,
             cpu_model=cpu_model,
             online_lr=args.online_lr,
+            feature_cache=feature_cache,
         )
 
     source_factory = None
@@ -218,6 +244,7 @@ def cmd_score(args) -> int:
                   "(--max-restarts with --checkpoint-dir); without it the "
                   "watchdog has no restart path to escalate into")
         return 2
+    fb = None
     try:
         if ckpt is not None and args.max_restarts > 0:
             # Supervised mode: restart-on-failure with checkpoint replay
@@ -231,7 +258,7 @@ def cmd_score(args) -> int:
                 make_engine, source, ckpt, sink=sink,
                 max_restarts=args.max_restarts, max_batches=args.max_batches,
                 resume=args.resume, stall_timeout_s=args.stall_timeout,
-                make_source=source_factory,
+                make_source=source_factory, make_feedback=make_feedback,
             )
         else:
             engine = make_engine()
@@ -244,12 +271,17 @@ def cmd_score(args) -> int:
                 truncate = getattr(sink, "truncate_after", None)
                 if truncate is not None:
                     truncate(engine.state.batches_done)
-            stats = engine.run(source, sink=sink, checkpointer=ckpt,
-                               max_batches=args.max_batches)
+            fb = make_feedback(engine) if make_feedback else None
+            stats = engine.run(
+                source, sink=sink, checkpointer=ckpt,
+                max_batches=args.max_batches, feedback=fb,
+            )
     finally:
         close = getattr(source, "close", None)
         if close is not None:
             close()
+        if fb is not None:
+            fb.close()
     if raw_table is not None:
         raw_table.flush()
         stats["raw_tx_rows"] = len(raw_table)
@@ -400,6 +432,11 @@ def main(argv=None) -> int:
     p.add_argument("--idle-timeout", type=float, default=0.0,
                    help="stop when the Kafka topic is idle this long "
                         "(0 = serve forever)")
+    p.add_argument("--feedback-bootstrap", default="",
+                   help="consume delayed fraud labels from this Kafka "
+                        "cluster's feedback topic between micro-batches "
+                        "(online learning, BASELINE config 4)")
+    p.add_argument("--feedback-topic", default="payment.feedback")
     p.add_argument("--out", default="")
     p.add_argument("--raw-table", default="",
                    help="also land raw transactions in a day-partitioned "

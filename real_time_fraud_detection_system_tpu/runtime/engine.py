@@ -410,8 +410,17 @@ class ScoringEngine:
         checkpointer=None,
         trigger_seconds: Optional[float] = None,
         heartbeat=None,
+        feedback=None,
     ) -> dict:
         """Stream until the source is exhausted (or max_batches).
+
+        ``feedback`` (a :class:`~.feedback.FeedbackLoop`) is polled once
+        per finished batch, BETWEEN device steps — the single-threaded
+        contract the loop requires (its updates touch
+        ``state.params``/``state.feature_state``). This closes BASELINE
+        config 4 in serving: delayed fraud labels land in the terminal
+        risk windows and (for differentiable models) drive online SGD
+        while the stream keeps scoring.
 
         The loop is double-buffered: batch N+1 is polled, host-prepped,
         ``device_put`` and dispatched while batch N's device step still
@@ -437,6 +446,11 @@ class ScoringEngine:
         rows0 = self.state.rows_done  # report THIS run's throughput, not
         batches0 = self.state.batches_done  # lifetime totals (warmup runs)
         pending: Optional[dict] = None
+        if feedback is not None and checkpointer is not None:
+            # Feedback offsets must TRAIL the state checkpoint (the same
+            # invariant as the source commit below): defer the loop's
+            # broker commits to the checkpoint cadence.
+            feedback.auto_commit = False
 
         def _finish(handle: dict) -> None:
             res = self._finish_batch(handle)
@@ -444,15 +458,21 @@ class ScoringEngine:
             latencies.append(res.latency_s)
             if sink is not None:
                 sink.append(res)
+            if feedback is not None:
+                # Between-batch label application (before the checkpoint,
+                # so saved state includes the landed labels).
+                feedback.poll_and_apply()
             if checkpointer is not None and self.state.batches_done % every == 0:
                 checkpointer.save(self.state)
                 # Broker-side offsets (sources that have them, e.g. Kafka)
                 # are committed only AFTER the framework checkpoint lands:
                 # they trail it, never lead, so a crash replays — never
-                # skips — rows.
+                # skips — rows. Same for consumed feedback labels.
                 commit = getattr(source, "commit", None)
                 if commit is not None:
                     commit()
+                if feedback is not None:
+                    feedback.commit()
             if trigger > 0:
                 time.sleep(max(0.0, trigger - res.latency_s))
 

@@ -278,6 +278,11 @@ class _FenceGuard:
         fence.check()
         return attr
 
+    def __setattr__(self, name, value):
+        fence = object.__getattribute__(self, "_fence")
+        fence.check()
+        setattr(object.__getattribute__(self, "_inner"), name, value)
+
 
 class _GuardedSource(_FenceGuard):
     """Source fence with a post-poll check.
@@ -305,7 +310,7 @@ class _GuardedSource(_FenceGuard):
 
 
 def _run_watched(engine, source, sink, checkpointer, max_batches,
-                 heartbeat: Heartbeat):
+                 heartbeat: Heartbeat, feedback=None):
     """Run one engine incarnation under a stall watchdog.
 
     The engine loop runs in a worker thread beating the heartbeat each
@@ -326,12 +331,22 @@ def _run_watched(engine, source, sink, checkpointer, max_batches,
     g_ckpt = _FenceGuard(checkpointer, fence) if checkpointer is not None \
         else None
     g_heartbeat = _FenceGuard(heartbeat, fence)
+    g_feedback = _FenceGuard(feedback, fence) if feedback is not None \
+        else None
+    if getattr(engine, "feature_cache", None) is not None:
+        # The cache outlives incarnations (it's how the feedback join
+        # finds rows scored before a restart) — fence THIS incarnation's
+        # handle so a zombie can't overwrite rows the live incarnation
+        # re-scored (or reset their labeled marks, double-applying
+        # additive label scatters).
+        engine.feature_cache = _FenceGuard(engine.feature_cache, fence)
 
     def _target():
         try:
             box["stats"] = engine.run(
                 g_source, sink=g_sink, checkpointer=g_ckpt,
                 max_batches=max_batches, heartbeat=g_heartbeat,
+                feedback=g_feedback,
             )
         except BaseException as e:  # report into the supervisor thread
             box["err"] = e
@@ -366,6 +381,7 @@ def run_with_recovery(
     stall_timeout_s: float = 0.0,
     resume: bool = True,
     make_source: Optional[Callable[[], object]] = None,
+    make_feedback: Optional[Callable[[object], object]] = None,
     recover_on: Tuple[Type[BaseException], ...] = (
         TransientError, OSError, ConnectionError,
     ),
@@ -385,6 +401,10 @@ def run_with_recovery(
     ever raised) is detected within the stall budget and recovered like a
     crash. Without either, the loop is synchronous and reacts to
     exceptions only.
+
+    ``make_feedback``: factory called with each incarnation's engine to
+    build its labeled-feedback loop (a fresh consumer session per
+    incarnation in production — see :class:`~.feedback.KafkaFeedbackSource`).
 
     ``make_source``: factory for a FRESH source per incarnation (the
     restart re-seeks it to the checkpointed offsets). Strongly preferred
@@ -455,22 +475,28 @@ def run_with_recovery(
         truncate = getattr(sink, "truncate_after", None) if sink else None
         if truncate is not None:
             truncate(engine.state.batches_done)
+        # Feedback loop binds THIS incarnation's engine (and, in
+        # production, its own consumer session).
+        feedback = make_feedback(engine) if make_feedback else None
         try:
             if heartbeat is not None:
                 stats = _run_watched(
                     engine, source, sink, checkpointer, max_batches,
-                    heartbeat,
+                    heartbeat, feedback=feedback,
                 )
             else:
                 stats = engine.run(
                     source, sink=sink, checkpointer=checkpointer,
-                    max_batches=max_batches,
+                    max_batches=max_batches, feedback=feedback,
                 )
             # Final checkpoint so a clean exit never replays.
             checkpointer.save(engine.state)
             commit = getattr(source, "commit", None)
             if commit is not None:
                 commit()
+            if feedback is not None:
+                feedback.commit()
+                feedback.close()
             stats["restarts"] = restarts
             # Whole-session totals: engine.run reports per-run deltas, but
             # a recovered session's caller wants rows across restarts —
@@ -489,6 +515,14 @@ def run_with_recovery(
         except recover_on as e:
             restarts += 1
             last_was_stall = isinstance(e, StallError)
+            if feedback is not None and not last_was_stall:
+                # Close the dead incarnation's feedback session so the
+                # group rebalances promptly (a stalled zombie may still
+                # be inside it — leak that one rather than hang here).
+                try:
+                    feedback.close()
+                except Exception:
+                    pass
             log.warning("engine crashed (%s); restart %d/%d",
                         e, restarts, max_restarts)
             if restarts > max_restarts:

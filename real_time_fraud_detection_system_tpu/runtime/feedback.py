@@ -250,7 +250,8 @@ class FeedbackLoop:
     """
 
     def __init__(self, engine, broker, cache: FeatureCache = None,
-                 topic: str = FEEDBACK_TOPIC, max_events: int = 65536):
+                 topic: str = FEEDBACK_TOPIC, max_events: int = 65536,
+                 auto_commit: bool = True):
         self.engine = engine
         self.broker = broker
         self.cache = cache if cache is not None else engine.feature_cache
@@ -261,6 +262,12 @@ class FeedbackLoop:
             )
         self.topic = topic
         self.max_events = max_events
+        # auto_commit=False defers broker commits to an external caller —
+        # the engine serving loop sets this when a checkpointer is in
+        # play, so committed feedback offsets TRAIL the state checkpoint
+        # (labels applied since the last checkpoint must be redelivered
+        # after a crash; mark_labeled idempotence absorbs the replay).
+        self.auto_commit = auto_commit
         self._offsets = (
             [0] * broker.n_partitions
             if hasattr(broker, "n_partitions") else []
@@ -290,10 +297,21 @@ class FeedbackLoop:
         applied = self._apply(msgs)
         # At-least-once transports (KafkaFeedbackSource) commit only after
         # apply succeeded: a crash in between replays, never drops.
+        if self.auto_commit:
+            self.commit()
+        return applied
+
+    def commit(self) -> None:
+        """Commit consumed feedback offsets (transports that have them)."""
         commit = getattr(self.broker, "commit", None)
         if commit is not None:
             commit()
-        return applied
+
+    def close(self) -> None:
+        """Close the underlying transport session (if it has one)."""
+        close = getattr(self.broker, "close", None)
+        if close is not None:
+            close()
 
     def _apply(self, msgs: List[bytes]) -> int:
         tx_ids, labels, ts_ms = decode_feedback_envelopes(msgs)

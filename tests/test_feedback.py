@@ -419,3 +419,79 @@ def test_apply_feedback_requires_gradient_path(small_dataset):
     with pytest.raises(ValueError, match="no gradient path"):
         engine.apply_feedback(np.zeros((4, 15), np.float32),
                               np.ones(4, np.int32))
+
+
+def test_engine_run_polls_feedback_between_batches(small_dataset):
+    """engine.run(feedback=...) closes the online-learning loop in the
+    serving loop itself: labels produced mid-stream land in the terminal
+    risk state and move the model, without any external driver."""
+    import jax.numpy as jnp
+
+    from real_time_fraud_detection_system_tpu.config import (
+        Config,
+        FeatureConfig,
+        RuntimeConfig,
+    )
+    from real_time_fraud_detection_system_tpu.models.logreg import init_logreg
+    from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+    from real_time_fraud_detection_system_tpu.runtime import (
+        FEEDBACK_TOPIC,
+        FeatureCache,
+        FeedbackLoop,
+        InProcBroker,
+        ScoringEngine,
+        encode_feedback_envelopes,
+    )
+
+    _, _, _, txs = small_dataset
+    part = txs.slice(slice(0, 1024))
+    cfg = Config(
+        features=FeatureConfig(customer_capacity=256, terminal_capacity=512,
+                               cms_width=1 << 10),
+        runtime=RuntimeConfig(batch_buckets=(256,), max_batch_rows=256,
+                              trigger_seconds=0.0),
+    )
+    eng = ScoringEngine(cfg, kind="logreg", params=init_logreg(15),
+                        scaler=Scaler(mean=jnp.zeros(15),
+                                      scale=jnp.ones(15)),
+                        online_lr=1e-2,
+                        feature_cache=FeatureCache(capacity=1 << 12))
+    broker = InProcBroker(2)
+    loop = FeedbackLoop(eng, broker)
+
+    class _LabelProducingSource:
+        """Replay source that publishes labels for batch k's rows while
+        batch k+1 is being polled — the delayed-label stream."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            self._prev_ids = None
+
+        def poll_batch(self):
+            cols = self.inner.poll_batch()
+            if self._prev_ids is not None:
+                broker.produce_many(
+                    FEEDBACK_TOPIC, [b""] * len(self._prev_ids),
+                    encode_feedback_envelopes(
+                        self._prev_ids,
+                        np.ones(len(self._prev_ids), np.int64),
+                    ),
+                )
+            self._prev_ids = cols["tx_id"] if cols is not None else None
+            return cols
+
+        @property
+        def offsets(self):
+            return self.inner.offsets
+
+        def seek(self, o):
+            self.inner.seek(o)
+
+    from real_time_fraud_detection_system_tpu.runtime import ReplaySource
+
+    w0 = np.asarray(eng.state.params.w).copy()
+    eng.run(_LabelProducingSource(ReplaySource(part, 1_743_465_600,
+                                               batch_rows=256)),
+            feedback=loop)
+    assert loop.stats["applied"] > 0  # labels landed during the stream
+    assert not np.allclose(w0, np.asarray(eng.state.params.w))

@@ -647,3 +647,131 @@ def test_cli_score_from_kafka(fake_kafka, tmp_path, monkeypatch):
     n_out = sum(pq.read_table(str(f)).num_rows for f in files)
     assert n_out == len(truth["tx_id"])
     assert list((tmp_path / "rawtx").glob("tx_date=*"))
+
+
+def test_cli_score_kafka_with_feedback(fake_kafka, tmp_path, monkeypatch):
+    """The full production serving shape from the CLI: Kafka transaction
+    ingress + Kafka label feedback, online SGD between batches."""
+    import numpy as np
+
+    from real_time_fraud_detection_system_tpu import cli
+    from real_time_fraud_detection_system_tpu.config import (
+        Config,
+        DataConfig,
+        TrainConfig,
+    )
+    from real_time_fraud_detection_system_tpu.data import generate_dataset
+    from real_time_fraud_detection_system_tpu.io.artifacts import save_model
+    from real_time_fraud_detection_system_tpu.models import train_model
+    from real_time_fraud_detection_system_tpu.runtime import (
+        FEEDBACK_TOPIC,
+        encode_feedback_envelopes,
+    )
+
+    dcfg = DataConfig(n_customers=50, n_terminals=100, n_days=30, seed=9)
+    _, _, txs = generate_dataset(dcfg)
+    cfg = Config(data=dcfg,
+                 train=TrainConfig(delta_train_days=12, delta_delay_days=4,
+                                   delta_test_days=4, epochs=2))
+    model, _ = train_model(txs, cfg, kind="logreg")
+    model_file = str(tmp_path / "m.npz")
+    save_model(model_file, model)
+
+    tx_logs, truth = _make_logs(fake_kafka, n_rows=192)
+    # Labels for the first rows, already waiting on the feedback topic.
+    fb_events = encode_feedback_envelopes(
+        truth["tx_id"][:64], np.ones(64, np.int64))
+    fb_logs = {0: [fake_kafka._Msg(FEEDBACK_TOPIC, 0, i, b"", m, 1)
+                   for i, m in enumerate(fb_events)]}
+
+    real_consumer = fake_kafka.Consumer
+
+    def routing_consumer(conf):
+        c = real_consumer(conf)
+        if conf["group.id"] == "rtfds-feedback":
+            c.inject(FEEDBACK_TOPIC, fb_logs)
+        else:
+            c.inject(TOPIC, tx_logs)
+        return c
+
+    monkeypatch.setattr(fake_kafka, "Consumer", routing_consumer)
+    rc = cli.main([
+        "score", "--source", "kafka", "--bootstrap", "fake:9092",
+        "--feedback-bootstrap", "fake:9092",
+        "--model-file", model_file, "--idle-timeout", "0.2",
+        "--batch-rows", "64", "--online-lr", "0.01",
+        "--out", str(tmp_path / "analyzed"),
+    ])
+    assert rc == 0
+    import pyarrow.parquet as pq
+
+    files = list((tmp_path / "analyzed").glob("*.parquet"))
+    n_out = sum(pq.read_table(str(f)).num_rows for f in files)
+    assert n_out == len(truth["tx_id"])
+
+
+def test_feedback_commit_trails_checkpoint(fake_kafka, tmp_path):
+    """With a checkpointer in play, consumed feedback offsets are
+    committed only at checkpoint boundaries — labels applied since the
+    last checkpoint are redelivered after a crash, never dropped."""
+    import jax.numpy as jnp
+
+    from real_time_fraud_detection_system_tpu.config import (
+        Config,
+        FeatureConfig,
+        RuntimeConfig,
+    )
+    from real_time_fraud_detection_system_tpu.io.checkpoint import (
+        Checkpointer,
+    )
+    from real_time_fraud_detection_system_tpu.models.logreg import init_logreg
+    from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+    from real_time_fraud_detection_system_tpu.runtime import (
+        FEEDBACK_TOPIC,
+        FeatureCache,
+        FeedbackLoop,
+        ScoringEngine,
+    )
+    from real_time_fraud_detection_system_tpu.runtime import (
+        encode_feedback_envelopes,
+    )
+    from real_time_fraud_detection_system_tpu.runtime.feedback import (
+        KafkaFeedbackSource,
+    )
+
+    logs, truth = _make_logs(fake_kafka, n_rows=64)
+    src, _ = _make_source(fake_kafka, logs, batch_rows=16)
+    events = encode_feedback_envelopes(truth["tx_id"][:16],
+                                       np.ones(16, np.int64))
+    fb_logs = {0: [fake_kafka._Msg(FEEDBACK_TOPIC, 0, i, b"", m, 1)
+                   for i, m in enumerate(events)]}
+    fb_holder = {}
+
+    def fb_factory(conf):
+        c = fake_kafka.Consumer(conf)
+        c.inject(FEEDBACK_TOPIC, fb_logs)
+        fb_holder["c"] = c
+        return c
+
+    fb_src = KafkaFeedbackSource("b:9092", consumer_factory=fb_factory,
+                                 poll_timeout_s=0.0)
+    cfg = Config(
+        features=FeatureConfig(customer_capacity=256, terminal_capacity=256),
+        runtime=RuntimeConfig(batch_buckets=(16,), max_batch_rows=16,
+                              trigger_seconds=0.0,
+                              checkpoint_every_batches=3),
+    )
+    eng = ScoringEngine(cfg, kind="logreg", params=init_logreg(15),
+                        scaler=Scaler(mean=jnp.zeros(15),
+                                      scale=jnp.ones(15)),
+                        online_lr=1e-2,
+                        feature_cache=FeatureCache(capacity=256))
+    loop = FeedbackLoop(eng, fb_src)
+    eng.run(src, checkpointer=Checkpointer(str(tmp_path / "ck")),
+            feedback=loop)
+    assert loop.auto_commit is False  # engine deferred the commits
+    assert loop.stats["applied"] > 0
+    # Feedback commits happened only at checkpoint boundaries (4 batches
+    # of 16 rows → checkpoints at batch 3; + the feedback group never
+    # committed ahead of them).
+    assert len(fb_holder["c"].committed) >= 1
