@@ -236,7 +236,7 @@ class ScoringEngine:
         self.state.feature_state = fstate
         self.state.params = params
         return {"cols": cols, "n": n, "probs": probs, "feats": feats,
-                "t0": t0}
+                "t0": t0, "prep_s": time.perf_counter() - t0}
 
     def _finish_batch(self, handle: dict) -> BatchResult:
         """Block on the handle's device futures; build the BatchResult."""
@@ -442,6 +442,8 @@ class ScoringEngine:
         )
         every = self.cfg.runtime.checkpoint_every_batches
         latencies: List[float] = []
+        preps: List[float] = []
+        blocks: List[float] = []
         t_start = time.perf_counter()
         rows0 = self.state.rows_done  # report THIS run's throughput, not
         batches0 = self.state.batches_done  # lifetime totals (warmup runs)
@@ -453,7 +455,13 @@ class ScoringEngine:
             feedback.auto_commit = False
 
         def _finish(handle: dict) -> None:
+            t_block = time.perf_counter()
             res = self._finish_batch(handle)
+            # Host-prep vs device-result-wait split: on TPU, prep time is
+            # the H2D/partition cost the double-buffer hides; block time
+            # approximates device step latency (minus overlap).
+            preps.append(handle.get("prep_s", 0.0))
+            blocks.append(time.perf_counter() - t_block)
             self.state.offsets = handle["source_offsets"]
             latencies.append(res.latency_s)
             if sink is not None:
@@ -534,4 +542,12 @@ class ScoringEngine:
             ),
             "latency_p50_ms": float(np.percentile(lat, 50) * 1e3),
             "latency_p99_ms": float(np.percentile(lat, 99) * 1e3),
+            "host_prep_p50_ms": float(
+                np.percentile(np.asarray(preps) if preps else np.zeros(1),
+                              50) * 1e3
+            ),
+            "result_wait_p50_ms": float(
+                np.percentile(np.asarray(blocks) if blocks else np.zeros(1),
+                              50) * 1e3
+            ),
         }

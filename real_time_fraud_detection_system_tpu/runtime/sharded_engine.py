@@ -206,7 +206,8 @@ class ShardedScoringEngine(ScoringEngine):
             self.state.feature_state = fstate
             self.state.params = params
             parts.append((rows, pos, probs, feats))
-        return {"cols": cols, "n": n, "parts": parts, "t0": t0}
+        return {"cols": cols, "n": n, "parts": parts, "t0": t0,
+                "prep_s": time.perf_counter() - t0}
 
     def _finish_batch(self, handle: dict) -> BatchResult:
         n = handle["n"]
