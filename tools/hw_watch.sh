@@ -1,0 +1,28 @@
+#!/bin/sh
+# Watch the TPU tunnel; when it comes alive, run the hardware parity gate
+# and save the evidence file. Exits after first success or when the overall
+# window (arg 1, seconds, default 4h) expires.
+#
+# Usage: sh tools/hw_watch.sh [window_s] [outfile]
+set -u
+WINDOW=${1:-14400}
+OUT=${2:-HWCHECK_r03.json}
+START=$(date +%s)
+cd "$(dirname "$0")/.."
+
+while :; do
+  NOW=$(date +%s)
+  [ $((NOW - START)) -ge "$WINDOW" ] && { echo "hw_watch: window expired"; exit 2; }
+  if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "hw_watch: tunnel alive at $(date -u +%H:%M:%S), running parity gate"
+    if timeout 1800 python tools/hw_parity_check.py > "$OUT.tmp" 2> "$OUT.log"; then
+      mv "$OUT.tmp" "$OUT"
+      echo "hw_watch: parity gate PASSED -> $OUT"
+      cat "$OUT"
+      exit 0
+    fi
+    echo "hw_watch: parity attempt failed (rc=$?), tail of log:"
+    tail -3 "$OUT.log"
+  fi
+  sleep 240
+done
