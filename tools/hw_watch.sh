@@ -19,6 +19,11 @@ while :; do
       mv "$OUT.tmp" "$OUT"
       echo "hw_watch: parity gate PASSED -> $OUT"
       cat "$OUT"
+      echo "hw_watch: racing forest-kernel variants (tools/tpu_step_profile.py)"
+      timeout 1800 env PROFILE_ROWS=262144 python tools/tpu_step_profile.py \
+        > PROFILE_r03.json 2>> "$OUT.log" \
+        && { echo "hw_watch: profile -> PROFILE_r03.json"; cat PROFILE_r03.json; } \
+        || echo "hw_watch: profile attempt failed (rc=$?)"
       exit 0
     fi
     echo "hw_watch: parity attempt failed (rc=$?), tail of log:"
