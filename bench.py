@@ -285,6 +285,11 @@ def _child_main(args) -> None:
     # ---- classify latency percentiles at the serving batch size ----
     _progress("latency percentiles")
     serve_rows = 4096
+    # Engine-loop batch: on TPU, per-call overhead (tunnel RTT when
+    # benched remotely; dispatch otherwise) swamps a 4k-row batch — serve
+    # at a size where the device does real work per round trip, like the
+    # throughput headline does.
+    engine_rows = 65536 if not (args.quick or on_cpu) else serve_rows
     lat_iters = 10 if args.quick or on_cpu else 100
     c = _make_batch_cols(rng, serve_rows)
     sbatch = jax.tree.map(jnp.asarray, make_batch(**c))
@@ -313,15 +318,16 @@ def _child_main(args) -> None:
         ecfg = Config(
             features=FeatureConfig(customer_capacity=8192,
                                    terminal_capacity=16384),
-            runtime=RuntimeConfig(batch_buckets=(serve_rows,),
-                                  max_batch_rows=serve_rows,
+            runtime=RuntimeConfig(batch_buckets=(engine_rows,),
+                                  max_batch_rows=engine_rows,
                                   trigger_seconds=0.0),
         )
         eng = ScoringEngine(ecfg, kind="forest", params=params,
                             scaler=scaler)
-        eng.run(_RandSource(1, serve_rows, seed=3), trigger_seconds=0.0)
-        st = eng.run(_RandSource(n_eng, serve_rows), trigger_seconds=0.0)
+        eng.run(_RandSource(1, engine_rows, seed=3), trigger_seconds=0.0)
+        st = eng.run(_RandSource(n_eng, engine_rows), trigger_seconds=0.0)
         engine_stats = {
+            "batch_rows": engine_rows,
             "rows_per_s": round(st["rows_per_s"], 1),
             "latency_p50_ms": round(st["latency_p50_ms"], 3),
             "latency_p99_ms": round(st["latency_p99_ms"], 3),
@@ -343,13 +349,14 @@ def _child_main(args) -> None:
             oeng = ScoringEngine(ecfg, kind="forest", params=params,
                                  scaler=scaler, scorer="cpu",
                                  cpu_model=_SklOracle(skl))
-            oeng.run(_RandSource(1, serve_rows, seed=3),
+            oeng.run(_RandSource(1, engine_rows, seed=3),
                      trigger_seconds=0.0)  # jit warmup outside the stats
-            ost = oeng.run(_RandSource(n_eng, serve_rows),
+            ost = oeng.run(_RandSource(n_eng, engine_rows),
                            trigger_seconds=0.0)
             engine_stats = {
                 "gemm_on_cpu": engine_stats,
                 "cpu_oracle": {
+                    "batch_rows": engine_rows,
                     "rows_per_s": round(ost["rows_per_s"], 1),
                     "latency_p50_ms": round(ost["latency_p50_ms"], 3),
                     "latency_p99_ms": round(ost["latency_p99_ms"], 3),
