@@ -44,6 +44,7 @@ from real_time_fraud_detection_system_tpu.models.scaler import (
 from real_time_fraud_detection_system_tpu.models.train import (
     TrainedModel,
     fit_classifier,
+    scale_split_to_txs,
     train_delay_test_split,
 )
 
@@ -63,8 +64,16 @@ def prequential_split(
     Fold 0 is the most recent window, matching ``shared_functions.py:265-292``
     where ``start_date_training - fold_index*delta_assessment`` walks
     backwards in time. Folds whose training window would start before day 0
-    are dropped (the reference would silently produce empty frames).
+    are dropped (the reference would silently produce empty frames), and
+    spans that don't fit the dataset are auto-scaled like
+    :func:`~.train.fit_split_to_days` does for ``train_model`` — the
+    default 153/30/30 on a short dataset would otherwise give every fold
+    an empty test window (NaN metric rows across the whole sweep).
     """
+    delta_train, delta_delay, delta_assessment = scale_split_to_txs(
+        txs, delta_train, delta_delay, delta_assessment,
+        start_day=start_day_training, logger_name="selection",
+    )
     folds = []
     for i in range(n_folds):
         sd = start_day_training - i * delta_assessment
@@ -211,16 +220,34 @@ def model_selection_wrapper(
     Validation folds end before the test period starts, so choosing
     hyper-parameters on them is unbiased; the matching test rows report what
     that choice would have achieved.
+
+    Short datasets: the spans are scaled ONCE here, anchored at the later
+    (test) sweep, and shared by both sweeps. Per-sweep scaling would let
+    each sweep fill the data to its last day, overlapping the validation
+    windows into the test period — selection would leak held-out days.
+    With shared spans, the windows stay disjoint whenever the anchors are
+    at least one (scaled) assessment span apart — the reference's own
+    ``start_valid = start_test - delta_test`` convention.
     """
+    dtr, dde, dte = scale_split_to_txs(
+        txs,
+        deltas.pop("delta_train", cfg.train.delta_train_days),
+        deltas.pop("delta_delay", cfg.train.delta_delay_days),
+        deltas.pop("delta_assessment", cfg.train.delta_test_days),
+        start_day=start_day_training_for_test,
+        logger_name="selection",
+    )
     rows = prequential_grid_search(
         txs, features, cfg, kind, param_grid,
         start_day_training_for_valid, n_folds=n_folds,
-        expe_type="validation", **deltas,
+        expe_type="validation", delta_train=dtr, delta_delay=dde,
+        delta_assessment=dte, **deltas,
     )
     rows += prequential_grid_search(
         txs, features, cfg, kind, param_grid,
         start_day_training_for_test, n_folds=n_folds,
-        expe_type="test", **deltas,
+        expe_type="test", delta_train=dtr, delta_delay=dde,
+        delta_assessment=dte, **deltas,
     )
     return rows
 

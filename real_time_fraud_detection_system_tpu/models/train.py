@@ -17,6 +17,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from real_time_fraud_detection_system_tpu.utils.logging import get_logger
+
 from real_time_fraud_detection_system_tpu.config import Config
 from real_time_fraud_detection_system_tpu.data.generator import Transactions
 from real_time_fraud_detection_system_tpu.features.offline import (
@@ -47,6 +49,57 @@ from real_time_fraud_detection_system_tpu.models.scaler import (
     fit_scaler,
     transform,
 )
+
+
+def fit_split_to_days(
+    n_days: int, delta_train: int, delta_delay: int, delta_test: int
+) -> Tuple[int, int, int]:
+    """Shrink a (train, delay, test) day split to fit an n_days dataset.
+
+    The reference pins 153/30/30 for its 245-day dataset
+    (``model_training.ipynb · cell 8``); smaller datasets (docs examples,
+    tests, `make run-all DAYS=...`) would get an EMPTY test window and NaN
+    metrics with those absolutes. When the spans don't fit, scale them
+    proportionally (preserving the 153:30:30 shape), keeping train/test
+    ≥ 1 day; leftover days go to train. A ≤1-day dataset cannot hold
+    disjoint train and test windows at all — it gets (n_days, 0, 0), and
+    the caller's metrics are honestly NaN."""
+    need = delta_train + delta_delay + delta_test
+    if n_days >= need or need <= 0:
+        return delta_train, delta_delay, delta_test
+    if n_days <= 1:
+        return max(n_days, 0), 0, 0
+    f = n_days / need
+    test = max(1, int(delta_test * f))
+    delay = int(delta_delay * f)
+    train = max(1, n_days - delay - test)
+    if train + delay + test > n_days:
+        delay = max(0, n_days - train - test)
+    return train, delay, test
+
+
+def scale_split_to_txs(
+    txs: Transactions,
+    delta_train: int,
+    delta_delay: int,
+    delta_test: int,
+    start_day: int = 0,
+    logger_name: str = "train",
+) -> Tuple[int, int, int]:
+    """:func:`fit_split_to_days` against the span actually available to a
+    split anchored at ``start_day`` (days [start_day, dataset end)), with
+    the scale-down warning. Shared by :func:`train_model` and
+    ``selection.prequential_split``."""
+    n_days = int(txs.tx_time_days.max()) + 1 if txs.n else 0
+    avail = max(0, n_days - start_day)
+    scaled = fit_split_to_days(avail, delta_train, delta_delay, delta_test)
+    if scaled != (delta_train, delta_delay, delta_test):
+        get_logger(logger_name).warning(
+            "%d days available from day %d < configured %d/%d/%d split; "
+            "scaled to %d/%d/%d",
+            avail, start_day, delta_train, delta_delay, delta_test, *scaled,
+        )
+    return scaled
 
 
 def train_delay_test_split(
@@ -332,11 +385,14 @@ def train_model(
         features = compute_features_replay(
             txs, cfg.features, start_date=cfg.data.start_date
         )
-    train_mask, test_mask = train_delay_test_split(
+    dtr, dde, dte = scale_split_to_txs(
         txs,
-        delta_train=cfg.train.delta_train_days,
-        delta_delay=cfg.train.delta_delay_days,
-        delta_test=cfg.train.delta_test_days,
+        cfg.train.delta_train_days,
+        cfg.train.delta_delay_days,
+        cfg.train.delta_test_days,
+    )
+    train_mask, test_mask = train_delay_test_split(
+        txs, delta_train=dtr, delta_delay=dde, delta_test=dte
     )
     model, metrics, _, _ = fit_and_assess(
         txs, features, cfg, kind, train_mask, test_mask

@@ -168,3 +168,46 @@ def test_gbt_device_form_matches(xy):
         np.asarray(gbt_predict_proba(model, jnp.asarray(x32))),
         atol=1e-5,
     )
+
+
+def test_fit_split_to_days_identity_and_scaling():
+    from real_time_fraud_detection_system_tpu.models.train import (
+        fit_split_to_days,
+    )
+
+    # fits: unchanged (the reference's 245-day dataset, 153/30/30)
+    assert fit_split_to_days(245, 153, 30, 30) == (153, 30, 30)
+    # shorter dataset: scaled proportionally, spans never overflow it
+    for n_days in (120, 60, 45, 10, 3, 2):
+        tr, de, te = fit_split_to_days(n_days, 153, 30, 30)
+        assert tr >= 1 and te >= 1 and de >= 0
+        assert tr + de + te <= n_days
+        # shape roughly preserved on non-degenerate sizes
+        if n_days >= 30:
+            assert tr > de and tr > te
+    # a <=1-day dataset cannot hold disjoint train+test windows
+    assert fit_split_to_days(1, 153, 30, 30) == (1, 0, 0)
+    assert fit_split_to_days(0, 153, 30, 30) == (0, 0, 0)
+
+
+def test_train_model_short_dataset_has_metrics(small_dataset):
+    """`make run-all DAYS=60`-style runs must not produce NaN metrics
+    (the configured 153/30/30 split is auto-scaled to the dataset)."""
+    from real_time_fraud_detection_system_tpu.config import (
+        Config,
+        DataConfig,
+        FeatureConfig,
+        TrainConfig,
+    )
+    from real_time_fraud_detection_system_tpu.models import train_model
+
+    _, _, _, txs = small_dataset  # 45 days << 153/30/30
+    cfg = Config(
+        data=DataConfig(n_customers=120, n_terminals=240, n_days=45, seed=7),
+        train=TrainConfig(epochs=2, batch_size=512),  # default 153/30/30
+        features=FeatureConfig(customer_capacity=512,
+                               terminal_capacity=1024),
+    )
+    _, metrics = train_model(txs, cfg, kind="logreg")
+    assert np.isfinite(metrics["auc_roc"]), metrics
+    assert 0.5 <= metrics["auc_roc"] <= 1.0

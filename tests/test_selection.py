@@ -59,7 +59,17 @@ def test_prequential_split_shifts_back(small_dataset, cfg):
             assert days[test_mask].min() >= sd + 15
             assert days[test_mask].max() < sd + 20
     # Folds that would start before day 0 are dropped.
-    assert len(prequential_split(txs, 5, n_folds=4, delta_assessment=5)) == 2
+    assert len(prequential_split(txs, 5, n_folds=4, delta_train=10,
+                                 delta_delay=5, delta_assessment=5)) == 2
+    # Spans that don't fit the dataset are auto-scaled against the span
+    # available from start_day (no empty-test folds from the default
+    # 153/30/30 on a 45-day table).
+    scaled = prequential_split(txs, 5, n_folds=2)
+    assert len(scaled) == 2
+    n_days = int(days.max()) + 1
+    for train_mask, test_mask in scaled:
+        assert train_mask.any() and test_mask.any()
+        assert days[test_mask].max() < n_days
 
 
 def test_expand_param_grid():
@@ -120,3 +130,37 @@ def test_kfold_cv(small_dataset, cfg, feats):
     assert out["n_folds"] == 3.0
     # The learned scorer must beat a coin flip on the synthetic frauds.
     assert out["auc_roc_mean"] > 0.6
+
+
+def test_wrapper_short_dataset_no_validation_test_overlap(
+    small_dataset, cfg, feats
+):
+    """Default 153/30/30 spans on a 45-day table: the wrapper scales ONCE
+    (anchored at the test sweep), so validation test-windows never reach
+    into the test sweep's window — selection can't leak held-out days."""
+    from real_time_fraud_detection_system_tpu.models.train import (
+        fit_split_to_days,
+    )
+
+    _, _, _, txs = small_dataset
+    days = txs.tx_time_days
+    n_days = int(days.max()) + 1
+    start_test = 10
+    tr, de, te = fit_split_to_days(n_days - start_test, 153, 30, 30)
+    rows = model_selection_wrapper(
+        txs, feats, cfg.replace(), "tree",
+        {"tree_max_depth": [2]},
+        # the reference convention: valid anchored one test-span earlier
+        start_day_training_for_valid=start_test - te,
+        start_day_training_for_test=start_test,
+        n_folds=1,
+        delta_train=153, delta_delay=30, delta_assessment=30,
+    )
+    v = [r for r in rows if r.expe_type == "validation"]
+    t = [r for r in rows if r.expe_type == "test"]
+    assert v and t and all(r.n_test > 0 for r in rows)
+    # windows are disjoint: validation test-days end before the test
+    # sweep's window starts
+    v_end = (start_test - te) + tr + de + te  # exclusive
+    t_start = start_test + tr + de
+    assert v_end <= t_start
