@@ -377,6 +377,74 @@ def cmd_query(args) -> int:
     return 0
 
 
+def cmd_compare(args) -> int:
+    """Fit every requested model kind on one shared split and report
+    metrics + fit/predict wall-clock per kind — the reference's
+    5-classifier comparison (``model_training.ipynb · cells 50-56``,
+    timing hooks ``shared_functions.py:312-320``) as one command.
+    Optionally saves the ROC/PR/threshold PNG report per kind."""
+    from real_time_fraud_detection_system_tpu.config import Config, TrainConfig
+    from real_time_fraud_detection_system_tpu.features.offline import (
+        compute_features_replay,
+    )
+    from real_time_fraud_detection_system_tpu.io.artifacts import (
+        load_transactions,
+    )
+    from real_time_fraud_detection_system_tpu.models.train import (
+        fit_and_assess,
+        scale_split_to_txs,
+        train_delay_test_split,
+    )
+    from real_time_fraud_detection_system_tpu.utils import get_logger
+
+    log = get_logger("compare")
+    txs = load_transactions(args.data)
+    cfg = Config(
+        train=TrainConfig(
+            delta_train_days=args.delta_train,
+            delta_delay_days=args.delta_delay,
+            delta_test_days=args.delta_test,
+            epochs=args.epochs,
+        )
+    )
+    features = compute_features_replay(
+        txs, cfg.features, start_date=cfg.data.start_date
+    )
+    dtr, dde, dte = scale_split_to_txs(
+        txs, cfg.train.delta_train_days, cfg.train.delta_delay_days,
+        cfg.train.delta_test_days,
+    )
+    train_mask, test_mask = train_delay_test_split(
+        txs, delta_train=dtr, delta_delay=dde, delta_test=dte
+    )
+    if args.plots_dir:
+        from real_time_fraud_detection_system_tpu.models.plots import (
+            save_plots,
+        )
+
+        os.makedirs(args.plots_dir, exist_ok=True)
+    rows = []
+    for kind in args.models:
+        _, metrics, fit_s, pred_s, probs = fit_and_assess(
+            txs, features, cfg, kind, train_mask, test_mask
+        )
+        row = {
+            "model": kind,
+            **{k: round(float(v), 4) for k, v in metrics.items()},
+            "fit_seconds": round(fit_s, 3),
+            "predict_seconds": round(pred_s, 3),
+        }
+        rows.append(row)
+        log.info("%s", row)
+        if args.plots_dir:
+            save_plots(
+                os.path.join(args.plots_dir, f"{kind}.png"),
+                txs.tx_fraud[test_mask], probs, label=kind,
+            )
+    print(_json_line({"split_days": [dtr, dde, dte], "models": rows}))
+    return 0
+
+
 def cmd_bench(args) -> int:
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     sys.path.insert(0, repo_root)
@@ -493,6 +561,23 @@ def main(argv=None) -> int:
     p.add_argument("--top-k", type=int, default=10)
     p.add_argument("--bucket", default="day", choices=["hour", "day"])
     p.set_defaults(fn=cmd_query)
+
+    p = sub.add_parser(
+        "compare",
+        help="fit several model kinds on one split; metrics + timings",
+    )
+    p.add_argument("--data", required=True)
+    p.add_argument("--models", nargs="+",
+                   default=["logreg", "tree", "forest", "gbt", "mlp"],
+                   choices=["logreg", "mlp", "tree", "forest", "gbt",
+                            "autoencoder"])
+    p.add_argument("--delta-train", type=int, default=153)
+    p.add_argument("--delta-delay", type=int, default=30)
+    p.add_argument("--delta-test", type=int, default=30)
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--plots-dir", default="",
+                   help="write <kind>.png ROC/PR/threshold reports here")
+    p.set_defaults(fn=cmd_compare)
 
     p = sub.add_parser("bench", help="run the benchmark harness")
     p.add_argument("--quick", action="store_true")

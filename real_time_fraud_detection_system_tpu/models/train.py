@@ -341,13 +341,14 @@ def fit_and_assess(
     kind: str,
     train_mask: np.ndarray,
     test_mask: np.ndarray,
-) -> Tuple[TrainedModel, dict, float, float]:
+) -> Tuple[TrainedModel, dict, float, float, np.ndarray]:
     """scale → fit → predict → assess on one (train, test) mask pair.
 
     Shared by :func:`train_model` and the model-selection sweeps; returns
-    (model, test metrics, fit_seconds, predict_seconds) — the timing pair is
-    the reference's per-classifier execution-time hook
-    (``shared_functions.py:312-320``).
+    (model, test metrics, fit_seconds, predict_seconds, test_probs) — the
+    timing pair is the reference's per-classifier execution-time hook
+    (``shared_functions.py:312-320``); the probs let callers plot/report
+    without re-running the (timed) inference pass.
     """
     import time
 
@@ -370,7 +371,7 @@ def fit_and_assess(
         days=txs.tx_time_days[test_mask],
         customer_ids=txs.customer_id[test_mask],
     )
-    return model, metrics, fit_s, predict_s
+    return model, metrics, fit_s, predict_s, probs
 
 
 def train_model(
@@ -394,7 +395,7 @@ def train_model(
     train_mask, test_mask = train_delay_test_split(
         txs, delta_train=dtr, delta_delay=dde, delta_test=dte
     )
-    model, metrics, _, _ = fit_and_assess(
+    model, metrics, _, _, _ = fit_and_assess(
         txs, features, cfg, kind, train_mask, test_mask
     )
     return model, metrics

@@ -153,3 +153,29 @@ def test_latency_tracker():
     snap = t.snapshot()
     assert snap["count"] == 100 and snap["rows"] == 1000
     assert 0 < snap["p50_ms"] <= snap["p99_ms"] <= snap["max_ms"] <= 10.01
+
+
+def test_cli_compare(workdir, capsys):
+    """`rtfds compare` — the reference's 5-classifier comparison
+    (model_training.ipynb · cells 50-56) as one command: shared split,
+    metrics + fit/predict timings per kind, one JSON line out."""
+    txs_path = str(workdir / "txs_cmp.npz")
+    plots_dir = str(workdir / "plots")
+    assert cli_main([
+        "datagen", "--out", txs_path, "--customers", "100", "--terminals",
+        "200", "--days", "40",
+    ]) == 0
+    assert cli_main([
+        "compare", "--data", txs_path, "--models", "logreg", "tree",
+        "--epochs", "2", "--plots-dir", plots_dir,
+    ]) == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    out = json.loads(line)
+    assert [m["model"] for m in out["models"]] == ["logreg", "tree"]
+    for m in out["models"]:
+        assert np.isfinite(m["auc_roc"]) and m["fit_seconds"] > 0
+    # scaled split recorded; spans fit the 40-day table
+    assert sum(out["split_days"]) <= 40
+    assert {f"{k}.png" for k in ("logreg", "tree")} <= set(
+        os.listdir(plots_dir)
+    )
