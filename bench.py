@@ -192,6 +192,12 @@ def _child_main(args) -> None:
     import jax
     import jax.numpy as jnp
 
+    from real_time_fraud_detection_system_tpu.utils import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
+
     from real_time_fraud_detection_system_tpu.config import (
         Config,
         FeatureConfig,

@@ -7,6 +7,7 @@ from real_time_fraud_detection_system_tpu.utils.logging import (  # noqa: F401
     get_logger,
 )
 from real_time_fraud_detection_system_tpu.utils.tracing import (  # noqa: F401
+    enable_compilation_cache,
     trace_span,
     profile_to,
 )

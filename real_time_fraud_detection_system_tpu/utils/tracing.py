@@ -13,7 +13,30 @@ show up on the profiler timeline next to device ops.
 from __future__ import annotations
 
 import contextlib
+import os
 from typing import Iterator, Optional
+
+
+def enable_compilation_cache(path: Optional[str] = None) -> None:
+    """Turn on jax's persistent XLA compilation cache (best-effort).
+
+    Over the axon TPU tunnel every compile is a ~20-40 s remote call;
+    caching makes re-runs (bench retries, the parity gate, the kernel
+    profiler) skip them. Default path is user-scoped (``~/.cache``) so a
+    shared /tmp on a multi-user host can't collide or be pre-created by
+    another user. jax fingerprints backend/config into the cache key, so
+    stale entries are never reused incorrectly."""
+    import jax
+
+    if path is None:
+        path = os.path.join(
+            os.path.expanduser("~"), ".cache", "rtfds", "xla"
+        )
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception:
+        pass  # older jax without the knobs: compile uncached
 
 
 @contextlib.contextmanager

@@ -1,0 +1,45 @@
+"""The standalone hardware tools must at least run clean on CPU.
+
+tests/conftest.py pins pytest itself to the virtual CPU mesh, so the
+tools are exercised as subprocesses with an explicit ``JAX_PLATFORMS=cpu``
+— the same invocation the tunnel watcher (``tools/hw_watch.sh``) uses,
+minus the real device."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(cmd, extra_env=None, timeout=300):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **(extra_env or {}))
+    return subprocess.run(
+        cmd, cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=timeout,
+    )
+
+
+def test_hw_parity_check_cpu():
+    p = _run([sys.executable, "tools/hw_parity_check.py"])
+    assert p.returncode == 0, p.stderr[-800:]
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert out["ok"] is True
+    assert out["forest_gemm_max_abs_diff"] < 1e-5
+    assert out["feature_kernel_max_abs_diff"] < 1e-4
+    assert out["auc_abs_gap"] < 1e-3
+
+
+def test_step_profile_variants_exact_cpu():
+    p = _run(
+        [sys.executable, "tools/tpu_step_profile.py"],
+        extra_env={"PROFILE_ROWS": "512"},
+        timeout=560,
+    )
+    assert p.returncode == 0, p.stderr[-800:]
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    for variant in ("current", "projHIGH", "gatherD", "flatproj"):
+        assert out[variant]["max_abs_diff_vs_sklearn"] < 1e-5, (
+            variant, out[variant],
+        )

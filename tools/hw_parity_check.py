@@ -68,6 +68,11 @@ def main() -> None:
         want = want + ",cpu"
     if want:
         jax.config.update("jax_platforms", want)
+    from real_time_fraud_detection_system_tpu.utils import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
     import jax.numpy as jnp
 
     _note("bring-up (jax.devices)")

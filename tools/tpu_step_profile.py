@@ -40,6 +40,11 @@ def main() -> None:
     want = os.environ.get("JAX_PLATFORMS")
     if want:
         jax.config.update("jax_platforms", want)
+    from real_time_fraud_detection_system_tpu.utils import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
 
     from sklearn.ensemble import RandomForestClassifier
 
