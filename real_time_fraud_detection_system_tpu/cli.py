@@ -39,6 +39,13 @@ def _platform_setup(platform: str | None) -> None:
         import jax
 
         jax.config.update("jax_platforms", want)
+    from real_time_fraud_detection_system_tpu.utils import (
+        enable_compilation_cache,
+    )
+
+    # Serving restarts over the TPU tunnel pay ~20-40 s per remote
+    # compile; the persistent cache makes them warm starts.
+    enable_compilation_cache()
 
 
 def _json_line(obj) -> str:

@@ -39,7 +39,7 @@ def test_step_profile_variants_exact_cpu():
     )
     assert p.returncode == 0, p.stderr[-800:]
     out = json.loads(p.stdout.strip().splitlines()[-1])
-    for variant in ("current", "projHIGH", "gatherD", "flatproj"):
+    for variant in ("current", "projHIGH", "gatherD", "flatproj", "int8z"):
         assert out[variant]["max_abs_diff_vs_sklearn"] < 1e-5, (
             variant, out[variant],
         )

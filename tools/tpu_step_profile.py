@@ -17,6 +17,9 @@ that keep decision-exactness, on whatever backend is live:
               matmul (static feat indices; no precision question)
   flatproj  — proj as ONE [B,15]x[15,T*I] matmul (reshape of sel) at
               HIGHEST; same math, different tiling
+  int8z     — the z contraction in int8×int8→int32 (d is 0/1, path is
+              ±1/0, z counts ≤ depth: all exactly representable; v5e
+              MXU int8 peak is 2× bf16)
 
 Prints one JSON line; run under the tunnel watcher when the TPU is up.
 """
@@ -119,6 +122,19 @@ def main() -> None:
         onehot = (jnp.abs(z - g.target[None]) < 0.5).astype(jnp.float32)
         return stage_leaf(onehot) / T
 
+    path_i8 = g.path.astype(jnp.int8)
+    target_i32 = g.target.astype(jnp.int32)
+
+    def kernel_int8z(x):
+        proj = jnp.einsum("bf,tfi->bti", x, g.sel, precision=hi)
+        d = (proj <= g.thresh[None]).astype(jnp.int8)
+        z = jnp.einsum("bti,til->btl", d, path_i8,
+                       preferred_element_type=jnp.int32)
+        # target is an exact small integer for real leaves and 1e9 for
+        # padding — the int32 cast keeps padded leaves unmatched.
+        onehot = (z == target_i32[None]).astype(jnp.float32)
+        return stage_leaf(onehot) / T
+
     def bench(fn, *args, iters=20):
         if not on_tpu:
             iters = max(1, iters // 10)  # GEMM-on-CPU is ~1000x slower
@@ -149,7 +165,8 @@ def main() -> None:
     for name, fn in [("current", kernel_current),
                      ("projHIGH", kernel_projHIGH),
                      ("gatherD", kernel_gatherD),
-                     ("flatproj", kernel_flatproj)]:
+                     ("flatproj", kernel_flatproj),
+                     ("int8z", kernel_int8z)]:
         try:
             t, out = bench(fn, x)
             p = np.asarray(out)
