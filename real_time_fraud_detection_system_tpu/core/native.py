@@ -2,10 +2,22 @@
 
 Compiles the shared library on first use (g++ available in the image; the
 build is one translation unit, <1 s) and caches the handle. All callers go
-through :func:`decode_transaction_envelopes_native`, which has the exact
-interface and semantics of the pure-Python
+through :func:`decode_transaction_envelopes_native`, which has the same
+interface as the pure-Python
 :func:`..core.envelope.decode_transaction_envelopes` — the dispatcher there
 prefers this path when available.
+
+Validity contract (differential-fuzz-pinned, ``tests/test_native.py``):
+the scanner extracts the required payload fields WITHOUT validating the
+whole JSON document — that is what makes it line-rate. Consequently it is
+strictly MORE lenient than the Python decoder: every message the scanner
+rejects, the strict parser rejects too, and on messages both accept the
+decoded columns are bit-identical; but a message whose required fields are
+intact inside otherwise-broken JSON (truncated tail, garbage between
+tokens) decodes here and is rejected by the strict parser. For
+well-formed Debezium traffic the two are exactly equivalent. (The scanner
+also does not un-escape ``\\uXXXX`` key names — Debezium never emits
+them.)
 """
 
 from __future__ import annotations

@@ -69,3 +69,63 @@ def test_native_schema_section_does_not_confuse_scanner():
     assert not inv[0]
     assert c["tx_id"][0] == 42
     assert c["tx_amount_cents"][0] == 0x01C8B4
+
+
+def test_native_parity_differential_fuzz(rng):
+    """Mutation fuzz pinning the decoders' validity contract (see
+    core/native.py docstring): the scanner is strictly more lenient — its
+    invalid set is a SUBSET of the strict parser's — and wherever both
+    accept a message the decoded columns are bit-identical. Inputs:
+    truncations, byte flips, garbage splices, whitespace injection."""
+    base = encode_transaction_envelopes(
+        np.arange(64, dtype=np.int64),
+        rng.integers(1_700_000_000, 1_800_000_000, 64) * 1_000_000,
+        rng.integers(0, 5000, 64),
+        rng.integers(0, 10000, 64),
+        rng.integers(-(10**6), 10**6, 64),
+    )
+    garbage = [b"", b"{", b"}", b'\x00\xff\xfe', b'{"payload":',
+               b'[1,2,3]', b'true', b'"payload"']
+    cases = []
+    for i in range(400):
+        m = bytearray(base[int(rng.integers(0, len(base)))])
+        op = int(rng.integers(0, 5))
+        if op == 0 and len(m) > 2:  # truncate
+            m = m[: int(rng.integers(1, len(m)))]
+        elif op == 1 and len(m) > 4:  # flip random bytes
+            for _ in range(int(rng.integers(1, 4))):
+                m[int(rng.integers(0, len(m)))] = int(rng.integers(32, 127))
+            # keep it bytes-decodable; arbitrary flips within ASCII range
+        elif op == 2:  # splice garbage into the middle
+            pos = int(rng.integers(0, len(m)))
+            g = garbage[int(rng.integers(0, len(garbage)))]
+            m = m[:pos] + bytearray(g) + m[pos:]
+        elif op == 3:  # random whitespace injection around punctuation
+            out = bytearray()
+            for b in m:
+                out.append(b)
+                if b in b'{},:' and rng.random() < 0.3:
+                    out += b" \t"
+            m = out
+        # op == 4: leave valid (control group)
+        cases.append(bytes(m))
+    cases += garbage
+
+    c_py, i_py = decode_transaction_envelopes(cases)
+    c_nat, i_nat = decode_transaction_envelopes_native(cases)
+    # Strictness ordering: scanner-invalid ⊆ parser-invalid. A message the
+    # lenient scanner drops but the strict parser accepts would be silent
+    # row loss on the native path — never allowed.
+    leak = i_nat & ~i_py
+    assert not leak.any(), (
+        f"scanner rejected messages the strict parser accepts: "
+        f"{np.flatnonzero(leak)[:5]}"
+    )
+    both_ok = ~i_py & ~i_nat
+    for k in c_py:
+        ok = np.array_equal(c_py[k][both_ok], c_nat[k][both_ok])
+        assert ok, (k, np.flatnonzero(
+            c_py[k][both_ok] != c_nat[k][both_ok])[:5])
+    # Control group sanity: some mutated-but-intact and all clean cases
+    # must decode on both paths.
+    assert both_ok.sum() > 50
