@@ -208,3 +208,63 @@ def test_run_demo_sharded_matches_single_chip(tmp_path):
     # Sharded demo landed both the analyzed parquet and the raw table.
     assert list((tmp_path / "out8").glob("*.parquet"))
     assert list((tmp_path / "out8" / "transactions").glob("tx_date=*"))
+
+
+def test_upsert_table_randomized_oracle(rng):
+    """Property fuzz: UpsertTable.merge vs a dict-based oracle under random
+    interleavings of upserts, deletes, out-of-order timestamps, duplicate
+    keys within a batch, and whole-batch replays (idempotence)."""
+    from real_time_fraud_detection_system_tpu.core.schema import CUSTOMERS
+
+    t = UpsertTable(CUSTOMERS, capacity=4)  # force repeated growth
+    oracle = {}  # key -> (version, x) for live rows
+    versions = {}  # key -> last version seen (incl. deletes/tombstones)
+
+    def oracle_merge(ids, xs, ts, ops):
+        # within-batch latest-wins: greatest ts, batch position breaks ties
+        best = {}
+        for i in range(len(ids)):
+            k = int(ids[i])
+            if k not in best or ts[i] >= ts[best[k]]:
+                best[k] = i
+        for k, i in best.items():
+            v = int(ts[i])
+            if v <= versions.get(k, -10**18):
+                continue  # stale replay
+            versions[k] = v
+            if ops[i] == 2:
+                oracle.pop(k, None)
+            else:
+                oracle[k] = float(xs[i])
+
+    batches = []
+    for step in range(60):
+        n = int(rng.integers(1, 12))
+        ids = rng.integers(0, 25, n)  # small key space → heavy collisions
+        xs = rng.random(n) * 100
+        ts = rng.integers(0, 50, n)  # heavily colliding, out-of-order
+        ops = np.where(rng.random(n) < 0.2, 2, 0).astype(np.int8)
+        cols = {
+            "customer_id": ids.astype(np.int64),
+            "x_location": xs.astype(np.float64),
+            "y_location": np.zeros(n),
+            "kafka_ts_ms": ts.astype(np.int64),
+            "op": ops,
+        }
+        batches.append(cols)
+        t.merge(cols, ts=ts.astype(np.int64), op=ops)
+        oracle_merge(ids, xs, ts, ops)
+
+        if rng.random() < 0.25 and batches:
+            # replay a random earlier batch — must be a stale no-op
+            j = int(rng.integers(0, len(batches)))
+            rb = batches[j]
+            t.merge(rb, ts=rb["kafka_ts_ms"], op=rb["op"])
+
+        got = t.to_columns()
+        live = {int(k): float(x) for k, x in
+                zip(got["customer_id"], got["x_location"])}
+        assert live == oracle, (
+            f"divergence at step {step}: {live} != {oracle}"
+        )
+        assert len(t) == len(oracle)
