@@ -251,38 +251,50 @@ def cmd_score(args) -> int:
                   "(--max-restarts with --checkpoint-dir); without it the "
                   "watchdog has no restart path to escalate into")
         return 2
+    from real_time_fraud_detection_system_tpu.utils import profile_to
+
+    if args.trace_dir and args.source == "kafka" and not args.max_batches:
+        # jax.profiler buffers the whole trace in host memory until
+        # stop_trace; an unbounded live stream would grow it without limit.
+        log.warning(
+            "--trace-dir on an unbounded Kafka stream traces the ENTIRE "
+            "run and buffers it in host memory; bound the run with "
+            "--max-batches for a usable trace"
+        )
+
     fb = None
     try:
-        if ckpt is not None and args.max_restarts > 0:
-            # Supervised mode: restart-on-failure with checkpoint replay
-            # (the compose `restart: on-failure` + Spark checkpoint
-            # contract).
-            from real_time_fraud_detection_system_tpu.runtime.faults import (
-                run_with_recovery,
-            )
+        with profile_to(args.trace_dir or None):
+            if ckpt is not None and args.max_restarts > 0:
+                # Supervised mode: restart-on-failure with checkpoint replay
+                # (the compose `restart: on-failure` + Spark checkpoint
+                # contract).
+                from real_time_fraud_detection_system_tpu.runtime.faults import (
+                    run_with_recovery,
+                )
 
-            stats = run_with_recovery(
-                make_engine, source, ckpt, sink=sink,
-                max_restarts=args.max_restarts, max_batches=args.max_batches,
-                resume=args.resume, stall_timeout_s=args.stall_timeout,
-                make_source=source_factory, make_feedback=make_feedback,
-            )
-        else:
-            engine = make_engine()
-            if ckpt is not None and args.resume:
-                restored = ckpt.restore(engine.state)
-                if restored is not None:
-                    source.seek(engine.state.offsets)
-                    log.info("resumed from batch %d",
-                             engine.state.batches_done)
-                truncate = getattr(sink, "truncate_after", None)
-                if truncate is not None:
-                    truncate(engine.state.batches_done)
-            fb = make_feedback(engine) if make_feedback else None
-            stats = engine.run(
-                source, sink=sink, checkpointer=ckpt,
-                max_batches=args.max_batches, feedback=fb,
-            )
+                stats = run_with_recovery(
+                    make_engine, source, ckpt, sink=sink,
+                    max_restarts=args.max_restarts, max_batches=args.max_batches,
+                    resume=args.resume, stall_timeout_s=args.stall_timeout,
+                    make_source=source_factory, make_feedback=make_feedback,
+                )
+            else:
+                engine = make_engine()
+                if ckpt is not None and args.resume:
+                    restored = ckpt.restore(engine.state)
+                    if restored is not None:
+                        source.seek(engine.state.offsets)
+                        log.info("resumed from batch %d",
+                                 engine.state.batches_done)
+                    truncate = getattr(sink, "truncate_after", None)
+                    if truncate is not None:
+                        truncate(engine.state.batches_done)
+                fb = make_feedback(engine) if make_feedback else None
+                stats = engine.run(
+                    source, sink=sink, checkpointer=ckpt,
+                    max_batches=args.max_batches, feedback=fb,
+                )
     finally:
         close = getattr(source, "close", None)
         if close is not None:
@@ -534,6 +546,9 @@ def main(argv=None) -> int:
                    help="serve on an N-device mesh (sharded engine: "
                         "customer-partitioned rows, all_to_all terminal "
                         "exchange); 1 = single-chip engine")
+    p.add_argument("--trace-dir", default="",
+                   help="capture a jax.profiler/TensorBoard trace of the "
+                        "serving run into this directory")
     p.set_defaults(fn=cmd_score)
 
     p = sub.add_parser("demo",

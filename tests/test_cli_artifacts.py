@@ -179,3 +179,21 @@ def test_cli_compare(workdir, capsys):
     assert {f"{k}.png" for k in ("logreg", "tree")} <= set(
         os.listdir(plots_dir)
     )
+
+
+def test_cli_score_trace_dir(workdir, capsys):
+    """`score --trace-dir` captures a jax.profiler trace of the serving
+    run (SURVEY §5.1: tracing built into the step loop)."""
+    txs_path = str(workdir / "txs.npz")      # from the roundtrip test
+    model_path = str(workdir / "model.npz")
+    trace_dir = str(workdir / "trace")
+    assert cli_main([
+        "score", "--data", txs_path, "--model-file", model_path,
+        "--scorer", "tpu", "--batch-rows", "2048", "--max-batches", "1",
+        "--trace-dir", trace_dir,
+    ]) == 0
+    capsys.readouterr()
+    found = []
+    for dirpath, _, files in os.walk(trace_dir):
+        found += [f for f in files if f.endswith((".pb", ".json.gz"))]
+    assert found, f"no trace artifacts under {trace_dir}"
