@@ -464,6 +464,86 @@ def cmd_compare(args) -> int:
     return 0
 
 
+def cmd_select(args) -> int:
+    """Prequential hyper-parameter selection — the reference's
+    ``prequential_grid_search`` / ``model_selection_wrapper`` notebooks
+    (``shared_functions.py:774-872``) as one command. ``--grid`` takes
+    ``field=v1,v2,...`` pairs over ModelConfig/TrainConfig fields."""
+    from real_time_fraud_detection_system_tpu.config import Config, TrainConfig
+    from real_time_fraud_detection_system_tpu.features.offline import (
+        compute_features_replay,
+    )
+    from real_time_fraud_detection_system_tpu.io.artifacts import (
+        load_transactions,
+    )
+    from real_time_fraud_detection_system_tpu.models.selection import (
+        execution_times,
+        model_selection_wrapper,
+        summarize_performances,
+    )
+    from real_time_fraud_detection_system_tpu.utils import get_logger
+
+    import dataclasses
+
+    from real_time_fraud_detection_system_tpu.config import ModelConfig
+
+    log = get_logger("select")
+    # Validate the grid BEFORE the (minutes-long at scale) data load and
+    # feature replay: spec syntax and field names both.
+    known = {f.name for f in dataclasses.fields(ModelConfig)} | {
+        f.name for f in dataclasses.fields(TrainConfig)
+    }
+    grid = {}
+    for spec in args.grid:
+        field, _, vals = spec.partition("=")
+        if not vals:
+            log.error("--grid expects field=v1,v2,... (got %r)", spec)
+            return 2
+        if field not in known:
+            log.error("--grid field %r is not a ModelConfig/TrainConfig "
+                      "field (known: %s)", field, ", ".join(sorted(known)))
+            return 2
+        parsed = []
+        for v in vals.split(","):
+            try:
+                parsed.append(int(v))
+            except ValueError:
+                try:
+                    parsed.append(float(v))
+                except ValueError:
+                    parsed.append(v)
+        grid[field] = parsed
+    txs = load_transactions(args.data)
+    cfg = Config(train=TrainConfig(epochs=args.epochs))
+    features = compute_features_replay(
+        txs, cfg.features, start_date=cfg.data.start_date
+    )
+    rows = model_selection_wrapper(
+        txs, features, cfg, args.model, grid,
+        start_day_training_for_valid=args.start_valid,
+        start_day_training_for_test=args.start_test,
+        n_folds=args.folds,
+    )
+    summaries = summarize_performances(rows)
+    out = {
+        "model": args.model,
+        "grid": grid,
+        "metrics": {
+            m: {
+                "best_params": s.best_params,
+                "validation": [round(s.validation_mean, 4),
+                               round(s.validation_std, 4)],
+                "test": [round(s.test_mean, 4), round(s.test_std, 4)],
+            }
+            for m, s in summaries.items()
+        },
+        "execution_times": execution_times(rows),
+    }
+    log.info("best by auc_roc: %s", summaries["auc_roc"].best_params)
+    print(_json_line(out))
+    return 0
+
+
 def cmd_bench(args) -> int:
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     sys.path.insert(0, repo_root)
@@ -600,6 +680,25 @@ def main(argv=None) -> int:
     p.add_argument("--plots-dir", default="",
                    help="write <kind>.png ROC/PR/threshold reports here")
     p.set_defaults(fn=cmd_compare)
+
+    p = sub.add_parser(
+        "select",
+        help="prequential hyper-parameter selection (validation+test sweeps)",
+    )
+    p.add_argument("--data", required=True)
+    p.add_argument("--model", default="tree",
+                   choices=["logreg", "mlp", "tree", "forest", "gbt"])
+    p.add_argument("--grid", nargs="+", required=True,
+                   metavar="FIELD=V1,V2",
+                   help="e.g. tree_max_depth=2,4,8 epochs=3,5")
+    p.add_argument("--start-valid", type=int, required=True,
+                   help="training-start day for the validation sweep")
+    p.add_argument("--start-test", type=int, required=True,
+                   help="training-start day for the test sweep (later; "
+                        "windows stay disjoint per the wrapper contract)")
+    p.add_argument("--folds", type=int, default=4)
+    p.add_argument("--epochs", type=int, default=3)
+    p.set_defaults(fn=cmd_select)
 
     p = sub.add_parser("bench", help="run the benchmark harness")
     p.add_argument("--quick", action="store_true")

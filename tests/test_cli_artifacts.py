@@ -197,3 +197,31 @@ def test_cli_score_trace_dir(workdir, capsys):
     for dirpath, _, files in os.walk(trace_dir):
         found += [f for f in files if f.endswith((".pb", ".json.gz"))]
     assert found, f"no trace artifacts under {trace_dir}"
+
+
+def test_cli_select(workdir, capsys):
+    """`rtfds select` — the reference's prequential grid search
+    (shared_functions.py:774-872) as one command."""
+    txs_path = str(workdir / "txs.npz")  # from the roundtrip test
+    assert cli_main([
+        "select", "--data", txs_path, "--model", "tree",
+        "--grid", "tree_max_depth=2,4",
+        "--start-valid", "15", "--start-test", "20",
+        "--folds", "2", "--epochs", "2",
+    ]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["grid"] == {"tree_max_depth": [2, 4]}
+    s = out["metrics"]["auc_roc"]
+    assert s["best_params"]["tree_max_depth"] in (2, 4)
+    assert len(out["execution_times"]) == 2
+    # malformed grid spec / unknown field: usage errors (exit 2), not
+    # crashes — and rejected BEFORE the data load (nonexistent path).
+    assert cli_main([
+        "select", "--data", txs_path, "--grid", "oops",
+        "--start-valid", "15", "--start-test", "20",
+    ]) == 2
+    assert cli_main([
+        "select", "--data", "/nonexistent.npz",
+        "--grid", "tree_maxdepth=2",
+        "--start-valid", "15", "--start-test", "20",
+    ]) == 2
