@@ -396,6 +396,28 @@ def cmd_query(args) -> int:
     return 0
 
 
+def cmd_dashboard(args) -> int:
+    """Render the static-HTML ops dashboard (the Superset role)."""
+    from real_time_fraud_detection_system_tpu.io.dashboard import (
+        write_dashboard,
+    )
+
+    try:
+        manifest = write_dashboard(
+            args.data,
+            args.out,
+            threshold=args.threshold,
+            top_k=args.top_k,
+            bucket=args.bucket,
+            title=args.title,
+        )
+    except FileNotFoundError as e:
+        print(_json_line({"error": str(e)}))
+        return 2
+    print(_json_line(manifest))
+    return 0
+
+
 def cmd_compare(args) -> int:
     """Fit every requested model kind on one shared split and report
     metrics + fit/predict wall-clock per kind — the reference's
@@ -663,6 +685,20 @@ def main(argv=None) -> int:
     p.add_argument("--top-k", type=int, default=10)
     p.add_argument("--bucket", default="day", choices=["hour", "day"])
     p.set_defaults(fn=cmd_query)
+
+    p = sub.add_parser(
+        "dashboard",
+        help="render the static-HTML ops dashboard (the Superset role)",
+    )
+    p.add_argument("--data", required=True,
+                   help="analyzed output directory (ParquetSink)")
+    p.add_argument("--out", default="dashboard.html")
+    p.add_argument("--threshold", type=float, default=0.5)
+    p.add_argument("--top-k", type=int, default=10)
+    p.add_argument("--bucket", default="day", choices=["hour", "day"])
+    p.add_argument("--title", default=None,
+                   help="page title (default set in io.dashboard)")
+    p.set_defaults(fn=cmd_dashboard)
 
     p = sub.add_parser(
         "compare",

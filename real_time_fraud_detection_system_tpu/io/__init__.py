@@ -19,3 +19,7 @@ from real_time_fraud_detection_system_tpu.io.tables import (  # noqa: F401
     RawTransactionsTable,
     UpsertTable,
 )
+from real_time_fraud_detection_system_tpu.io.dashboard import (  # noqa: F401
+    render_dashboard_html,
+    write_dashboard,
+)

@@ -1,0 +1,443 @@
+"""Static-HTML fraud-ops dashboard over the analyzed output.
+
+The reference ships Superset pre-wired to Trino over
+``nessie.payment.analyzed_transactions`` (``superset/entrypoint.sh:19``,
+``docker-compose.yml:141-161``) as its L5 visualization layer. This module
+is the in-process equivalent: it renders the canned aggregations from
+:mod:`.query` into ONE self-contained HTML file — no server, no JS/CSS
+dependencies, works offline and over ``file://`` — so a deployment without
+the Trino/Superset stack still gets the dashboard, and one WITH the stack
+can keep using Superset on the unchanged Parquet output.
+
+Views (mirroring the reference dashboard's charts over
+``analyzed_transactions``):
+
+- headline stat tiles (volume, flags, amounts, score tail)
+- transactions-per-bucket and flag-rate-per-bucket time series
+  (two charts, one y-axis each — never dual-axis)
+- top risky terminals / customers (the scenario-2 / scenario-3 detection
+  surfaces, ``data_generator.ipynb · cell 42``) as bar charts
+- the recent-alerts work queue as a table
+
+Every chart carries a hover tooltip layer, a ``<details>`` table-view twin
+(values are never color- or hover-gated), and light/dark theming driven by
+``prefers-color-scheme``.
+"""
+
+from __future__ import annotations
+
+import html
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from real_time_fraud_detection_system_tpu.io.query import (
+    fraud_rate_over_time,
+    load_analyzed,
+    recent_alerts,
+    summary_stats,
+    top_risky_customers,
+    top_risky_terminals,
+)
+
+_US = 1_000_000
+
+# Chart geometry (CSS px). Bars stay <= 24px thick per the mark spec.
+_W, _H = 640, 200
+_PAD_L, _PAD_R, _PAD_T, _PAD_B = 46, 14, 10, 22
+_BAR_H = 18
+
+
+def _esc(v) -> str:
+    return html.escape(str(v), quote=True)
+
+
+def _compact(v: float, money: bool = False) -> str:
+    """1,284 / 12.9K / $4.2M — stat-tile value formatting."""
+    sign = "-" if v < 0 else ""
+    a = abs(float(v))
+    pre = "$" if money else ""
+    if a >= 1e9:
+        s = f"{a / 1e9:.1f}B"
+    elif a >= 1e6:
+        s = f"{a / 1e6:.1f}M"
+    elif a >= 10_000:
+        s = f"{a / 1e3:.1f}K"
+    elif money:
+        s = f"{a:,.2f}"
+    elif a == int(a):
+        s = f"{int(a):,}"
+    else:
+        s = f"{a:,.3g}"
+    return f"{sign}{pre}{s}"
+
+
+def _nice_max(v: float) -> float:
+    """Round up to a clean axis maximum (1/2/2.5/5 × 10^k)."""
+    if v <= 0:
+        return 1.0
+    exp = np.floor(np.log10(v))
+    for m in (1.0, 2.0, 2.5, 5.0, 10.0):
+        top = m * 10.0 ** exp
+        if v <= top:
+            return float(top)
+    return float(10.0 ** (exp + 1))
+
+
+def _day_label(us: int) -> str:
+    t = time.gmtime(int(us) // _US)
+    return f"{t.tm_year:04d}-{t.tm_mon:02d}-{t.tm_mday:02d}"
+
+
+def _hour_label(us: int) -> str:
+    t = time.gmtime(int(us) // _US)
+    return (f"{t.tm_year:04d}-{t.tm_mon:02d}-{t.tm_mday:02d} "
+            f"{t.tm_hour:02d}:00")
+
+
+def _table_twin(headers: Sequence[str], rows: Sequence[Sequence[str]]) -> str:
+    """The <details> table view — the WCAG-clean twin of every chart."""
+    head = "".join(f"<th>{_esc(h)}</th>" for h in headers)
+    body = "".join(
+        "<tr>" + "".join(f"<td>{_esc(c)}</td>" for c in r) + "</tr>"
+        for r in rows
+    )
+    return ("<details class='twin'><summary>Table view</summary>"
+            f"<table><thead><tr>{head}</tr></thead>"
+            f"<tbody>{body}</tbody></table></details>")
+
+
+def _grid_and_yticks(vmax: float, fmt=lambda v: _compact(v)) -> str:
+    """4 hairline gridlines + clean tick labels along the left edge."""
+    out = []
+    ph = _H - _PAD_T - _PAD_B
+    for i in range(5):
+        frac = i / 4
+        y = _PAD_T + ph * (1 - frac)
+        out.append(
+            f"<line class='grid' x1='{_PAD_L}' y1='{y:.1f}' "
+            f"x2='{_W - _PAD_R}' y2='{y:.1f}'/>"
+        )
+        out.append(
+            f"<text class='tick' x='{_PAD_L - 6}' y='{y + 3:.1f}' "
+            f"text-anchor='end'>{_esc(fmt(vmax * frac))}</text>"
+        )
+    return "".join(out)
+
+
+def _line_chart(
+    xs_label: List[str],
+    ys: np.ndarray,
+    *,
+    unit: str = "",
+    percent: bool = False,
+) -> str:
+    """Single-series line with area wash, end marker, hover layer.
+
+    One series → no legend box (the card title names it); the endpoint
+    value is the one direct label.
+    """
+    n = len(ys)
+    if n == 0:
+        return "<p class='empty'>no data</p>"
+    pw = _W - _PAD_L - _PAD_R
+    ph = _H - _PAD_T - _PAD_B
+    vmax = _nice_max(float(np.max(ys)) if n else 1.0)
+    if percent:
+        vmax = max(vmax, 0.05)
+
+    def px(i: int) -> float:
+        return _PAD_L + (pw * (i + 0.5) / n)
+
+    def py(v: float) -> float:
+        return _PAD_T + ph * (1.0 - float(v) / vmax)
+
+    fmt = (lambda v: f"{100 * v:.3g}%") if percent else _compact
+    pts = " ".join(f"{px(i):.1f},{py(ys[i]):.1f}" for i in range(n))
+    area = (f"{_PAD_L + pw * 0.5 / n:.1f},{_PAD_T + ph} {pts} "
+            f"{px(n - 1):.1f},{_PAD_T + ph}")
+    ex, ey = px(n - 1), py(ys[n - 1])
+    # Full-band transparent hit columns: targets far bigger than the mark.
+    hits = "".join(
+        f"<rect class='hit' x='{_PAD_L + pw * i / n:.1f}' y='{_PAD_T}' "
+        f"width='{pw / n:.2f}' height='{ph}' tabindex='0' "
+        f"data-tip='{_esc(xs_label[i])}: {_esc(fmt(ys[i]))}{_esc(unit)}'>"
+        "</rect>"
+        for i in range(n)
+    )
+    x_first, x_last = _esc(xs_label[0]), _esc(xs_label[-1])
+    return f"""<svg viewBox='0 0 {_W} {_H}' role='img'>
+{_grid_and_yticks(vmax, fmt)}
+<line class='axis' x1='{_PAD_L}' y1='{_PAD_T + ph}' x2='{_W - _PAD_R}' y2='{_PAD_T + ph}'/>
+<polygon class='wash' points='{area}'/>
+<polyline class='line' points='{pts}'/>
+<circle class='dot' cx='{ex:.1f}' cy='{ey:.1f}' r='4'/>
+<text class='endlabel' x='{ex - 6:.1f}' y='{ey - 8:.1f}' text-anchor='end'>{_esc(fmt(ys[-1]))}</text>
+<text class='tick' x='{_PAD_L}' y='{_H - 6}'>{x_first}</text>
+<text class='tick' x='{_W - _PAD_R}' y='{_H - 6}' text-anchor='end'>{x_last}</text>
+{hits}
+</svg>"""
+
+
+def _bar_path(x: float, y: float, w: float, h: float, r: float = 4.0) -> str:
+    """Horizontal bar: square at the baseline (left), 4px rounded data-end."""
+    r = min(r, w / 2, h / 2)
+    return (f"M{x:.1f},{y:.1f} h{w - r:.1f} "
+            f"a{r},{r} 0 0 1 {r},{r} v{h - 2 * r:.1f} "
+            f"a{r},{r} 0 0 1 -{r},{r} h-{w - r:.1f} z")
+
+
+def _hbar_chart(labels: List[str], values: np.ndarray, counts: np.ndarray,
+                *, vmax: float = 1.0, key_name: str = "key") -> str:
+    """Horizontal single-series bars (mean score 0..vmax), value at the tip."""
+    n = len(labels)
+    if n == 0:
+        return "<p class='empty'>no data</p>"
+    label_w = 90
+    pw = _W - label_w - 60
+    h = n * (_BAR_H + 8) + 8
+    rows = []
+    for i in range(n):
+        y = 4 + i * (_BAR_H + 8)
+        w = max(2.0, pw * float(values[i]) / vmax)
+        tip = (f"{key_name} {labels[i]}: score {values[i]:.3f} "
+               f"over {int(counts[i])} txs")
+        rows.append(
+            f"<text class='lab' x='{label_w - 8}' y='{y + _BAR_H - 5}' "
+            f"text-anchor='end'>{_esc(labels[i])}</text>"
+            f"<path class='bar' d='{_bar_path(label_w, y, w, _BAR_H)}'/>"
+            f"<text class='val' x='{label_w + w + 6:.1f}' "
+            f"y='{y + _BAR_H - 5}'>{values[i]:.3f}</text>"
+            f"<rect class='hit' x='0' y='{y - 4}' width='{_W}' "
+            f"height='{_BAR_H + 8}' tabindex='0' data-tip='{_esc(tip)}'>"
+            "</rect>"
+        )
+    return (f"<svg viewBox='0 0 {_W} {h}' role='img'>"
+            f"<line class='axis' x1='{label_w}' y1='0' x2='{label_w}' "
+            f"y2='{h}'/>" + "".join(rows) + "</svg>")
+
+
+def _tiles(s: dict) -> str:
+    if s.get("transactions", 0) == 0:
+        return "<p class='empty'>no analyzed transactions</p>"
+    thr = s["threshold"]
+    tiles = [
+        ("Transactions", _compact(s["transactions"]), ""),
+        ("Flagged", _compact(s["flagged"]),
+         f"{100 * s['flagged_rate']:.2f}% at threshold {thr:g}"),
+        ("Flagged amount", _compact(s["flagged_amount"], money=True),
+         f"of {_compact(s['total_amount'], money=True)} total"),
+        ("Customers", _compact(s["customers"]), ""),
+        ("Terminals", _compact(s["terminals"]), ""),
+        ("Score p99", f"{s['score_p99']:.3f}",
+         f"median {s['score_p50']:.3f}"),
+    ]
+    out = []
+    for label, value, sub in tiles:
+        subdiv = f"<div class='sub'>{_esc(sub)}</div>" if sub else ""
+        out.append(f"<div class='tile'><div class='lbl'>{_esc(label)}</div>"
+                   f"<div class='num'>{_esc(value)}</div>{subdiv}</div>")
+    return "<div class='tiles'>" + "".join(out) + "</div>"
+
+
+_CSS = """
+:root { color-scheme: light dark; }
+.viz {
+  --surface: #fcfcfb; --plane: #f9f9f7;
+  --ink: #0b0b0b; --ink2: #52514e; --muted: #898781;
+  --grid: #e1e0d9; --axis: #c3c2b7;
+  --s1: #2a78d6; --border: rgba(11,11,11,0.10);
+  font: 14px/1.45 system-ui, -apple-system, "Segoe UI", sans-serif;
+  color: var(--ink); background: var(--plane);
+  margin: 0; padding: 24px; min-height: 100vh; box-sizing: border-box;
+}
+@media (prefers-color-scheme: dark) { .viz {
+  --surface: #1a1a19; --plane: #0d0d0d;
+  --ink: #ffffff; --ink2: #c3c2b7;
+  --grid: #2c2c2a; --axis: #383835;
+  --s1: #3987e5; --border: rgba(255,255,255,0.10);
+}}
+.viz h1 { font-size: 20px; margin: 0 0 2px; }
+.viz .meta { color: var(--ink2); margin-bottom: 20px; }
+.tiles { display: flex; flex-wrap: wrap; gap: 12px; margin-bottom: 20px; }
+.tile { background: var(--surface); border: 1px solid var(--border);
+  border-radius: 8px; padding: 12px 16px; min-width: 132px; }
+.tile .lbl { color: var(--ink2); font-size: 12px; }
+.tile .num { font-size: 26px; font-weight: 600; }
+.tile .sub { color: var(--muted); font-size: 12px; }
+.cards { display: grid; gap: 16px;
+  grid-template-columns: repeat(auto-fit, minmax(360px, 1fr)); }
+.card { background: var(--surface); border: 1px solid var(--border);
+  border-radius: 8px; padding: 14px 16px; overflow: hidden; }
+.card h2 { font-size: 14px; font-weight: 600; margin: 0 0 10px; }
+.card svg { width: 100%; height: auto; display: block; }
+.grid { stroke: var(--grid); stroke-width: 1; }
+.axis { stroke: var(--axis); stroke-width: 1; }
+.line { fill: none; stroke: var(--s1); stroke-width: 2;
+  stroke-linejoin: round; stroke-linecap: round; }
+.wash { fill: var(--s1); opacity: 0.1; }
+.dot { fill: var(--s1); stroke: var(--surface); stroke-width: 2; }
+.bar { fill: var(--s1); }
+.tick, .lab, .val, .endlabel { font-size: 11px; fill: var(--muted); }
+.tick { font-variant-numeric: tabular-nums; }
+.lab { fill: var(--ink2); }
+.val, .endlabel { fill: var(--ink2); font-variant-numeric: tabular-nums; }
+.hit { fill: transparent; outline: none; }
+.hit:focus-visible { stroke: var(--s1); stroke-width: 1; }
+.empty { color: var(--muted); }
+.twin summary { color: var(--ink2); font-size: 12px; cursor: pointer;
+  margin-top: 8px; }
+.twin table { border-collapse: collapse; margin-top: 6px; width: 100%;
+  font-size: 12px; font-variant-numeric: tabular-nums; }
+.twin th, .twin td, .alerts th, .alerts td {
+  text-align: left; padding: 3px 10px 3px 0;
+  border-bottom: 1px solid var(--grid); }
+.twin th, .alerts th { color: var(--ink2); font-weight: 600; }
+.alerts table { border-collapse: collapse; width: 100%; font-size: 13px;
+  font-variant-numeric: tabular-nums; }
+#tip { position: fixed; display: none; pointer-events: none;
+  background: var(--ink); color: var(--surface); padding: 4px 8px;
+  border-radius: 4px; font-size: 12px; z-index: 10; max-width: 320px; }
+"""
+
+_JS = """
+var tip = document.getElementById('tip');
+function show(el, x, y) {
+  tip.textContent = el.getAttribute('data-tip');
+  tip.style.display = 'block';
+  var w = tip.offsetWidth, vw = window.innerWidth;
+  tip.style.left = Math.min(x + 12, vw - w - 8) + 'px';
+  tip.style.top = (y + 14) + 'px';
+}
+document.querySelectorAll('[data-tip]').forEach(function (el) {
+  el.addEventListener('mousemove', function (e) { show(el, e.clientX, e.clientY); });
+  el.addEventListener('mouseleave', function () { tip.style.display = 'none'; });
+  el.addEventListener('focus', function () {
+    var r = el.getBoundingClientRect(); show(el, r.left, r.top + r.height / 2);
+  });
+  el.addEventListener('blur', function () { tip.style.display = 'none'; });
+});
+"""
+
+
+def render_dashboard_html(
+    cols: Dict[str, np.ndarray],
+    *,
+    threshold: float = 0.5,
+    top_k: int = 10,
+    bucket: str = "day",
+    title: str = "Fraud detection — analyzed transactions",
+) -> str:
+    """Render the full dashboard for an analyzed column dict."""
+    s = summary_stats(cols, threshold)
+    n = s.get("transactions", 0)
+    gen = time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime())
+    parts = [
+        "<!doctype html><html><head><meta charset='utf-8'>",
+        f"<title>{_esc(title)}</title>",
+        "<meta name='viewport' content='width=device-width, initial-scale=1'>",
+        f"<style>{_CSS}</style></head><body class='viz'>",
+        f"<h1>{_esc(title)}</h1>",
+        f"<div class='meta'>generated {gen} · threshold "
+        f"{threshold:g} · bucket {_esc(bucket)}</div>",
+        _tiles(s),
+    ]
+    if n:
+        lab = _day_label if bucket == "day" else _hour_label
+        ts = fraud_rate_over_time(cols, bucket, threshold)
+        xs = [lab(u) for u in ts["bucket_start_us"]]
+        vol_twin = _table_twin(
+            (bucket, "transactions", "amount"),
+            [(xs[i], int(ts["transactions"][i]), f"{ts['amount'][i]:,.2f}")
+             for i in range(len(xs))])
+        rate_twin = _table_twin(
+            (bucket, "flagged", "flag rate"),
+            [(xs[i], int(ts["flagged"][i]),
+              f"{100 * ts['flag_rate'][i]:.2f}%")
+             for i in range(len(xs))])
+        term = top_risky_terminals(cols, top_k, threshold)
+        cust = top_risky_customers(cols, top_k, threshold)
+        alerts = recent_alerts(cols, threshold, limit=top_k)
+        alert_rows = "".join(
+            "<tr>"
+            f"<td>{int(alerts['tx_id'][i])}</td>"
+            f"<td>{_esc(_hour_label(alerts['tx_datetime_us'][i]))}</td>"
+            f"<td>{int(alerts['customer_id'][i])}</td>"
+            f"<td>{int(alerts['terminal_id'][i])}</td>"
+            f"<td>{alerts['tx_amount'][i]:,.2f}</td>"
+            f"<td>{alerts['prediction'][i]:.3f}</td></tr>"
+            for i in range(len(alerts["tx_id"]))
+        ) or "<tr><td colspan='6'>none</td></tr>"
+        parts += [
+            "<div class='cards'>",
+            "<div class='card'><h2>Transactions per "
+            f"{_esc(bucket)}</h2>",
+            _line_chart(xs, ts["transactions"].astype(np.float64)),
+            vol_twin, "</div>",
+            "<div class='card'><h2>Flag rate per "
+            f"{_esc(bucket)}</h2>",
+            _line_chart(xs, ts["flag_rate"], percent=True),
+            rate_twin, "</div>",
+            "<div class='card'><h2>Top risky terminals "
+            "(mean score)</h2>",
+            _hbar_chart([str(t) for t in term["terminal_id"]],
+                        term["mean_score"], term["transactions"],
+                        key_name="terminal"),
+            _table_twin(("terminal", "txs", "mean score", "flagged",
+                         "amount"),
+                        [(int(term["terminal_id"][i]),
+                          int(term["transactions"][i]),
+                          f"{term['mean_score'][i]:.3f}",
+                          int(term["flagged"][i]),
+                          f"{term['amount'][i]:,.2f}")
+                         for i in range(len(term["terminal_id"]))]),
+            "</div>",
+            "<div class='card'><h2>Top risky customers "
+            "(mean score)</h2>",
+            _hbar_chart([str(c) for c in cust["customer_id"]],
+                        cust["mean_score"], cust["transactions"],
+                        key_name="customer"),
+            _table_twin(("customer", "txs", "mean score", "flagged",
+                         "amount"),
+                        [(int(cust["customer_id"][i]),
+                          int(cust["transactions"][i]),
+                          f"{cust['mean_score'][i]:.3f}",
+                          int(cust["flagged"][i]),
+                          f"{cust['amount'][i]:,.2f}")
+                         for i in range(len(cust["customer_id"]))]),
+            "</div>",
+            "<div class='card alerts'><h2>Recent alerts</h2>",
+            "<table><thead><tr><th>tx</th><th>time</th><th>customer</th>"
+            "<th>terminal</th><th>amount</th><th>score</th></tr></thead>"
+            f"<tbody>{alert_rows}</tbody></table></div>",
+            "</div>",
+        ]
+    parts += [f"<div id='tip'></div><script>{_JS}</script></body></html>"]
+    return "".join(parts)
+
+
+def write_dashboard(
+    analyzed_dir: str,
+    out_path: str,
+    *,
+    threshold: float = 0.5,
+    top_k: int = 10,
+    bucket: str = "day",
+    title: Optional[str] = None,
+) -> dict:
+    """Load an analyzed output directory and write the dashboard HTML.
+
+    Returns a small manifest (path, transaction count) for CLI printing.
+    """
+    cols = load_analyzed(analyzed_dir)
+    htm = render_dashboard_html(
+        cols, threshold=threshold, top_k=top_k, bucket=bucket,
+        title=title or "Fraud detection — analyzed transactions")
+    with open(out_path, "w", encoding="utf-8") as f:
+        f.write(htm)
+    return {
+        "dashboard": out_path,
+        "transactions": int(len(cols.get("tx_id", ()))),
+        "bytes": len(htm.encode()),
+    }

@@ -1,0 +1,146 @@
+"""Static-HTML dashboard (the Superset role, SURVEY §1/L5).
+
+The reference's dashboard is Superset over Trino over
+``analyzed_transactions`` (``superset/entrypoint.sh:19``); here the same
+canned views render into one self-contained HTML file.
+"""
+
+import numpy as np
+import pytest
+
+from real_time_fraud_detection_system_tpu.io.dashboard import (
+    _compact,
+    _nice_max,
+    render_dashboard_html,
+    write_dashboard,
+)
+
+_US_HOUR = 3_600_000_000
+
+
+@pytest.fixture(scope="module")
+def analyzed():
+    return {
+        "tx_id": np.arange(8, dtype=np.int64),
+        "tx_datetime_us": np.array(
+            [0, 1, 1, 2, 2, 2, 2, 2], dtype=np.int64) * _US_HOUR,
+        "customer_id": np.array([1, 1, 2, 2, 3, 3, 3, 4], dtype=np.int64),
+        "terminal_id": np.array([10, 10, 20, 20, 20, 20, 10, 10],
+                                dtype=np.int64),
+        "tx_amount": np.array([10.0, 20, 30, 40, 50, 60, 70, 80]),
+        "prediction": np.array([0.1, 0.2, 0.9, 0.8, 0.7, 0.95, 0.1, 0.3]),
+    }
+
+
+def test_value_formatting():
+    assert _compact(1284) == "1,284"
+    assert _compact(12_900) == "12.9K"
+    assert _compact(4_200_000, money=True) == "$4.2M"
+    assert _compact(12.5, money=True) == "$12.50"
+    assert _nice_max(7.3) == 10.0
+    assert _nice_max(1800) == 2000.0
+    assert _nice_max(0.42) == 0.5
+
+
+def test_render_full(analyzed):
+    htm = render_dashboard_html(analyzed, bucket="hour")
+    # stat tiles
+    for label in ("Transactions", "Flagged", "Flagged amount",
+                  "Score p99"):
+        assert label in htm
+    # every chart card present
+    for h2 in ("Transactions per hour", "Flag rate per hour",
+               "Top risky terminals", "Top risky customers",
+               "Recent alerts"):
+        assert h2 in htm
+    # single-series charts: no legend box anywhere
+    assert "legend" not in htm.lower()
+    # hover layer + table-view twins (values never tooltip-gated)
+    assert "data-tip" in htm
+    assert htm.count("Table view") >= 3
+    # dark-mode theming is selected, not auto-flipped
+    assert "prefers-color-scheme: dark" in htm
+    # the hot terminal (20) appears in the bar chart rows
+    assert "terminal 20" in htm
+
+
+def test_render_is_wellformed_xml(analyzed):
+    """The SVG/HTML must parse — catches unescaped labels and broken
+    markup."""
+    import xml.etree.ElementTree as ET
+
+    htm = render_dashboard_html(
+        analyzed, title="<script>alert('x&y')</script>")
+    # title is escaped, not executed
+    assert "<script>alert" not in htm
+    assert "&lt;script&gt;" in htm
+    # every svg island parses standalone
+    start = 0
+    n_svg = 0
+    while True:
+        i = htm.find("<svg", start)
+        if i < 0:
+            break
+        j = htm.index("</svg>", i) + len("</svg>")
+        ET.fromstring(htm[i:j])
+        n_svg += 1
+        start = j
+    assert n_svg >= 4  # 2 time series + 2 bar charts
+
+
+def test_render_empty():
+    htm = render_dashboard_html({})
+    assert "no analyzed transactions" in htm
+    assert "<svg" not in htm
+
+
+def test_write_dashboard_roundtrip(analyzed, tmp_path):
+    """End-to-end through ParquetSink output on disk."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    adir = tmp_path / "analyzed"
+    adir.mkdir()
+    pq.write_table(
+        pa.table({k: v for k, v in analyzed.items()}),
+        adir / "part-000.parquet")
+    out = tmp_path / "dash.html"
+    manifest = write_dashboard(str(adir), str(out), bucket="hour")
+    assert manifest["transactions"] == 8
+    htm = out.read_text()
+    assert "Top risky terminals" in htm
+
+
+def test_cli_dashboard(analyzed, tmp_path, capsys):
+    import json
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from real_time_fraud_detection_system_tpu.cli import main
+
+    adir = tmp_path / "analyzed"
+    adir.mkdir()
+    pq.write_table(pa.table(dict(analyzed)), adir / "part-000.parquet")
+    out = tmp_path / "d.html"
+    rc = main(["--platform", "cpu", "dashboard", "--data", str(adir),
+               "--out", str(out), "--bucket", "hour"])
+    assert rc == 0
+    manifest = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert manifest["transactions"] == 8
+    assert out.exists()
+
+
+def test_cli_dashboard_missing_dir(tmp_path, capsys):
+    """A bad --data path gets the structured JSON error, not a traceback
+    (same contract as cmd_query's transactions report)."""
+    import json
+
+    from real_time_fraud_detection_system_tpu.cli import main
+
+    rc = main(["--platform", "cpu", "dashboard",
+               "--data", str(tmp_path / "nope"),
+               "--out", str(tmp_path / "d.html")])
+    assert rc == 2
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "error" in out
