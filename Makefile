@@ -42,6 +42,9 @@ run-all: datagen train score
 query:
 	$(CLI) query --data $(OUT)/analyzed --report summary
 
+dashboard:
+	$(CLI) dashboard --data $(OUT)/analyzed --out $(OUT)/dashboard.html
+
 bench:
 	$(PY) bench.py
 
@@ -54,4 +57,4 @@ install:
 clean:
 	rm -rf $(OUT)
 
-.PHONY: demo datagen train score run-all query bench test install clean
+.PHONY: demo datagen train score run-all query dashboard bench test install clean

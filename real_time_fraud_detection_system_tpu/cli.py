@@ -359,6 +359,21 @@ def cmd_demo(args) -> int:
         batch_rows=args.batch_rows,
         n_devices=args.devices,
     )
+    if args.out:
+        # Close the loop the way the reference demo does — README.md:31-43
+        # ends at the Superset dashboard; here it ends at the static one.
+        # A dashboard failure must not discard the already-computed summary.
+        from real_time_fraud_detection_system_tpu.io.dashboard import (
+            write_dashboard,
+        )
+
+        try:
+            dash = write_dashboard(
+                args.out, os.path.join(args.out, "dashboard.html"))
+            summary["dashboard"] = dash["dashboard"]
+        except OSError as e:
+            log.warning("dashboard render failed: %s", e)
+            summary["dashboard_error"] = str(e)
     print(_json_line(summary))
     return 0
 

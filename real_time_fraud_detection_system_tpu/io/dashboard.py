@@ -96,6 +96,13 @@ def _hour_label(us: int) -> str:
             f"{t.tm_hour:02d}:00")
 
 
+def _ts_label(us: int) -> str:
+    """Full minute-resolution timestamp (alert rows, not bucket labels)."""
+    t = time.gmtime(int(us) // _US)
+    return (f"{t.tm_year:04d}-{t.tm_mon:02d}-{t.tm_mday:02d} "
+            f"{t.tm_hour:02d}:{t.tm_min:02d}")
+
+
 def _table_twin(headers: Sequence[str], rows: Sequence[Sequence[str]]) -> str:
     """The <details> table view — the WCAG-clean twin of every chart."""
     head = "".join(f"<th>{_esc(h)}</th>" for h in headers)
@@ -158,6 +165,8 @@ def _line_chart(
     area = (f"{_PAD_L + pw * 0.5 / n:.1f},{_PAD_T + ph} {pts} "
             f"{px(n - 1):.1f},{_PAD_T + ph}")
     ex, ey = px(n - 1), py(ys[n - 1])
+    # keep the one direct label inside the plot even at the axis maximum
+    label_y = max(ey - 8.0, _PAD_T + 10.0)
     # Full-band transparent hit columns: targets far bigger than the mark.
     hits = "".join(
         f"<rect class='hit' x='{_PAD_L + pw * i / n:.1f}' y='{_PAD_T}' "
@@ -173,7 +182,7 @@ def _line_chart(
 <polygon class='wash' points='{area}'/>
 <polyline class='line' points='{pts}'/>
 <circle class='dot' cx='{ex:.1f}' cy='{ey:.1f}' r='4'/>
-<text class='endlabel' x='{ex - 6:.1f}' y='{ey - 8:.1f}' text-anchor='end'>{_esc(fmt(ys[-1]))}</text>
+<text class='endlabel' x='{ex - 6:.1f}' y='{label_y:.1f}' text-anchor='end'>{_esc(fmt(ys[-1]))}</text>
 <text class='tick' x='{_PAD_L}' y='{_H - 6}'>{x_first}</text>
 <text class='tick' x='{_W - _PAD_R}' y='{_H - 6}' text-anchor='end'>{x_last}</text>
 {hits}
@@ -356,13 +365,27 @@ def render_dashboard_html(
             [(xs[i], int(ts["flagged"][i]),
               f"{100 * ts['flag_rate'][i]:.2f}%")
              for i in range(len(xs))])
+        def top_card(title: str, key_name: str, top: dict) -> str:
+            key_col = f"{key_name}_id"
+            chart = _hbar_chart([str(k) for k in top[key_col]],
+                                top["mean_score"], top["transactions"],
+                                key_name=key_name)
+            twin = _table_twin(
+                (key_name, "txs", "mean score", "flagged", "amount"),
+                [(int(top[key_col][i]), int(top["transactions"][i]),
+                  f"{top['mean_score'][i]:.3f}", int(top["flagged"][i]),
+                  f"{top['amount'][i]:,.2f}")
+                 for i in range(len(top[key_col]))])
+            return (f"<div class='card'><h2>{_esc(title)}</h2>"
+                    f"{chart}{twin}</div>")
+
         term = top_risky_terminals(cols, top_k, threshold)
         cust = top_risky_customers(cols, top_k, threshold)
         alerts = recent_alerts(cols, threshold, limit=top_k)
         alert_rows = "".join(
             "<tr>"
             f"<td>{int(alerts['tx_id'][i])}</td>"
-            f"<td>{_esc(_hour_label(alerts['tx_datetime_us'][i]))}</td>"
+            f"<td>{_esc(_ts_label(alerts['tx_datetime_us'][i]))}</td>"
             f"<td>{int(alerts['customer_id'][i])}</td>"
             f"<td>{int(alerts['terminal_id'][i])}</td>"
             f"<td>{alerts['tx_amount'][i]:,.2f}</td>"
@@ -379,34 +402,8 @@ def render_dashboard_html(
             f"{_esc(bucket)}</h2>",
             _line_chart(xs, ts["flag_rate"], percent=True),
             rate_twin, "</div>",
-            "<div class='card'><h2>Top risky terminals "
-            "(mean score)</h2>",
-            _hbar_chart([str(t) for t in term["terminal_id"]],
-                        term["mean_score"], term["transactions"],
-                        key_name="terminal"),
-            _table_twin(("terminal", "txs", "mean score", "flagged",
-                         "amount"),
-                        [(int(term["terminal_id"][i]),
-                          int(term["transactions"][i]),
-                          f"{term['mean_score'][i]:.3f}",
-                          int(term["flagged"][i]),
-                          f"{term['amount'][i]:,.2f}")
-                         for i in range(len(term["terminal_id"]))]),
-            "</div>",
-            "<div class='card'><h2>Top risky customers "
-            "(mean score)</h2>",
-            _hbar_chart([str(c) for c in cust["customer_id"]],
-                        cust["mean_score"], cust["transactions"],
-                        key_name="customer"),
-            _table_twin(("customer", "txs", "mean score", "flagged",
-                         "amount"),
-                        [(int(cust["customer_id"][i]),
-                          int(cust["transactions"][i]),
-                          f"{cust['mean_score'][i]:.3f}",
-                          int(cust["flagged"][i]),
-                          f"{cust['amount'][i]:,.2f}")
-                         for i in range(len(cust["customer_id"]))]),
-            "</div>",
+            top_card("Top risky terminals (mean score)", "terminal", term),
+            top_card("Top risky customers (mean score)", "customer", cust),
             "<div class='card alerts'><h2>Recent alerts</h2>",
             "<table><thead><tr><th>tx</th><th>time</th><th>customer</th>"
             "<th>terminal</th><th>amount</th><th>score</th></tr></thead>"

@@ -131,6 +131,25 @@ def test_cli_dashboard(analyzed, tmp_path, capsys):
     assert out.exists()
 
 
+def test_cli_demo_emits_dashboard(tmp_path, capsys):
+    """`rtfds demo --out D` ends at the dashboard, the way the reference
+    demo ends at Superset (README.md:31-43)."""
+    import json
+
+    from real_time_fraud_detection_system_tpu.cli import main
+
+    out = tmp_path / "demo_out"
+    rc = main(["--platform", "cpu", "demo", "--customers", "30",
+               "--terminals", "60", "--days", "14", "--model", "logreg",
+               "--delta-train", "6", "--delta-delay", "2",
+               "--delta-test", "3", "--out", str(out)])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["dashboard"].endswith("dashboard.html")
+    htm = (out / "dashboard.html").read_text()
+    assert "Top risky terminals" in htm
+
+
 def test_cli_dashboard_missing_dir(tmp_path, capsys):
     """A bad --data path gets the structured JSON error, not a traceback
     (same contract as cmd_query's transactions report)."""
