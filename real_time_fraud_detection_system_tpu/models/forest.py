@@ -223,7 +223,9 @@ def to_gemm(ens: TreeEnsemble, n_features: int) -> GemmEnsemble:
     )
 
 
-def gemm_leaf_sum(g: GemmEnsemble, x: jnp.ndarray) -> jnp.ndarray:
+def gemm_leaf_sum(
+    g: GemmEnsemble, x: jnp.ndarray, z_mode: str | None = None
+) -> jnp.ndarray:
     """[B, F] → Σ_t leaf value [B] via three contractions (MXU formulation).
 
     Sum-reduction shared by bagging (÷ n_trees) and boosting (+ base logit).
@@ -234,31 +236,52 @@ def gemm_leaf_sum(g: GemmEnsemble, x: jnp.ndarray) -> jnp.ndarray:
     - proj MUST be f32 HIGHEST: the decision ``proj <= thresh`` flips for
       inputs near thresholds under any bf16-pass scheme (measured: HIGH
       flips ~1% of decisions on threshold-valued inputs);
-    - the dominant z contraction runs in bf16 with f32 accumulation: d is
-      0/1 and path is ±1/0 — both exact in bf16 — and z counts ≤ depth·1,
-      integers far below 2^8, so every partial product and the f32
-      accumulation are exact. ~15% faster end-to-end on v5e, bigger at
-      large B;
+    - the dominant z contraction is exact in EVERY reduced-precision mode
+      because its operands are tiny integers: d is 0/1, path is ±1/0, and
+      z counts ≤ depth. ``z_mode`` selects the arithmetic:
+        * ``"bf16"`` — bf16×bf16→f32 (integers ≪ 2^8 are bf16-exact);
+          ~15% faster than f32 end-to-end on v5e. TPU default.
+        * ``"int8"`` — int8×int8→int32 on the MXU's int8 path (2× bf16
+          peak on v5e); ``target`` compares exactly in int32, with the
+          1e9 leaf padding still unmatched.
+        * ``"f32"`` — plain f32; the only float mode CPU XLA lowers
+          (no BF16×BF16→F32 dot thunk there, so ``"bf16"`` silently
+          degrades to f32 off-TPU — same values by construction).
+          CPU default.
     - the leaf gather keeps leaf_val in f32 (probabilities are not
       bf16-exact; onehot is 0/1 so f32 HIGHEST here is exact and cheap —
       L ≪ I·L work).
     """
     hi = jax.lax.Precision.HIGHEST
-    # CPU XLA has no BF16×BF16→F32 dot; the cast only pays off on the MXU.
-    zdt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    if z_mode is None:
+        z_mode = "bf16" if jax.default_backend() == "tpu" else "f32"
+    if z_mode not in ("bf16", "int8", "f32"):
+        raise ValueError(f"unknown z_mode {z_mode!r}")
     proj = jnp.einsum("bf,tfi->bti", x, g.sel, precision=hi)
-    d = (proj <= g.thresh[None]).astype(zdt)
-    z = jnp.einsum(
-        "bti,til->btl", d, g.path.astype(zdt),
-        preferred_element_type=jnp.float32,
-    )
-    onehot = (jnp.abs(z - g.target[None]) < 0.5).astype(jnp.float32)
+    if z_mode == "int8":
+        d = (proj <= g.thresh[None]).astype(jnp.int8)
+        z = jnp.einsum(
+            "bti,til->btl", d, g.path.astype(jnp.int8),
+            preferred_element_type=jnp.int32,
+        )
+        onehot = (z == g.target.astype(jnp.int32)[None]).astype(jnp.float32)
+    else:
+        on_tpu = jax.default_backend() == "tpu"
+        zdt = jnp.bfloat16 if (z_mode == "bf16" and on_tpu) else jnp.float32
+        d = (proj <= g.thresh[None]).astype(zdt)
+        z = jnp.einsum(
+            "bti,til->btl", d, g.path.astype(zdt),
+            preferred_element_type=jnp.float32,
+        )
+        onehot = (jnp.abs(z - g.target[None]) < 0.5).astype(jnp.float32)
     return jnp.einsum("btl,tl->b", onehot, g.leaf_val, precision=hi)
 
 
-def gemm_predict_proba(g: GemmEnsemble, x: jnp.ndarray) -> jnp.ndarray:
+def gemm_predict_proba(
+    g: GemmEnsemble, x: jnp.ndarray, z_mode: str | None = None
+) -> jnp.ndarray:
     """[B, F] → probability [B] (bagging mean over trees)."""
-    return gemm_leaf_sum(g, x) / g.n_trees
+    return gemm_leaf_sum(g, x, z_mode) / g.n_trees
 
 
 def predict_proba(params, x: jnp.ndarray) -> jnp.ndarray:

@@ -68,6 +68,20 @@ def test_forest_gemm_exactly_matches_sklearn(xy):
     g = to_gemm(ens, x.shape[1])
     ours_gemm = np.asarray(gemm_predict_proba(g, jnp.asarray(x32)))
     np.testing.assert_allclose(ours_gemm, ours, atol=1e-5)
+    # every z-contraction arithmetic mode is decision-exact (operands are
+    # tiny integers in all of them) — including threshold-sitting inputs.
+    # Off-TPU the "bf16" mode degrades to f32 (no bf16 dot on CPU XLA),
+    # so here its assert only pins the dispatch; the real bf16-vs-f32 and
+    # int8-on-MXU exactness evidence is tools/hw_parity_check.py on the
+    # TPU backend.
+    x_thr = np.asarray(g.thresh).ravel()
+    x_thr = x_thr[np.isfinite(x_thr)][:64]
+    probe = np.concatenate(
+        [x32, np.tile(x_thr[:, None], (1, x.shape[1])).astype(np.float32)])
+    base = np.asarray(gemm_predict_proba(g, jnp.asarray(probe), "f32"))
+    for mode in ("bf16", "int8"):
+        alt = np.asarray(gemm_predict_proba(g, jnp.asarray(probe), mode))
+        np.testing.assert_array_equal(alt, base, err_msg=mode)
 
 
 def test_decision_tree_depth2(xy):

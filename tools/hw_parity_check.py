@@ -119,6 +119,15 @@ def main() -> None:
         np.max(np.abs(p_desc - oracle)))
     ok &= results["forest_gemm_max_abs_diff"] < 1e-5
     ok &= results["forest_descent_max_abs_diff"] < 1e-5
+    _note("forest int8-z compile+run")
+    p_i8 = np.asarray(jax.jit(
+        lambda g_, x_: gemm_predict_proba(g_, x_, "int8"))(
+            gemm, jnp.asarray(xte)))
+    # int8 z must make the SAME decisions as the default mode bit-for-bit
+    # (both are exact integer arithmetic on the MXU's int8/bf16 paths)
+    results["forest_int8z_max_abs_diff_vs_default"] = float(
+        np.max(np.abs(p_i8 - p_gemm)))
+    ok &= results["forest_int8z_max_abs_diff_vs_default"] == 0.0
 
     from real_time_fraud_detection_system_tpu.models.logreg import (
         init_logreg,

@@ -20,7 +20,7 @@ while :; do
       echo "hw_watch: parity gate PASSED -> $OUT"
       cat "$OUT"
       echo "hw_watch: racing forest-kernel variants (tools/tpu_step_profile.py)"
-      timeout 1800 env PROFILE_ROWS=262144 python tools/tpu_step_profile.py \
+      timeout 1800 env PROFILE_ROWS=${PROFILE_ROWS:-65536} python tools/tpu_step_profile.py \
         > PROFILE_r03.json 2>> "$OUT.log" \
         && { echo "hw_watch: profile -> PROFILE_r03.json"; cat PROFILE_r03.json; } \
         || echo "hw_watch: profile attempt failed (rc=$?)"

@@ -67,7 +67,9 @@ def main() -> None:
     T, F, I = (int(s) for s in g.sel.shape)
     L = int(g.path.shape[2])
 
-    B = int(os.environ.get("PROFILE_ROWS", "262144"))
+    # 262144 RESOURCE_EXHAUSTs a v5e when all five raced variants hold
+    # their buffers at once (observed 2026-07-30); 65536 fits.
+    B = int(os.environ.get("PROFILE_ROWS", "65536"))
     x = jnp.asarray(rng.normal(0, 1, (B, 15)).astype(np.float32))
     xh = np.asarray(x)
     oracle = skl.predict_proba(xh)[:, 1]
@@ -122,18 +124,10 @@ def main() -> None:
         onehot = (jnp.abs(z - g.target[None]) < 0.5).astype(jnp.float32)
         return stage_leaf(onehot) / T
 
-    path_i8 = g.path.astype(jnp.int8)
-    target_i32 = g.target.astype(jnp.int32)
-
     def kernel_int8z(x):
-        proj = jnp.einsum("bf,tfi->bti", x, g.sel, precision=hi)
-        d = (proj <= g.thresh[None]).astype(jnp.int8)
-        z = jnp.einsum("bti,til->btl", d, path_i8,
-                       preferred_element_type=jnp.int32)
-        # target is an exact small integer for real leaves and 1e9 for
-        # padding — the int32 cast keeps padded leaves unmatched.
-        onehot = (z == target_i32[None]).astype(jnp.float32)
-        return stage_leaf(onehot) / T
+        # the SHIPPED int8 kernel (forest.gemm_leaf_sum z_mode="int8"),
+        # not a hand-rolled copy — the race must time what serving runs
+        return gemm_predict_proba(g, x, "int8")
 
     def bench(fn, *args, iters=20):
         if not on_tpu:
