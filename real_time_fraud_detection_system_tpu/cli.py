@@ -143,7 +143,9 @@ def cmd_score(args) -> int:
     if args.source != "kafka" and not args.data:
         log.error("--data is required unless --source kafka")
         return 2
-    txs = load_transactions(args.data) if args.data else None
+    # replay reads a generated .npz; raw-table reads a table DIRECTORY
+    txs = (load_transactions(args.data)
+           if args.data and args.source == "replay" else None)
     model = load_model(args.model_file)
     cfg = Config()
     cpu_model = None
@@ -222,6 +224,22 @@ def cmd_score(args) -> int:
             )
 
         source = source_factory()
+    elif args.source == "raw-table":
+        from real_time_fraud_detection_system_tpu.runtime.sources import (
+            RawTableSource,
+        )
+
+        try:
+            source = RawTableSource(
+                args.data,
+                batch_rows=args.batch_rows,
+                from_day=args.from_date or None,
+                to_day=args.to_date or None,
+            )
+        except (FileNotFoundError, ValueError) as e:
+            log.error("%s", e)
+            return 2
+        log.info("raw-table backfill: %d rows", source.n)
     else:
         source = ReplaySource(
             txs,
@@ -627,9 +645,18 @@ def main(argv=None) -> int:
     p.add_argument("--model-file", required=True)
     p.add_argument("--scorer", default="tpu", choices=["cpu", "tpu"])
     p.add_argument("--mode", default="columnar", choices=["columnar", "envelope"])
-    p.add_argument("--source", default="replay", choices=["replay", "kafka"],
-                   help="replay a generated table, or consume the Debezium "
-                        "transaction topic from a real Kafka cluster")
+    p.add_argument("--source", default="replay",
+                   choices=["replay", "kafka", "raw-table"],
+                   help="replay a generated table (.npz), consume the "
+                        "Debezium transaction topic from a real Kafka "
+                        "cluster, or backfill from a persistent raw-"
+                        "transactions table directory (--data <dir>, the "
+                        "reference's stream-read of nessie.payment."
+                        "transactions history)")
+    p.add_argument("--from-date", default="",
+                   help="raw-table backfill start day (YYYY-MM-DD, incl.)")
+    p.add_argument("--to-date", default="",
+                   help="raw-table backfill end day (YYYY-MM-DD, incl.)")
     p.add_argument("--bootstrap", default="localhost:9092",
                    help="Kafka bootstrap servers (--source kafka)")
     p.add_argument("--topic", default="debezium.payment.transactions")

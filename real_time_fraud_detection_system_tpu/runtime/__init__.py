@@ -1,6 +1,7 @@
 from real_time_fraud_detection_system_tpu.runtime.sources import (  # noqa: F401
     InProcBroker,
     KafkaSource,
+    RawTableSource,
     ReplaySource,
     SyntheticSource,
     make_kafka_source,

@@ -222,6 +222,96 @@ class SyntheticSource:
         self._replay.seek(offsets)
 
 
+class RawTableSource:
+    """Stream the persistent raw-transactions table back through the
+    engine — backfill / re-score-after-retrain.
+
+    The reference's scorer stream-reads the Iceberg transactions table,
+    history included (``fraud_detection.py:91-93``:
+    ``readStream.format("iceberg").load("nessie.payment.transactions")``),
+    so re-running it after retraining re-scores everything already
+    landed. This source gives the framework the same workflow over its
+    own day-partitioned Parquet table (:class:`~.io.tables.
+    RawTransactionsTable`).
+
+    The table snapshot is loaded once at construction (latest-wins
+    across parts), sorted into temporal order — window features require
+    time-ordered ingestion — optionally restricted to
+    ``[from_day, to_day]`` (inclusive ``YYYY-MM-DD`` strings), then
+    served as ``batch_rows`` micro-batches behind the standard
+    ``poll_batch``/``offsets``/``seek`` protocol. Rows written to the
+    table after construction are not seen (snapshot isolation, matching
+    the read_all contract).
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        batch_rows: int = 4096,
+        from_day: Optional[str] = None,
+        to_day: Optional[str] = None,
+    ):
+        from real_time_fraud_detection_system_tpu.io.tables import (
+            RawTransactionsTable,
+        )
+
+        cols = RawTransactionsTable(directory).read_all()
+        if not cols:
+            raise FileNotFoundError(
+                f"no raw-transactions partitions under {directory!r} "
+                "(expected tx_date=*/part-*.parquet)"
+            )
+        if from_day or to_day:
+            from real_time_fraud_detection_system_tpu.core.batch import (
+                US_PER_DAY,
+            )
+            from real_time_fraud_detection_system_tpu.utils.timing import (
+                date_to_epoch_s,
+            )
+
+            def _day_num(s: str) -> int:
+                try:
+                    return date_to_epoch_s(s) // 86400
+                except ValueError as e:
+                    raise ValueError(
+                        f"bad day filter {s!r} (want YYYY-MM-DD): {e}"
+                    ) from None
+
+            days = cols["tx_datetime_us"] // US_PER_DAY
+            keep = np.ones(len(days), dtype=bool)
+            if from_day:
+                keep &= days >= _day_num(from_day)
+            if to_day:
+                keep &= days <= _day_num(to_day)
+            cols = {k: v[keep] for k, v in cols.items()}
+        order = np.lexsort((cols["tx_id"], cols["tx_datetime_us"]))
+        self._cols = {k: np.ascontiguousarray(v[order])
+                      for k, v in cols.items()}
+        self.batch_rows = batch_rows
+        self._pos = 0
+
+    @property
+    def n(self) -> int:
+        return len(self._cols["tx_id"])
+
+    def poll_batch(self) -> Optional[dict]:
+        if self._pos >= self.n:
+            return None
+        s, e = self._pos, min(self._pos + self.batch_rows, self.n)
+        self._pos = e
+        out = {k: v[s:e] for k, v in self._cols.items()}
+        # replayed history: event time doubles as the transport timestamp
+        out["kafka_ts_ms"] = out["tx_datetime_us"] // 1000
+        return out
+
+    @property
+    def offsets(self) -> List[int]:
+        return [self._pos]
+
+    def seek(self, offsets: Sequence[int]) -> None:
+        self._pos = int(offsets[0])
+
+
 def raise_for_kafka_error(ck, err) -> bool:
     """Shared poll-error policy for all Kafka consumers in this runtime.
 
