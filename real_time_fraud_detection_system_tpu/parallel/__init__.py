@@ -12,3 +12,10 @@ from real_time_fraud_detection_system_tpu.parallel.distributed import (  # noqa:
     mesh_axes,
     process_local_batch_slice,
 )
+from real_time_fraud_detection_system_tpu.parallel.tensor_parallel import (  # noqa: F401
+    make_tp_mlp,
+    make_tp_step,
+)
+from real_time_fraud_detection_system_tpu.parallel.pipeline_parallel import (  # noqa: F401
+    make_pipeline,
+)

@@ -1,0 +1,142 @@
+"""Pipeline parallelism: GPipe-style microbatch pipeline over ppermute.
+
+Stages of a deep residual scorer live on different devices; microbatches
+flow stage→stage over ICI with ``lax.ppermute`` while every stage
+computes in parallel — the classic bubble-amortized schedule
+(fill S-1 ticks, steady state, drain S-1 ticks).
+
+The reference has nothing this deep (its dormant MLP is 2 layers), but a
+framework claiming the reference's scale on TPU must place models deeper
+than one chip; this is the canonical TPU idiom for it. The demo model is
+a stack of S uniform residual blocks (``init_stack``) whose parameters
+are stacked on a leading stage axis and sharded over the mesh, plus a
+replicated input/output head applied outside the pipeline.
+
+SPMD mechanics (all devices run the same program under ``shard_map``):
+
+- tick t: stage 0 *injects* microbatch t (if any left), every stage
+  applies its block to the activation it holds, stage S-1 *emits* its
+  result into the output buffer at slot t-(S-1);
+- between ticks, activations rotate one hop with ``ppermute`` (the ICI
+  neighbor exchange);
+- after S-1+M ticks the output buffer on the last stage holds all M
+  microbatches; one ``psum`` broadcasts it (every other stage holds
+  zeros).
+
+Exactness: each microbatch passes through stages 0..S-1 in order, so the
+pipelined result equals the sequential stack application bit-for-bit —
+pinned by ``tests/test_tensor_pipeline.py``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class StackParams(NamedTuple):
+    """S uniform residual blocks, stacked on the leading (stage) axis."""
+
+    w1: jnp.ndarray  # [S, H, H]
+    b1: jnp.ndarray  # [S, H]
+    w2: jnp.ndarray  # [S, H, H]
+    b2: jnp.ndarray  # [S, H]
+
+
+def init_stack(width: int, n_stages: int, seed: int = 0) -> StackParams:
+    key = jax.random.PRNGKey(seed)
+    ks = jax.random.split(key, 2)
+    scale = np.sqrt(2.0 / width)
+    w1 = scale * jax.random.normal(
+        ks[0], (n_stages, width, width), dtype=jnp.float32)
+    w2 = scale * jax.random.normal(
+        ks[1], (n_stages, width, width), dtype=jnp.float32)
+    z = jnp.zeros((n_stages, width), dtype=jnp.float32)
+    return StackParams(w1=w1, b1=z, w2=w2, b2=z)
+
+
+def block_apply(p: StackParams, s, h: jnp.ndarray) -> jnp.ndarray:
+    """One residual block (params of stage ``s``): h + W2·relu(W1·h)."""
+    inner = jax.nn.relu(h @ p.w1[s] + p.b1[s])
+    return h + inner @ p.w2[s] + p.b2[s]
+
+
+def stack_apply(p: StackParams, h: jnp.ndarray) -> jnp.ndarray:
+    """Sequential reference: apply all S blocks in order (single device)."""
+    for s in range(p.w1.shape[0]):
+        h = block_apply(p, s, h)
+    return h
+
+
+def make_pipeline(
+    mesh: Mesh,
+    params: StackParams,
+    n_micro: int,
+    axis: Optional[str] = None,
+):
+    """→ (sharded_params, run(params, x) → y) with stages sharded over
+    ``axis`` and ``x [B, H]`` split into ``n_micro`` microbatches.
+
+    ``B`` must divide evenly by ``n_micro``; stage count must equal the
+    axis size (one stage per device — the deployment shape; several
+    blocks per device just means a deeper ``block_apply``).
+    """
+    from real_time_fraud_detection_system_tpu.parallel.mesh import (
+        compat_shard_map,
+    )
+
+    axis = axis or mesh.axis_names[0]
+    n_dev = mesh.shape[axis]
+    s_total = params.w1.shape[0]
+    if s_total != n_dev:
+        raise ValueError(
+            f"{s_total} stages on a {n_dev}-device '{axis}' axis "
+            "(want exactly one stage per device)"
+        )
+    spec = P(axis)  # stage-stacked leaves shard on their leading axis
+    sharded = jax.tree.map(
+        lambda a: jax.device_put(a, NamedSharding(mesh, spec)), params)
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    def run(p, x):
+        stage = jax.lax.axis_index(axis)
+        b, h_dim = x.shape
+        if b % n_micro:
+            raise ValueError(f"batch {b} not divisible by {n_micro}")
+        m_rows = b // n_micro
+        mb = x.reshape(n_micro, m_rows, h_dim)
+        outs0 = jnp.zeros_like(mb)
+        h0 = jnp.zeros((m_rows, h_dim), x.dtype)
+
+        def tick(t, carry):
+            h_cur, outs = carry
+            # stage 0 injects microbatch t (clamped once the feed drains)
+            inject = mb[jnp.minimum(t, n_micro - 1)]
+            h_in = jnp.where(stage == 0, inject, h_cur)
+            h_out = block_apply(p, 0, h_in)  # local shard: stage axis len 1
+            # last stage emits into slot t-(S-1) while t is in range
+            slot = t - (n_dev - 1)
+            emit = (stage == n_dev - 1) & (slot >= 0)
+            outs = jax.lax.cond(
+                emit,
+                lambda o: o.at[jnp.maximum(slot, 0)].set(h_out),
+                lambda o: o,
+                outs,
+            )
+            h_next = jax.lax.ppermute(h_out, axis, perm)
+            return h_next, outs
+
+        _, outs = jax.lax.fori_loop(
+            0, n_micro + n_dev - 1, tick, (h0, outs0))
+        # broadcast the last stage's buffer (all others hold zeros)
+        outs = jax.lax.psum(
+            jnp.where(stage == n_dev - 1, outs, jnp.zeros_like(outs)), axis)
+        return outs.reshape(b, h_dim)
+
+    run_sharded = jax.jit(compat_shard_map(
+        run, mesh, (jax.tree.map(lambda _: spec, params), P()), P()))
+    return sharded, run_sharded

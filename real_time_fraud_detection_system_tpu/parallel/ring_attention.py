@@ -184,21 +184,15 @@ def make_ring_attention_sharded(
     Returns ``fn(q, k, v) -> out`` with out sharded like q. The caller's
     arrays may live anywhere; jit will insert the resharding collectives.
     """
-    try:
-        from jax import shard_map as _sm  # jax >= 0.8
-
-        kw = {"check_vma": False}
-    except ImportError:  # pragma: no cover - older jax
-        from jax.experimental.shard_map import shard_map as _sm
-
-        kw = {"check_rep": False}
+    from real_time_fraud_detection_system_tpu.parallel.mesh import (
+        compat_shard_map,
+    )
 
     spec = P(None, axis, None, None)
-    fn = _sm(
+    fn = compat_shard_map(
         partial(ring_attention, axis_name=axis, causal=causal),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        **kw,
+        mesh,
+        (spec, spec, spec),
+        spec,
     )
     return jax.jit(fn)

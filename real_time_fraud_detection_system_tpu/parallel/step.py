@@ -312,18 +312,12 @@ def make_sharded_step(
                                  cms=cms)
         return new_state, params, probs, feats
 
-    try:
-        from jax import shard_map as _sm  # jax >= 0.8
+    from real_time_fraud_detection_system_tpu.parallel.mesh import (
+        compat_shard_map,
+    )
 
-        def _shard_map(f, in_specs, out_specs):
-            return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                       check_vma=False)
-    except ImportError:  # pragma: no cover - older jax
-        from jax.experimental.shard_map import shard_map as _sm
-
-        def _shard_map(f, in_specs, out_specs):
-            return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                       check_rep=False)
+    def _shard_map(f, in_specs, out_specs):
+        return compat_shard_map(f, mesh, in_specs, out_specs)
 
     def spec_like(tree, spec):
         return jax.tree.map(lambda _: spec, tree)

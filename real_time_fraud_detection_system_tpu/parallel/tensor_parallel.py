@@ -1,0 +1,157 @@
+"""Tensor parallelism: Megatron-style sharded MLP forward/backward.
+
+The reference has no model large enough to shard (its dormant PyTorch MLP,
+``shared_functions.py:1312-1707``, is single-device), but a TPU-native
+framework must scale its deep scorers past one chip's HBM/FLOPs: this
+module shards the MLP of :mod:`..models.mlp` over a mesh axis the
+standard way —
+
+- layer 1 **column-parallel**: ``W1 [F, H]`` split on H; each device
+  computes its slice of the hidden activation locally;
+- layer 2 **row-parallel**: ``W2 [H, H2]`` split on H (the contraction
+  axis); partial products are ``psum``-reduced over ICI — the ONE
+  collective in the forward pass;
+- remaining layers replicated (they are tiny: the head is ``[H2, 1]``).
+
+The same function differentiates under ``shard_map`` (JAX transposes the
+``psum`` to the backward broadcast automatically), so the online-SGD path
+works sharded without extra code. Gradients of sharded weights come out
+sharded — exactly what a per-device optax update wants.
+
+This module implements PURE tensor parallelism: the batch is replicated
+and only weights are split. Composing with data parallelism (rows
+sharded over a second mesh axis + gradient ``psum`` over it) is what
+:func:`..step.make_sharded_step` does for the serving models; a DP×TP
+MLP would add that axis here — not yet wired, so use a 1-axis mesh.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from real_time_fraud_detection_system_tpu.models.mlp import MLPParams
+
+
+def tp_specs(params: MLPParams) -> List[Tuple[P, P]]:
+    """PartitionSpecs per (W, b): col-parallel L1, row-parallel L2,
+    replicated rest. The placeholder axis name "tp" is substituted with
+    the mesh's real axis via :func:`_rename`."""
+    specs: List[Tuple[P, P]] = []
+    for i in range(len(params)):
+        if i == 0:
+            specs.append((P(None, "tp"), P("tp")))
+        elif i == 1:
+            specs.append((P("tp", None), P(None)))
+        else:
+            specs.append((P(None, None), P(None)))
+    return specs
+
+
+def _rename(spec: P, axis: str) -> P:
+    return P(*[axis if s == "tp" else s for s in spec])
+
+
+def shard_mlp_params(params: MLPParams, mesh: Mesh, axis: str) -> MLPParams:
+    """Place params on the mesh with the TP layout (host → device)."""
+    out: MLPParams = []
+    for (w, b), (ws, bs) in zip(params, tp_specs(params)):
+        out.append((
+            jax.device_put(w, NamedSharding(mesh, _rename(ws, axis))),
+            jax.device_put(b, NamedSharding(mesh, _rename(bs, axis))),
+        ))
+    return out
+
+
+def _check_tp(params: MLPParams, n_shards: int) -> None:
+    if len(params) < 3:
+        # with 2 layers, the row-parallel layer would BE the head and the
+        # hidden relu below would corrupt the logits
+        raise ValueError(
+            "tensor-parallel MLP needs >= 2 hidden layers "
+            "(mlp_hidden=(H1, H2, ...))"
+        )
+    h_dim = params[0][0].shape[1]
+    if h_dim % n_shards:
+        raise ValueError(
+            f"hidden width {h_dim} not divisible by {n_shards} shards"
+        )
+
+
+def tp_mlp_logits(params: MLPParams, x: jnp.ndarray, axis: str) -> jnp.ndarray:
+    """Per-shard forward (call under ``shard_map``): x [B, F] replicated,
+    L1 weights column-sharded, L2 row-sharded → full logits [B] on every
+    device after one psum."""
+    (w1, b1), (w2, b2) = params[0], params[1]
+    h = jax.nn.relu(x @ w1 + b1)  # [B, H/n] local
+    partial_h2 = h @ w2  # [B, H2] partial over the contraction
+    h2 = jax.lax.psum(partial_h2, axis) + b2  # the ONE forward collective
+    h = jax.nn.relu(h2)
+    for w, b in params[2:-1]:
+        h = jax.nn.relu(h @ w + b)
+    w, b = params[-1]
+    return (h @ w + b)[..., 0]
+
+
+def make_tp_mlp(mesh: Mesh, params: MLPParams, axis: Optional[str] = None):
+    """→ (sharded_params, predict_proba(params, x)) jitted over the mesh.
+
+    ``x`` is replicated (pure TP); compose with the row-sharded engine
+    step for DP×TP. Requires ≥ 2 hidden layers and hidden width divisible
+    by the axis size.
+    """
+    from real_time_fraud_detection_system_tpu.parallel.mesh import (
+        compat_shard_map,
+    )
+
+    axis = axis or mesh.axis_names[0]
+    _check_tp(params, mesh.shape[axis])
+    sharded = shard_mlp_params(params, mesh, axis)
+    specs = [
+        (_rename(ws, axis), _rename(bs, axis))
+        for ws, bs in tp_specs(params)
+    ]
+
+    def _predict(p, x):
+        return jax.nn.sigmoid(tp_mlp_logits(p, x, axis))
+
+    predict_proba = jax.jit(
+        compat_shard_map(_predict, mesh, (specs, P()), P()))
+    return sharded, predict_proba
+
+
+def make_tp_step(mesh: Mesh, params: MLPParams, lr: float = 1e-2,
+                 axis: Optional[str] = None):
+    """→ (sharded_params, step(params, x, y) → (params, loss)): one SGD
+    step with TP-sharded weights; weight grads stay shard-local (the psum
+    transpose gives each shard exactly its gradient slice)."""
+    import optax
+
+    from real_time_fraud_detection_system_tpu.parallel.mesh import (
+        compat_shard_map,
+    )
+
+    axis = axis or mesh.axis_names[0]
+    _check_tp(params, mesh.shape[axis])
+    sharded = shard_mlp_params(params, mesh, axis)
+    specs = [
+        (_rename(ws, axis), _rename(bs, axis)) for ws, bs in tp_specs(params)
+    ]
+
+    def loss_fn(p, x, y):
+        logits = tp_mlp_logits(p, x, axis)
+        per = optax.sigmoid_binary_cross_entropy(
+            logits, y.astype(jnp.float32))
+        return per.mean()
+
+    def _step(p, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(p, x, y)
+        new = jax.tree.map(lambda w, g: w - lr * g, p, grads)
+        return new, loss
+
+    step = jax.jit(
+        compat_shard_map(_step, mesh, (specs, P(), P()), (specs, P())))
+    return sharded, step

@@ -1,0 +1,89 @@
+"""Tensor- and pipeline-parallel paths on the 8-virtual-device mesh.
+
+TP: Megatron column/row-split MLP must match the unsharded forward and
+train under SGD with shard-local weight gradients. PP: the GPipe
+microbatch pipeline must equal the sequential stack bit-for-bit.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from real_time_fraud_detection_system_tpu.models.mlp import (
+    init_mlp,
+    mlp_predict_proba,
+)
+from real_time_fraud_detection_system_tpu.parallel.mesh import make_mesh
+from real_time_fraud_detection_system_tpu.parallel.pipeline_parallel import (
+    init_stack,
+    make_pipeline,
+    stack_apply,
+)
+from real_time_fraud_detection_system_tpu.parallel.tensor_parallel import (
+    make_tp_mlp,
+    make_tp_step,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8)
+
+
+def test_tp_forward_matches_unsharded(mesh):
+    params = init_mlp(15, hidden=(64, 32), seed=3)
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(0, 1, (256, 15)), jnp.float32)
+    ref = np.asarray(mlp_predict_proba(params, x))
+    sharded, predict = make_tp_mlp(mesh, params)
+    tp = np.asarray(predict(sharded, x))
+    # row-parallel psum re-associates one f32 sum — close, not bit-equal
+    np.testing.assert_allclose(tp, ref, atol=1e-6)
+
+
+def test_tp_rejects_bad_shapes(mesh):
+    with pytest.raises(ValueError, match="hidden layers"):
+        make_tp_mlp(mesh, init_mlp(15, hidden=(64,)))
+    with pytest.raises(ValueError, match="divisible"):
+        make_tp_mlp(mesh, init_mlp(15, hidden=(30, 16)))
+
+
+def test_tp_training_step_learns(mesh):
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(0, 1, (512, 15)), jnp.float32)
+    y = jnp.asarray(
+        (np.asarray(x)[:, 0] - np.asarray(x)[:, 2] > 0.5).astype(np.int32))
+    params = init_mlp(15, hidden=(64, 32), seed=0)
+    sharded, step = make_tp_step(mesh, params, lr=0.1)
+    losses = []
+    for _ in range(30):
+        sharded, loss = step(sharded, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7
+    # weights stayed TP-sharded through the updates
+    w1 = sharded[0][0]
+    assert w1.sharding.spec == jax.sharding.PartitionSpec(None, "data")
+
+
+def test_pipeline_matches_sequential(mesh):
+    width, n_dev, n_micro = 16, 8, 4
+    params = init_stack(width, n_stages=n_dev, seed=2)
+    x = jnp.asarray(
+        np.random.default_rng(3).normal(0, 1, (64, width)), jnp.float32)
+    ref = np.asarray(stack_apply(params, x))
+    sharded, run = make_pipeline(mesh, params, n_micro=n_micro)
+    out = np.asarray(run(sharded, x))
+    # same per-microbatch compute in the same order → bit-identical
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_pipeline_single_microbatch_and_errors(mesh):
+    params = init_stack(8, n_stages=8)
+    x = jnp.asarray(
+        np.random.default_rng(4).normal(0, 1, (8, 8)), jnp.float32)
+    sharded, run = make_pipeline(mesh, params, n_micro=1)
+    np.testing.assert_array_equal(
+        np.asarray(run(sharded, x)), np.asarray(stack_apply(params, x)))
+    with pytest.raises(ValueError, match="stage"):
+        make_pipeline(mesh, init_stack(8, n_stages=4), n_micro=2)
