@@ -13,6 +13,7 @@ from real_time_fraud_detection_system_tpu.parallel.distributed import (  # noqa:
     process_local_batch_slice,
 )
 from real_time_fraud_detection_system_tpu.parallel.tensor_parallel import (  # noqa: F401
+    make_dp_tp_step,
     make_tp_mlp,
     make_tp_step,
 )

@@ -13,16 +13,20 @@ standard way —
   collective in the forward pass;
 - remaining layers replicated (they are tiny: the head is ``[H2, 1]``).
 
-The same function differentiates under ``shard_map`` (JAX transposes the
-``psum`` to the backward broadcast automatically), so the online-SGD path
-works sharded without extra code. Gradients of sharded weights come out
-sharded — exactly what a per-device optax update wants.
+The same function differentiates under ``shard_map``, with one caveat:
+the forward all-reduce must carry a custom identity backward
+(:func:`_allreduce_g` — Megatron's *g*; a plain ``psum`` re-transposes
+to ``psum`` and inflates sharded-weight gradients by the axis size).
+With that in place gradients of sharded weights come out sharded —
+exactly what a per-device optax update wants.
 
-This module implements PURE tensor parallelism: the batch is replicated
-and only weights are split. Composing with data parallelism (rows
-sharded over a second mesh axis + gradient ``psum`` over it) is what
-:func:`..step.make_sharded_step` does for the serving models; a DP×TP
-MLP would add that axis here — not yet wired, so use a 1-axis mesh.
+:func:`make_tp_mlp`/:func:`make_tp_step` on a 1-axis mesh are PURE
+tensor parallelism (batch replicated, weights split).
+:func:`make_dp_tp_step` composes both on a 2-axis ``(dp, tp)`` mesh:
+batch rows shard over ``dp``, weights over ``tp``, and the backward pass
+adds the one extra collective DP requires — gradient ``psum`` over
+``dp`` — while the TP weight grads stay shard-local exactly as in the
+1-axis case. This is the standard 2D layout deep scorers deploy with.
 """
 
 from __future__ import annotations
@@ -81,6 +85,33 @@ def _check_tp(params: MLPParams, n_shards: int) -> None:
         )
 
 
+def _allreduce_g(axis: str):
+    """Megatron's *g* function: ``psum`` forward, IDENTITY backward.
+
+    Under ``shard_map`` with replication checks off, plain ``psum``
+    transposes to another ``psum`` — but the cotangent arriving from the
+    (replicated) downstream is already identical on every shard, so that
+    second psum inflates sharded-weight gradients by the axis size
+    (measured: exactly 8× on an 8-shard mesh; the loss still descends,
+    which is why a learns-test can't catch it). The custom VJP passes
+    the cotangent through unchanged — the mathematically correct
+    transpose given replicated downstream compute.
+    """
+
+    @jax.custom_vjp
+    def g(x):
+        return jax.lax.psum(x, axis)
+
+    def fwd(x):
+        return jax.lax.psum(x, axis), None
+
+    def bwd(_, ct):
+        return (ct,)
+
+    g.defvjp(fwd, bwd)
+    return g
+
+
 def tp_mlp_logits(params: MLPParams, x: jnp.ndarray, axis: str) -> jnp.ndarray:
     """Per-shard forward (call under ``shard_map``): x [B, F] replicated,
     L1 weights column-sharded, L2 row-sharded → full logits [B] on every
@@ -88,7 +119,7 @@ def tp_mlp_logits(params: MLPParams, x: jnp.ndarray, axis: str) -> jnp.ndarray:
     (w1, b1), (w2, b2) = params[0], params[1]
     h = jax.nn.relu(x @ w1 + b1)  # [B, H/n] local
     partial_h2 = h @ w2  # [B, H2] partial over the contraction
-    h2 = jax.lax.psum(partial_h2, axis) + b2  # the ONE forward collective
+    h2 = _allreduce_g(axis)(partial_h2) + b2  # the ONE forward collective
     h = jax.nn.relu(h2)
     for w, b in params[2:-1]:
         h = jax.nn.relu(h @ w + b)
@@ -107,7 +138,7 @@ def make_tp_mlp(mesh: Mesh, params: MLPParams, axis: Optional[str] = None):
         compat_shard_map,
     )
 
-    axis = axis or mesh.axis_names[0]
+    axis = axis or mesh.axis_names[-1]
     _check_tp(params, mesh.shape[axis])
     sharded = shard_mlp_params(params, mesh, axis)
     specs = [
@@ -124,22 +155,32 @@ def make_tp_mlp(mesh: Mesh, params: MLPParams, axis: Optional[str] = None):
 
 
 def make_tp_step(mesh: Mesh, params: MLPParams, lr: float = 1e-2,
-                 axis: Optional[str] = None):
+                 axis: Optional[str] = None,
+                 dp_axis: Optional[str] = None):
     """→ (sharded_params, step(params, x, y) → (params, loss)): one SGD
-    step with TP-sharded weights; weight grads stay shard-local (the psum
-    transpose gives each shard exactly its gradient slice)."""
+    step with TP-sharded weights; weight grads stay shard-local
+    (:func:`_allreduce_g` gives each shard exactly its gradient slice).
+
+    With ``dp_axis`` set (2-axis mesh), batch rows shard over it and the
+    backward adds the one collective DP requires: grads (and the
+    reported loss) are mean-``psum``'d over ``dp_axis`` so every dp
+    replica applies the identical update — the standard 2D DP×TP layout.
+    Batch size must divide by the dp axis.
+    """
     import optax
 
     from real_time_fraud_detection_system_tpu.parallel.mesh import (
         compat_shard_map,
     )
 
-    axis = axis or mesh.axis_names[0]
+    axis = axis or mesh.axis_names[-1]
     _check_tp(params, mesh.shape[axis])
     sharded = shard_mlp_params(params, mesh, axis)
     specs = [
         (_rename(ws, axis), _rename(bs, axis)) for ws, bs in tp_specs(params)
     ]
+    n_dp = mesh.shape[dp_axis] if dp_axis else 1
+    x_spec = P(dp_axis) if dp_axis else P()
 
     def loss_fn(p, x, y):
         logits = tp_mlp_logits(p, x, axis)
@@ -149,9 +190,25 @@ def make_tp_step(mesh: Mesh, params: MLPParams, lr: float = 1e-2,
 
     def _step(p, x, y):
         loss, grads = jax.value_and_grad(loss_fn)(p, x, y)
+        if dp_axis:
+            # the ONE extra DP collective: average across row groups
+            grads = jax.tree.map(
+                lambda g: jax.lax.psum(g, dp_axis) / n_dp, grads)
+            loss = jax.lax.psum(loss, dp_axis) / n_dp
         new = jax.tree.map(lambda w, g: w - lr * g, p, grads)
         return new, loss
 
-    step = jax.jit(
-        compat_shard_map(_step, mesh, (specs, P(), P()), (specs, P())))
+    step = jax.jit(compat_shard_map(
+        _step, mesh, (specs, x_spec, x_spec), (specs, P())))
     return sharded, step
+
+
+def make_dp_tp_step(
+    mesh: Mesh,
+    params: MLPParams,
+    lr: float = 1e-2,
+    dp_axis: str = "dp",
+    tp_axis: str = "tp",
+):
+    """2D DP×TP training step on a 2-axis mesh — see :func:`make_tp_step`."""
+    return make_tp_step(mesh, params, lr=lr, axis=tp_axis, dp_axis=dp_axis)

@@ -49,6 +49,37 @@ def test_tp_rejects_bad_shapes(mesh):
         make_tp_mlp(mesh, init_mlp(15, hidden=(30, 16)))
 
 
+def test_tp_grads_match_unsharded(mesh):
+    """One lr=1.0 step recovers the gradient; it must equal the
+    single-device gradient on EVERY layer (the psum-transpose inflation
+    bug scaled sharded layers by the axis size while still descending)."""
+    import optax
+
+    from real_time_fraud_detection_system_tpu.models.mlp import mlp_logits
+
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(0, 1, (128, 15)), jnp.float32)
+    y = jnp.asarray((rng.random(128) < 0.3).astype(np.int32))
+    params = init_mlp(15, hidden=(32, 16), seed=7)
+
+    def ref_loss(p):
+        per = optax.sigmoid_binary_cross_entropy(
+            mlp_logits(p, x), y.astype(jnp.float32))
+        return per.mean()
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss)(params)
+    sharded, step = make_tp_step(mesh, params, lr=1.0)
+    new, loss = step(sharded, x, y)
+    assert abs(float(loss) - float(ref_l)) < 1e-6
+    for i, ((w0, b0), (w1, b1)) in enumerate(zip(params, new)):
+        np.testing.assert_allclose(
+            np.asarray(w0) - np.asarray(w1), np.asarray(ref_g[i][0]),
+            atol=1e-6, err_msg=f"W grad layer {i}")
+        np.testing.assert_allclose(
+            np.asarray(b0) - np.asarray(b1), np.asarray(ref_g[i][1]),
+            atol=1e-6, err_msg=f"b grad layer {i}")
+
+
 def test_tp_training_step_learns(mesh):
     rng = np.random.default_rng(1)
     x = jnp.asarray(rng.normal(0, 1, (512, 15)), jnp.float32)
@@ -64,6 +95,44 @@ def test_tp_training_step_learns(mesh):
     # weights stayed TP-sharded through the updates
     w1 = sharded[0][0]
     assert w1.sharding.spec == jax.sharding.PartitionSpec(None, "data")
+
+
+def test_dp_tp_step_matches_full_batch_sgd():
+    """2D (dp=2, tp=4) step must equal single-device full-batch SGD:
+    equal-size dp groups → mean-of-group-means == full-batch mean."""
+    import optax
+
+    from real_time_fraud_detection_system_tpu.models.mlp import mlp_logits
+    from real_time_fraud_detection_system_tpu.parallel.tensor_parallel import (
+        make_dp_tp_step,
+    )
+
+    devs = np.asarray(jax.devices()[:8]).reshape(2, 4)
+    mesh2 = jax.sharding.Mesh(devs, ("dp", "tp"))
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(0, 1, (128, 15)), jnp.float32)
+    y = jnp.asarray((rng.random(128) < 0.3).astype(np.int32))
+    params = init_mlp(15, hidden=(32, 16), seed=7)
+
+    def ref_loss(p):
+        per = optax.sigmoid_binary_cross_entropy(
+            mlp_logits(p, x), y.astype(jnp.float32))
+        return per.mean()
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss)(params)
+    sharded, step = make_dp_tp_step(mesh2, params, lr=1.0)
+    new, loss = step(sharded, x, y)
+    assert abs(float(loss) - float(ref_l)) < 1e-6
+    # EVERY layer's recovered gradient equals the full-batch gradient
+    # (a dp mis-reduction on any leaf — bias skipped, layer re-inflated —
+    # must fail here, not just layer 0)
+    for i, ((w0, b0), (w1, b1)) in enumerate(zip(params, new)):
+        np.testing.assert_allclose(
+            np.asarray(w0) - np.asarray(w1), np.asarray(ref_g[i][0]),
+            atol=1e-6, err_msg=f"W grad layer {i}")
+        np.testing.assert_allclose(
+            np.asarray(b0) - np.asarray(b1), np.asarray(ref_g[i][1]),
+            atol=1e-6, err_msg=f"b grad layer {i}")
 
 
 def test_pipeline_matches_sequential(mesh):
