@@ -234,10 +234,17 @@ def transformer_logits(
     params: TransformerParams,
     x: jnp.ndarray,  # [B, T, N_EVENT_FEATURES]
     attn_fn: Optional[AttnFn] = None,
+    reduce_fn: Optional[Callable[[jnp.ndarray], jnp.ndarray]] = None,
 ) -> jnp.ndarray:
     """Per-position fraud logits [B, T]. ``attn_fn(q,k,v) -> o`` defaults to
-    causal naive attention; pass a blockwise/ring closure for long T."""
+    causal naive attention; pass a blockwise/ring closure for long T.
+
+    ``reduce_fn`` wraps the two row-parallel contractions per block (the
+    attention-output and MLP-down projections) — identity here; the
+    tensor-parallel path passes its all-reduce so the SAME forward serves
+    sharded (``parallel.tensor_parallel.tp_transformer_logits``)."""
     attn = attn_fn or (lambda q, k, v: naive_attn(q, k, v, causal=True))
+    red = reduce_fn or (lambda t: t)
     # positional information comes from the inter-arrival/time-of-day event
     # channels (translation-invariant histories), not absolute embeddings.
     h = x @ params.embed_w + params.embed_b
@@ -247,9 +254,9 @@ def transformer_logits(
         k = jnp.einsum("btd,dhe->bthe", hn, blk.wk)
         v = jnp.einsum("btd,dhe->bthe", hn, blk.wv)
         o = attn(q, k, v)
-        h = h + jnp.einsum("bthe,hed->btd", o, blk.wo)
+        h = h + red(jnp.einsum("bthe,hed->btd", o, blk.wo))
         hn = _ln(h, blk.ln2_g, blk.ln2_b)
-        h = h + jax.nn.gelu(hn @ blk.w1 + blk.b1) @ blk.w2 + blk.b2
+        h = h + red(jax.nn.gelu(hn @ blk.w1 + blk.b1) @ blk.w2) + blk.b2
     h = _ln(h, params.lnf_g, params.lnf_b)
     return (h @ params.head_w + params.head_b)[..., 0]
 

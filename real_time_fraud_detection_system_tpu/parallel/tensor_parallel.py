@@ -212,3 +212,81 @@ def make_dp_tp_step(
 ):
     """2D DP×TP training step on a 2-axis mesh — see :func:`make_tp_step`."""
     return make_tp_step(mesh, params, lr=lr, axis=tp_axis, dp_axis=dp_axis)
+
+
+# ---------------------------------------------------------------------------
+# Transformer TP (Megatron attention + MLP split for models/sequence.py)
+# ---------------------------------------------------------------------------
+
+
+def transformer_tp_specs(params, axis: str):
+    """PartitionSpecs for :class:`..models.sequence.TransformerParams`:
+    per block, Q/K/V sharded on the HEAD axis (each shard attends with
+    its own heads — softmax is per-head, so head sharding is exact), the
+    output projection row-parallel, the MLP column/row split; embeddings,
+    layernorms, and the scalar head replicated."""
+    from real_time_fraud_detection_system_tpu.models.sequence import (
+        BlockParams,
+        TransformerParams,
+    )
+
+    rep2, rep1 = P(None, None), P(None)
+    blk = BlockParams(
+        ln1_g=rep1, ln1_b=rep1,
+        wq=P(None, axis, None), wk=P(None, axis, None),
+        wv=P(None, axis, None),
+        wo=P(axis, None, None),
+        ln2_g=rep1, ln2_b=rep1,
+        w1=P(None, axis), b1=P(axis),
+        w2=P(axis, None), b2=rep1,
+    )
+    return TransformerParams(
+        embed_w=rep2, embed_b=rep1,
+        blocks=tuple(blk for _ in params.blocks),
+        lnf_g=rep1, lnf_b=rep1,
+        head_w=rep2, head_b=rep1,
+    )
+
+
+def tp_transformer_logits(params, x: jnp.ndarray, axis: str) -> jnp.ndarray:
+    """Per-shard causal-transformer forward (under ``shard_map``): the
+    SAME :func:`..models.sequence.transformer_logits` code path, with its
+    two row-parallel contractions per block all-reduced via
+    :func:`_allreduce_g` (the ``reduce_fn`` hook). Attention is
+    naive-causal over the LOCAL heads (head-sharded attention is exact;
+    ring/blockwise attention composes with sequence parallelism, not
+    this head split)."""
+    from real_time_fraud_detection_system_tpu.models.sequence import (
+        transformer_logits,
+    )
+
+    return transformer_logits(params, x, reduce_fn=_allreduce_g(axis))
+
+
+def make_tp_transformer(mesh: Mesh, params, axis: Optional[str] = None):
+    """→ (sharded_params, logits(params, x)) with heads + MLP hidden
+    sharded over ``axis``. Requires n_heads and d_ff divisible by the
+    axis size."""
+    from real_time_fraud_detection_system_tpu.parallel.mesh import (
+        compat_shard_map,
+    )
+
+    axis = axis or mesh.axis_names[-1]
+    n = mesh.shape[axis]
+    n_heads = params.blocks[0].wq.shape[1]
+    d_ff = params.blocks[0].w1.shape[1]
+    if n_heads % n or d_ff % n:
+        raise ValueError(
+            f"n_heads {n_heads} and d_ff {d_ff} must divide by {n} shards"
+        )
+    specs = transformer_tp_specs(params, axis)
+    sharded = jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+        params, specs,
+    )
+
+    def _logits(p, x):
+        return tp_transformer_logits(p, x, axis)
+
+    logits = jax.jit(compat_shard_map(_logits, mesh, (specs, P()), P()))
+    return sharded, logits

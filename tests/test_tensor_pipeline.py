@@ -135,6 +135,30 @@ def test_dp_tp_step_matches_full_batch_sgd():
             atol=1e-6, err_msg=f"b grad layer {i}")
 
 
+def test_tp_transformer_matches_unsharded(mesh):
+    """Megatron attention/MLP split of the sequence model: head-sharded
+    attention + two psums per block must match the single-device forward."""
+    from real_time_fraud_detection_system_tpu.models.sequence import (
+        init_transformer,
+        transformer_logits,
+    )
+    from real_time_fraud_detection_system_tpu.parallel.tensor_parallel import (
+        make_tp_transformer,
+    )
+
+    params = init_transformer(
+        d_model=32, n_heads=8, n_layers=2, d_ff=64, seed=1)
+    x = jnp.asarray(
+        np.random.default_rng(6).normal(0, 1, (4, 16, 8)), jnp.float32)
+    ref = np.asarray(transformer_logits(params, x))
+    sharded, logits = make_tp_transformer(mesh, params)
+    tp = np.asarray(logits(sharded, x))
+    np.testing.assert_allclose(tp, ref, atol=2e-5)
+    with pytest.raises(ValueError, match="divide"):
+        make_tp_transformer(
+            mesh, init_transformer(d_model=32, n_heads=2, n_layers=1))
+
+
 def test_pipeline_matches_sequential(mesh):
     width, n_dev, n_micro = 16, 8, 4
     params = init_stack(width, n_stages=n_dev, seed=2)
