@@ -13,6 +13,7 @@
 PY ?= python
 CLI = $(PY) -m real_time_fraud_detection_system_tpu.cli
 OUT ?= out
+CONNECT_URL ?= http://localhost:8083
 # Dataset scale: moderate default so `make run-all` finishes in minutes on
 # a laptop CPU; reference scale (data_generator.ipynb · cell 34) is
 # `make datagen CUSTOMERS=5000 TERMINALS=10000 DAYS=245`.
@@ -45,6 +46,9 @@ query:
 dashboard:
 	$(CLI) dashboard --data $(OUT)/analyzed --out $(OUT)/dashboard.html
 
+connectors:
+	$(CLI) connectors --connect-url $(CONNECT_URL)
+
 bench:
 	$(PY) bench.py
 
@@ -57,4 +61,4 @@ install:
 clean:
 	rm -rf $(OUT)
 
-.PHONY: demo datagen train score run-all query dashboard bench test install clean
+.PHONY: demo datagen train score run-all query dashboard connectors bench test install clean

@@ -429,6 +429,72 @@ def cmd_query(args) -> int:
     return 0
 
 
+def cmd_connectors(args) -> int:
+    """Register the Debezium Postgres source connector with Kafka Connect.
+
+    The reference's ``make connectors`` POSTs its connector JSON to the
+    Connect REST API (``Makefile:21-22`` → ``:8083/connectors/``, config
+    at ``connect/pg-src-connector.json``: PostgresConnector, tasks.max 1,
+    schema include ``payment``, topic prefix ``debezium``). Same here,
+    stdlib-only; 409 Conflict (already registered) is success."""
+    import urllib.error
+    import urllib.request
+
+    from real_time_fraud_detection_system_tpu.utils import get_logger
+
+    log = get_logger("connectors")
+    body = {
+        "name": args.name,
+        "config": {
+            "connector.class":
+                "io.debezium.connector.postgresql.PostgresConnector",
+            "tasks.max": "1",
+            "database.hostname": args.db_host,
+            "database.port": str(args.db_port),
+            "database.user": args.db_user,
+            "database.password": args.db_password,
+            "database.dbname": args.db_name,
+            "database.include.list": args.db_name,
+            "schema.include.list": args.schema,
+            "topic.prefix": args.topic_prefix,
+        },
+    }
+    url = args.connect_url.rstrip("/") + "/connectors/"
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(body).encode(),
+        headers={"Accept": "application/json",
+                 "Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=args.timeout) as resp:
+            raw = resp.read() or b"{}"
+            try:
+                payload = json.loads(raw)
+            except json.JSONDecodeError:
+                # a 2xx from something that is NOT Kafka Connect
+                log.error("non-JSON response from %s (is this really the "
+                          "Connect REST API?): %r", url, raw[:120])
+                return 1
+            out = {"status": resp.status,
+                   "connector": args.name,
+                   "response": payload}
+    except urllib.error.HTTPError as e:
+        if e.code == 409:
+            out = {"status": 409, "connector": args.name,
+                   "already_registered": True}
+        else:
+            log.error("connect REST error %s: %s", e.code,
+                      e.read()[:200].decode(errors="replace"))
+            return 1
+    except (urllib.error.URLError, OSError) as e:
+        log.error("cannot reach Kafka Connect at %s: %s", url, e)
+        return 1
+    print(_json_line(out))
+    return 0
+
+
 def cmd_dashboard(args) -> int:
     """Render the static-HTML ops dashboard (the Superset role)."""
     from real_time_fraud_detection_system_tpu.io.dashboard import (
@@ -727,6 +793,23 @@ def main(argv=None) -> int:
     p.add_argument("--top-k", type=int, default=10)
     p.add_argument("--bucket", default="day", choices=["hour", "day"])
     p.set_defaults(fn=cmd_query)
+
+    p = sub.add_parser(
+        "connectors",
+        help="register the Debezium Postgres source connector "
+             "(the reference's make connectors)",
+    )
+    p.add_argument("--connect-url", default="http://localhost:8083")
+    p.add_argument("--name", default="pg-src-connector")
+    p.add_argument("--db-host", default="postgres")
+    p.add_argument("--db-port", type=int, default=5432)
+    p.add_argument("--db-user", default="postgres")
+    p.add_argument("--db-password", default="postgres")
+    p.add_argument("--db-name", default="postgres")
+    p.add_argument("--schema", default="payment")
+    p.add_argument("--topic-prefix", default="debezium")
+    p.add_argument("--timeout", type=float, default=10.0)
+    p.set_defaults(fn=cmd_connectors)
 
     p = sub.add_parser(
         "dashboard",

@@ -1,0 +1,73 @@
+"""`rtfds connectors` — Debezium connector registration
+(the reference's ``make connectors`` → Connect REST POST,
+``Makefile:21-22``, ``connect/pg-src-connector.json``)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from real_time_fraud_detection_system_tpu.cli import main
+
+
+@pytest.fixture()
+def connect_server():
+    """Fake Kafka-Connect REST endpoint capturing connector POSTs."""
+    posts = []
+
+    class Handler(BaseHTTPRequestHandler):
+        status = 201
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            posts.append((self.path, json.loads(self.rfile.read(n))))
+            self.send_response(Handler.status)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(b'{"name": "pg-src-connector"}')
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv, posts, Handler
+    srv.shutdown()
+
+
+def test_registers_reference_shaped_connector(connect_server, capsys):
+    srv, posts, _ = connect_server
+    rc = main(["--platform", "cpu", "connectors",
+               "--connect-url", f"http://127.0.0.1:{srv.server_port}"])
+    assert rc == 0
+    path, body = posts[0]
+    assert path == "/connectors/"
+    # the reference connector config, field for field
+    assert body["name"] == "pg-src-connector"
+    cfg = body["config"]
+    assert cfg["connector.class"] == (
+        "io.debezium.connector.postgresql.PostgresConnector")
+    assert cfg["tasks.max"] == "1"
+    assert cfg["schema.include.list"] == "payment"
+    assert cfg["topic.prefix"] == "debezium"
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["status"] == 201
+
+
+def test_conflict_is_success(connect_server, capsys):
+    srv, _, Handler = connect_server
+    Handler.status = 409
+    rc = main(["--platform", "cpu", "connectors",
+               "--connect-url", f"http://127.0.0.1:{srv.server_port}"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["already_registered"] is True
+
+
+def test_unreachable_connect_fails_cleanly():
+    rc = main(["--platform", "cpu", "connectors",
+               "--connect-url", "http://127.0.0.1:1",
+               "--timeout", "0.5"])
+    assert rc == 1
