@@ -477,6 +477,12 @@ def cmd_connectors(args) -> int:
                 log.error("non-JSON response from %s (is this really the "
                           "Connect REST API?): %r", url, raw[:120])
                 return 1
+            # Connect echoes the full config back — redact the secret
+            # before it can reach stdout/CI logs
+            if isinstance(payload, dict):
+                cfg_echo = payload.get("config")
+                if isinstance(cfg_echo, dict) and "database.password" in cfg_echo:
+                    cfg_echo["database.password"] = "***"
             out = {"status": resp.status,
                    "connector": args.name,
                    "response": payload}

@@ -235,27 +235,33 @@ def transformer_logits(
     x: jnp.ndarray,  # [B, T, N_EVENT_FEATURES]
     attn_fn: Optional[AttnFn] = None,
     reduce_fn: Optional[Callable[[jnp.ndarray], jnp.ndarray]] = None,
+    enter_fn: Optional[Callable[[jnp.ndarray], jnp.ndarray]] = None,
 ) -> jnp.ndarray:
     """Per-position fraud logits [B, T]. ``attn_fn(q,k,v) -> o`` defaults to
     causal naive attention; pass a blockwise/ring closure for long T.
 
-    ``reduce_fn`` wraps the two row-parallel contractions per block (the
-    attention-output and MLP-down projections) — identity here; the
-    tensor-parallel path passes its all-reduce so the SAME forward serves
-    sharded (``parallel.tensor_parallel.tp_transformer_logits``)."""
+    ``reduce_fn`` and ``enter_fn`` bracket the two column→row parallel
+    regions per block (Q/K/V→attention-out, and the MLP): identity here;
+    the tensor-parallel path passes Megatron's *g* (psum forward,
+    identity backward) as ``reduce_fn`` at each region's EXIT and *f*
+    (identity forward, psum backward) at each ENTRY — without *f*, the
+    gradients of replicated upstream params (embeddings, layernorms)
+    would only count the local shard's heads. The SAME forward thus
+    serves sharded (``parallel.tensor_parallel.tp_transformer_logits``)."""
     attn = attn_fn or (lambda q, k, v: naive_attn(q, k, v, causal=True))
     red = reduce_fn or (lambda t: t)
+    ent = enter_fn or (lambda t: t)
     # positional information comes from the inter-arrival/time-of-day event
     # channels (translation-invariant histories), not absolute embeddings.
     h = x @ params.embed_w + params.embed_b
     for blk in params.blocks:
-        hn = _ln(h, blk.ln1_g, blk.ln1_b)
+        hn = ent(_ln(h, blk.ln1_g, blk.ln1_b))
         q = jnp.einsum("btd,dhe->bthe", hn, blk.wq)
         k = jnp.einsum("btd,dhe->bthe", hn, blk.wk)
         v = jnp.einsum("btd,dhe->bthe", hn, blk.wv)
         o = attn(q, k, v)
         h = h + red(jnp.einsum("bthe,hed->btd", o, blk.wo))
-        hn = _ln(h, blk.ln2_g, blk.ln2_b)
+        hn = ent(_ln(h, blk.ln2_g, blk.ln2_b))
         h = h + red(jax.nn.gelu(hn @ blk.w1 + blk.b1) @ blk.w2) + blk.b2
     h = _ln(h, params.lnf_g, params.lnf_b)
     return (h @ params.head_w + params.head_b)[..., 0]
@@ -268,8 +274,12 @@ def transformer_loss(
     mask: jnp.ndarray,
     pos_weight: float = 1.0,
     attn_fn: Optional[AttnFn] = None,
+    reduce_fn=None,
+    enter_fn=None,
 ) -> jnp.ndarray:
-    logits = transformer_logits(params, x, attn_fn).astype(jnp.float32)
+    logits = transformer_logits(
+        params, x, attn_fn, reduce_fn=reduce_fn,
+        enter_fn=enter_fn).astype(jnp.float32)
     yf = y.astype(jnp.float32)
     w = jnp.where(yf > 0, pos_weight, 1.0) * mask.astype(jnp.float32)
     ll = jax.nn.log_sigmoid(logits) * yf + jax.nn.log_sigmoid(-logits) * (1 - yf)

@@ -17,6 +17,7 @@ from real_time_fraud_detection_system_tpu.parallel.tensor_parallel import (  # n
     make_tp_mlp,
     make_tp_step,
     make_tp_transformer,
+    make_tp_transformer_step,
 )
 from real_time_fraud_detection_system_tpu.parallel.pipeline_parallel import (  # noqa: F401
     make_pipeline,

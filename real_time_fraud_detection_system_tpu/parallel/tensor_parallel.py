@@ -112,6 +112,29 @@ def _allreduce_g(axis: str):
     return g
 
 
+def _identity_f(axis: str):
+    """Megatron's *f* function: IDENTITY forward, ``psum`` backward.
+
+    Placed at the ENTRY of a column-sharded region (before Q/K/V or the
+    MLP up-projection): forward is a no-op on the replicated activation;
+    backward sums the per-shard cotangents so gradients of replicated
+    UPSTREAM params (embeddings, layernorms, earlier blocks) count every
+    shard's heads/hidden-slice, not just the local one."""
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, ct):
+        return (jax.lax.psum(ct, axis),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
 def tp_mlp_logits(params: MLPParams, x: jnp.ndarray, axis: str) -> jnp.ndarray:
     """Per-shard forward (call under ``shard_map``): x [B, F] replicated,
     L1 weights column-sharded, L2 row-sharded → full logits [B] on every
@@ -260,18 +283,16 @@ def tp_transformer_logits(params, x: jnp.ndarray, axis: str) -> jnp.ndarray:
         transformer_logits,
     )
 
-    return transformer_logits(params, x, reduce_fn=_allreduce_g(axis))
-
-
-def make_tp_transformer(mesh: Mesh, params, axis: Optional[str] = None):
-    """→ (sharded_params, logits(params, x)) with heads + MLP hidden
-    sharded over ``axis``. Requires n_heads and d_ff divisible by the
-    axis size."""
-    from real_time_fraud_detection_system_tpu.parallel.mesh import (
-        compat_shard_map,
+    return transformer_logits(
+        params, x,
+        reduce_fn=_allreduce_g(axis),
+        enter_fn=_identity_f(axis),
     )
 
-    axis = axis or mesh.axis_names[-1]
+
+def _shard_transformer(mesh: Mesh, params, axis: str):
+    """Validate divisibility and place TransformerParams with the TP
+    layout. Shared by the logits factory and the train-step factory."""
     n = mesh.shape[axis]
     n_heads = params.blocks[0].wq.shape[1]
     d_ff = params.blocks[0].w1.shape[1]
@@ -284,6 +305,76 @@ def make_tp_transformer(mesh: Mesh, params, axis: Optional[str] = None):
         lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
         params, specs,
     )
+    return specs, sharded
+
+
+def make_tp_transformer_step(
+    mesh: Mesh,
+    params,
+    lr: float = 1e-3,
+    pos_weight: float = 1.0,
+    axis: Optional[str] = None,
+    dp_axis: Optional[str] = None,
+):
+    """→ (sharded_params, step(params, x, y, mask) → (params, loss)):
+    one SGD step of the head/MLP-sharded transformer (masked BCE, the
+    sequence family's loss). Optionally DP×TP on a 2-axis mesh: rows
+    shard over ``dp_axis``; per-group losses/grads combine with a
+    weight-proportional psum (masked-mean losses weight by each group's
+    mask mass, matching the full-batch masked mean when groups carry
+    different numbers of live positions)."""
+    from real_time_fraud_detection_system_tpu.models.sequence import (
+        transformer_loss,
+    )
+    from real_time_fraud_detection_system_tpu.parallel.mesh import (
+        compat_shard_map,
+    )
+
+    axis = axis or mesh.axis_names[-1]
+    specs, sharded = _shard_transformer(mesh, params, axis)
+    x_spec = P(dp_axis) if dp_axis else P()
+
+    def _step(p, x, y, mask):
+        def loss_fn(p_):
+            return transformer_loss(
+                p_, x, y, mask, pos_weight=pos_weight,
+                reduce_fn=_allreduce_g(axis),
+                enter_fn=_identity_f(axis))
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        if dp_axis:
+            # masked (pos-weighted) mean over dp groups: weight each
+            # group's loss/grads by its weight mass — the same mass the
+            # loss normalizes by — so the combined update equals the
+            # full-batch masked mean; an empty group carries zero weight
+            wts = jnp.where(y.astype(jnp.float32) > 0, pos_weight, 1.0)
+            w = (wts * mask.astype(jnp.float32)).sum()
+            tot = jnp.maximum(jax.lax.psum(w, dp_axis), 1.0)
+            # the group loss normalized by max(w, 1) — mirror that clamp
+            # here, or a group with mass in (0,1) would be down-weighted
+            # by w twice (loss=s/1 scaled by w/tot vs the true s/tot)
+            scale = jnp.maximum(w, 1.0) / tot
+            grads = jax.tree.map(
+                lambda g: jax.lax.psum(g * scale, dp_axis), grads)
+            loss = jax.lax.psum(loss * scale, dp_axis)
+        new = jax.tree.map(lambda v, g: v - lr * g, p, grads)
+        return new, loss
+
+    step = jax.jit(compat_shard_map(
+        _step, mesh, (specs, x_spec, x_spec, x_spec), (specs, P())))
+    return sharded, step
+
+
+def make_tp_transformer(mesh: Mesh, params, axis: Optional[str] = None):
+    """→ (sharded_params, logits(params, x)) with heads + MLP hidden
+    sharded over ``axis``. Requires n_heads and d_ff divisible by the
+    axis size."""
+    from real_time_fraud_detection_system_tpu.parallel.mesh import (
+        compat_shard_map,
+    )
+
+    axis = axis or mesh.axis_names[-1]
+    specs, sharded = _shard_transformer(mesh, params, axis)
 
     def _logits(p, x):
         return tp_transformer_logits(p, x, axis)

@@ -21,11 +21,13 @@ def connect_server():
 
         def do_POST(self):
             n = int(self.headers.get("Content-Length", 0))
-            posts.append((self.path, json.loads(self.rfile.read(n))))
+            body = json.loads(self.rfile.read(n))
+            posts.append((self.path, body))
             self.send_response(Handler.status)
             self.send_header("Content-Type", "application/json")
             self.end_headers()
-            self.wfile.write(b'{"name": "pg-src-connector"}')
+            # like real Connect: echo name + full config (incl. password)
+            self.wfile.write(json.dumps(body).encode())
 
         def log_message(self, *a):
             pass
@@ -54,6 +56,8 @@ def test_registers_reference_shaped_connector(connect_server, capsys):
     assert cfg["topic.prefix"] == "debezium"
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["status"] == 201
+    # Connect echoes the config back; the password must never reach stdout
+    assert out["response"]["config"]["database.password"] == "***"
 
 
 def test_conflict_is_success(connect_server, capsys):
