@@ -24,6 +24,12 @@ while :; do
         > PROFILE_r03.json 2>> "$OUT.log" \
         && { echo "hw_watch: profile -> PROFILE_r03.json"; cat PROFILE_r03.json; } \
         || echo "hw_watch: profile attempt failed (rc=$?)"
+      echo "hw_watch: fresh bench while the window is open (bench.py)"
+      timeout 2400 python bench.py > "BENCH_SESSION_${BENCH_TAG:-r03b}_tpu.json" \
+        2>> "$OUT.log" \
+        && { echo "hw_watch: bench -> BENCH_SESSION_${BENCH_TAG:-r03b}_tpu.json"; \
+             cat "BENCH_SESSION_${BENCH_TAG:-r03b}_tpu.json"; } \
+        || echo "hw_watch: bench attempt failed (rc=$?)"
       exit 0
     fi
     echo "hw_watch: parity attempt failed (rc=$?), tail of log:"
