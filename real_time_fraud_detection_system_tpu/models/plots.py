@@ -212,6 +212,76 @@ def plot_prequential_summary(rows: List, metric: str = "auc_roc", ax=None):
     return ax.figure
 
 
+def plot_tx_stats(txs, ax=None):
+    """Dataset statistics: transactions/day and fraudulent txs/day over
+    the generated table (reference ``get_tx_stats`` +
+    ``get_template_tx_stats``, ``shared_functions.py:925-988`` — the
+    notebook's first look at the simulator output)."""
+    plt = _mpl()
+    if ax is None:
+        _, ax = plt.subplots(figsize=(8, 4))
+    days = np.asarray(txs.tx_time_days)
+    # full calendar range: a day with zero transactions plots as 0, not
+    # as an interpolated segment between its neighbors
+    n_days = int(days.max()) + 1 if len(days) else 0
+    n_tx = np.bincount(days, minlength=n_days)
+    n_fraud = np.bincount(days, weights=np.asarray(txs.tx_fraud),
+                          minlength=n_days)
+    xs_days = np.arange(n_days)
+    ax.plot(xs_days, n_tx, label="# transactions")
+    ax.plot(xs_days, n_fraud, label="# fraudulent txs")
+    ax.set_xlabel("day")
+    ax.set_ylabel("count")
+    rate = n_fraud.sum() / max(n_tx.sum(), 1)
+    ax.set_title(f"Transaction stats (fraud rate {rate:.2%})")
+    ax.legend()
+    return ax.figure
+
+
+def plot_decision_boundary(
+    predict_proba,
+    x: np.ndarray,
+    y: np.ndarray,
+    feature_idx: Sequence[int] = (0, 1),
+    resolution: int = 100,
+    ax=None,
+):
+    """2-feature decision surface of any scorer (reference
+    ``plot_decision_boundary_classifier``, ``shared_functions.py:
+    1231-1302`` — the notebook's classifier-intuition figure).
+
+    ``predict_proba(features) -> probs`` is called on a grid over the
+    two selected features with the remaining features held at their
+    column means."""
+    plt = _mpl()
+    if ax is None:
+        _, ax = plt.subplots(figsize=(5, 4))
+    i, j = feature_idx
+    x = np.asarray(x, dtype=np.float64)
+    y = np.asarray(y)
+    xi, xj = x[:, i], x[:, j]
+    pad_i = 0.1 * max(float(np.ptp(xi)), 1e-6)
+    pad_j = 0.1 * max(float(np.ptp(xj)), 1e-6)
+    gi = np.linspace(xi.min() - pad_i, xi.max() + pad_i, resolution)
+    gj = np.linspace(xj.min() - pad_j, xj.max() + pad_j, resolution)
+    mi, mj = np.meshgrid(gi, gj)
+    grid = np.tile(x.mean(axis=0), (resolution * resolution, 1))
+    grid[:, i] = mi.ravel()
+    grid[:, j] = mj.ravel()
+    probs = np.asarray(predict_proba(grid.astype(np.float32)))
+    ax.contourf(mi, mj, probs.reshape(resolution, resolution),
+                levels=20, cmap="RdBu_r", alpha=0.7, vmin=0, vmax=1)
+    ax.scatter(xi[y == 0], xj[y == 0], s=8, c="tab:blue", label="genuine",
+               edgecolors="none")
+    ax.scatter(xi[y == 1], xj[y == 1], s=12, c="tab:red", label="fraud",
+               edgecolors="none")
+    ax.set_xlabel(f"feature {i}")
+    ax.set_ylabel(f"feature {j}")
+    ax.set_title("Decision boundary")
+    ax.legend()
+    return ax.figure
+
+
 def save_plots(
     path: str,
     y_true,

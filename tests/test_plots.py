@@ -98,3 +98,37 @@ def test_save_plots(tmp_path, scored):
     out = save_plots(str(tmp_path / "report.png"), y, s, "forest")
     assert os.path.exists(out)
     assert os.path.getsize(out) > 10_000  # a real rendered PNG
+
+
+def test_tx_stats_plot():
+    from real_time_fraud_detection_system_tpu.config import DataConfig
+    from real_time_fraud_detection_system_tpu.data import generate_dataset
+    from real_time_fraud_detection_system_tpu.models.plots import (
+        plot_tx_stats,
+    )
+
+    _, _, txs = generate_dataset(
+        DataConfig(n_customers=50, n_terminals=100, n_days=10))
+    fig = plot_tx_stats(txs)
+    assert fig is not None
+    ax = fig.axes[0]
+    # the volume line spans the FULL calendar range (zero-days plot as 0,
+    # never interpolated away)
+    assert len(ax.lines[0].get_xdata()) == int(txs.tx_time_days.max()) + 1
+    assert ax.lines[0].get_ydata().sum() == txs.n
+
+
+def test_decision_boundary_plot():
+    from real_time_fraud_detection_system_tpu.models.plots import (
+        plot_decision_boundary,
+    )
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (200, 4)).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0.5).astype(np.int32)
+
+    def predict(grid):
+        return 1.0 / (1.0 + np.exp(-(grid[:, 0] + grid[:, 1] - 0.5)))
+
+    fig = plot_decision_boundary(predict, x, y, resolution=24)
+    assert fig is not None
