@@ -794,7 +794,8 @@ def main(argv=None) -> int:
                         "table directory (tx_date=*/ layout)")
     p.add_argument("--report", default="summary",
                    choices=["summary", "timeseries", "terminals",
-                            "customers", "alerts", "transactions"])
+                            "customers", "alerts", "drift",
+                            "transactions"])
     p.add_argument("--threshold", type=float, default=0.5)
     p.add_argument("--top-k", type=int, default=10)
     p.add_argument("--bucket", default="day", choices=["hour", "day"])

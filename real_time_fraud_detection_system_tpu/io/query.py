@@ -210,6 +210,70 @@ def raw_transactions_report(directory: str) -> dict:
     }
 
 
+def _psi(ref: np.ndarray, cur: np.ndarray, n_bins: int = 10) -> float:
+    """Population stability index between two samples of one variable.
+
+    Bins are the reference deciles; probabilities are floored at 1e-4 so
+    empty bins contribute a large-but-finite term. Common reading:
+    < 0.1 stable, 0.1–0.25 drifting, > 0.25 shifted.
+    """
+    if len(ref) == 0 or len(cur) == 0:
+        return 0.0
+    edges = np.quantile(ref, np.linspace(0, 1, n_bins + 1)[1:-1])
+    p_ref = np.bincount(np.searchsorted(edges, ref), minlength=n_bins)
+    p_cur = np.bincount(np.searchsorted(edges, cur), minlength=n_bins)
+    p_ref = np.maximum(p_ref / len(ref), 1e-4)
+    p_cur = np.maximum(p_cur / len(cur), 1e-4)
+    return float(((p_cur - p_ref) * np.log(p_cur / p_ref)).sum())
+
+
+def drift_report(
+    cols: Dict[str, np.ndarray],
+    split_us: Optional[int] = None,
+    threshold: float = 0.5,
+) -> dict:
+    """Score/volume drift between a reference window and the current one.
+
+    The serving-side health check the reference's stack has no analogue
+    for: compares the analyzed output BEFORE ``split_us`` (default: the
+    time-midpoint) against AFTER it — PSI of the prediction
+    distribution, amount distribution, and the flag-rate/volume deltas.
+    A shifted score distribution (PSI > 0.25) is the canonical retrain
+    trigger."""
+    n = len(cols.get("tx_id", ()))
+    if n == 0:
+        return {"transactions": 0}
+    t = cols["tx_datetime_us"]
+    if split_us is None:
+        split_us = int((int(t.min()) + int(t.max())) // 2)
+    before = t < split_us
+    after = ~before
+    pred, amount = cols["prediction"], cols["tx_amount"]
+    out = {
+        "split_us": int(split_us),
+        "reference_rows": int(before.sum()),
+        "current_rows": int(after.sum()),
+        "threshold": float(threshold),
+    }
+    if not (before.any() and after.any()):
+        # one window is empty (e.g. all rows share a timestamp): there is
+        # no comparison — say so, never a confident "stable"
+        out["valid"] = False
+        out["drifting"] = None
+        return out
+    out["valid"] = True
+    out["prediction_psi"] = round(_psi(pred[before], pred[after]), 4)
+    out["amount_psi"] = round(_psi(amount[before], amount[after]), 4)
+    out["mean_score_delta"] = round(
+        float(pred[after].mean() - pred[before].mean()), 4)
+    out["flag_rate_before"] = round(
+        float((pred[before] >= threshold).mean()), 4)
+    out["flag_rate_after"] = round(
+        float((pred[after] >= threshold).mean()), 4)
+    out["drifting"] = bool(out["prediction_psi"] > 0.25)
+    return out
+
+
 def report(
     cols: Dict[str, np.ndarray],
     kind: str = "summary",
@@ -220,6 +284,8 @@ def report(
     """Dispatch a named dashboard report; arrays JSON-ready (lists)."""
     if kind == "summary":
         return summary_stats(cols, threshold)
+    if kind == "drift":
+        return drift_report(cols, threshold=threshold)
     if kind not in ("timeseries", "terminals", "customers", "alerts"):
         raise ValueError(f"unknown report kind {kind}")
     if not cols or len(cols.get("tx_id", ())) == 0:

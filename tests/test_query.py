@@ -74,6 +74,44 @@ def test_recent_alerts(analyzed):
     assert (alerts["prediction"] >= 0.5).all()
 
 
+def test_drift_report():
+    from real_time_fraud_detection_system_tpu.io.query import (
+        _psi,
+        drift_report,
+    )
+
+    rng = np.random.default_rng(0)
+    n = 4000
+    # identical halves → stable
+    same = rng.beta(0.5, 5, n)
+    assert _psi(same[: n // 2], same[n // 2:]) < 0.1
+    # shifted current window → drifting
+    shifted = np.concatenate([same[: n // 2], same[n // 2:] * 0.2 + 0.7])
+    cols = {
+        "tx_id": np.arange(n, dtype=np.int64),
+        "tx_datetime_us": np.arange(n, dtype=np.int64) * _US_HOUR,
+        "customer_id": np.zeros(n, dtype=np.int64),
+        "terminal_id": np.zeros(n, dtype=np.int64),
+        "tx_amount": rng.gamma(2.0, 30.0, n),
+        "prediction": shifted,
+    }
+    rep = drift_report(cols)
+    assert rep["drifting"] is True
+    assert rep["prediction_psi"] > 0.25
+    assert rep["reference_rows"] + rep["current_rows"] == n
+    # stable predictions → not drifting
+    cols["prediction"] = same
+    assert drift_report(cols)["drifting"] is False
+    assert drift_report({"tx_id": np.zeros(0)}) == {"transactions": 0}
+    # threshold is honored in the flag-rate deltas
+    hi = drift_report(cols, threshold=0.99)
+    assert hi["flag_rate_before"] == 0.0 and hi["flag_rate_after"] == 0.0
+    # degenerate split (all rows one timestamp) → invalid, NOT "stable"
+    cols["tx_datetime_us"] = np.zeros(n, dtype=np.int64)
+    degen = drift_report(cols)
+    assert degen["valid"] is False and degen["drifting"] is None
+
+
 def test_report_dispatch_and_cli(analyzed, tmp_path):
     assert report(analyzed, "summary")["transactions"] == 8
     assert isinstance(report(analyzed, "terminals")["terminal_id"], list)
