@@ -33,6 +33,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from real_time_fraud_detection_system_tpu.io.query import (
+    drift_report,
     fraud_rate_over_time,
     load_analyzed,
     recent_alerts,
@@ -227,7 +228,7 @@ def _hbar_chart(labels: List[str], values: np.ndarray, counts: np.ndarray,
             f"y2='{h}'/>" + "".join(rows) + "</svg>")
 
 
-def _tiles(s: dict) -> str:
+def _tiles(s: dict, drift: Optional[dict] = None) -> str:
     if s.get("transactions", 0) == 0:
         return "<p class='empty'>no analyzed transactions</p>"
     thr = s["threshold"]
@@ -247,6 +248,23 @@ def _tiles(s: dict) -> str:
         subdiv = f"<div class='sub'>{_esc(sub)}</div>" if sub else ""
         out.append(f"<div class='tile'><div class='lbl'>{_esc(label)}</div>"
                    f"<div class='num'>{_esc(value)}</div>{subdiv}</div>")
+    if drift and drift.get("valid"):
+        # the documented PSI bands (_psi docstring): <0.1 stable,
+        # 0.1–0.25 drifting (early warning), >0.25 shifted. Status color
+        # rides ONLY the icon glyph; the word stays in text ink (status
+        # colors are sub-contrast for text on the light surface).
+        psi = drift["prediction_psi"]
+        if psi > 0.25:
+            badge = "<span class='ico serious'>▲</span> shifted"
+        elif psi > 0.1:
+            badge = "<span class='ico warning'>▲</span> drifting"
+        else:
+            badge = "<span class='ico good'>●</span> stable"
+        out.append(
+            "<div class='tile'><div class='lbl'>Score drift (PSI)</div>"
+            f"<div class='num'>{psi:.3f}</div>"
+            f"<div class='sub'>{badge} vs first half · amount PSI "
+            f"{drift['amount_psi']:.3f}</div></div>")
     return "<div class='tiles'>" + "".join(out) + "</div>"
 
 
@@ -257,6 +275,7 @@ _CSS = """
   --ink: #0b0b0b; --ink2: #52514e; --muted: #898781;
   --grid: #e1e0d9; --axis: #c3c2b7;
   --s1: #2a78d6; --border: rgba(11,11,11,0.10);
+  --st-good: #0ca30c; --st-warn: #fab219; --st-serious: #ec835a;
   font: 14px/1.45 system-ui, -apple-system, "Segoe UI", sans-serif;
   color: var(--ink); background: var(--plane);
   margin: 0; padding: 24px; min-height: 100vh; box-sizing: border-box;
@@ -275,6 +294,9 @@ _CSS = """
 .tile .lbl { color: var(--ink2); font-size: 12px; }
 .tile .num { font-size: 26px; font-weight: 600; }
 .tile .sub { color: var(--muted); font-size: 12px; }
+.ico.good { color: var(--st-good); }
+.ico.warning { color: var(--st-warn); }
+.ico.serious { color: var(--st-serious); }
 .cards { display: grid; gap: 16px;
   grid-template-columns: repeat(auto-fit, minmax(360px, 1fr)); }
 .card { background: var(--surface); border: 1px solid var(--border);
@@ -341,6 +363,7 @@ def render_dashboard_html(
     """Render the full dashboard for an analyzed column dict."""
     s = summary_stats(cols, threshold)
     n = s.get("transactions", 0)
+    drift = drift_report(cols, threshold=threshold) if n else None
     gen = time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime())
     parts = [
         "<!doctype html><html><head><meta charset='utf-8'>",
@@ -350,7 +373,7 @@ def render_dashboard_html(
         f"<h1>{_esc(title)}</h1>",
         f"<div class='meta'>generated {gen} · threshold "
         f"{threshold:g} · bucket {_esc(bucket)}</div>",
-        _tiles(s),
+        _tiles(s, drift),
     ]
     if n:
         lab = _day_label if bucket == "day" else _hour_label

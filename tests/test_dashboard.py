@@ -62,6 +62,9 @@ def test_render_full(analyzed):
     assert "prefers-color-scheme: dark" in htm
     # the hot terminal (20) appears in the bar chart rows
     assert "terminal 20" in htm
+    # drift tile present with a status word (never color alone)
+    assert "Score drift (PSI)" in htm
+    assert any(w in htm for w in ("stable", "drifting", "shifted"))
 
 
 def test_render_is_wellformed_xml(analyzed):
