@@ -117,7 +117,14 @@ def cmd_train(args) -> int:
             epochs=args.epochs,
         )
     )
-    model, metrics = train_model(txs, cfg, kind=args.model)
+    if args.model == "sequence":
+        from real_time_fraud_detection_system_tpu.models.train import (
+            train_sequence_model,
+        )
+
+        model, metrics = train_sequence_model(txs, cfg)
+    else:
+        model, metrics = train_model(txs, cfg, kind=args.model)
     save_model(args.out_model, model)
     log.info("model=%s metrics=%s -> %s", args.model,
              {k: round(v, 4) for k, v in metrics.items()}, args.out_model)
@@ -157,6 +164,25 @@ def cmd_score(args) -> int:
                   "not compose with --devices > 1 (the sharded engine "
                   "always scores on-device)")
         return 2
+
+    if model.kind == "sequence":
+        # fail fast with the CLI convention instead of constructor
+        # tracebacks (the engines raise the same constraints)
+        bad = None
+        if args.scorer == "cpu":
+            bad = ("--scorer cpu does not apply to kind='sequence' "
+                   "(no sklearn oracle for the transformer)")
+        elif args.devices > 1:
+            bad = ("multi-device serving is not wired for "
+                   "kind='sequence' yet — drop --devices")
+        elif args.online_lr > 0:
+            bad = "online SGD is not wired for kind='sequence'"
+        elif args.feedback_bootstrap:
+            bad = ("the labeled-feedback loop is not wired for "
+                   "kind='sequence'")
+        if bad:
+            log.error(bad)
+            return 2
 
     feature_cache = None
     make_feedback = None
@@ -703,7 +729,7 @@ def main(argv=None) -> int:
     p.add_argument("--data", required=True)
     p.add_argument("--model", default="forest",
                    choices=["logreg", "mlp", "tree", "forest", "gbt",
-                            "autoencoder"])
+                            "autoencoder", "sequence"])
     p.add_argument("--out-model", required=True)
     p.add_argument("--delta-train", type=int, default=153)
     p.add_argument("--delta-delay", type=int, default=30)

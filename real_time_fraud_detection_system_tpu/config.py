@@ -79,6 +79,10 @@ class FeatureConfig:
     # overestimate-only error. Terminal risk always uses the table (the
     # sketch holds no fraud sums).
     customer_source: str = "table"
+    # Per-customer event-history ring length for the sequence scorer
+    # (features/history.py) — the serving-side max_len of
+    # models/sequence.build_sequences.
+    history_len: int = 32
     # Canonical flag definitions (see module docstring).
     night_end_hour: int = 6
     weekend_start_weekday: int = 5  # Monday == 0
@@ -110,6 +114,11 @@ class ModelConfig:
     forest_n_trees: int = 100
     forest_max_depth: int = 8
     tree_max_depth: int = 2
+    # Sequence (causal transformer) family dims — models/sequence.py.
+    seq_d_model: int = 32
+    seq_n_heads: int = 2
+    seq_n_layers: int = 2
+    seq_d_ff: int = 64
     dtype: str = "float32"
     seed: int = 0
 

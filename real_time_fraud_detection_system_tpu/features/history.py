@@ -1,0 +1,198 @@
+"""HBM-resident per-customer event histories — long-context serving state.
+
+The sequence family (``models/sequence.py``, the live successor of the
+reference's dormant seq2seq fraud model, ``shared_functions.py:
+1312-1707``) scores a transaction from its card's event history. Offline
+that history comes from ``build_sequences`` over a full table; ONLINE it
+must live on-device and update per micro-batch, exactly like the window
+state. This module is that state:
+
+- a ring buffer of the last K event-feature vectors per customer slot
+  (``events [C+1, K, 8]``), with each cell's absolute event index
+  (``pos``) so partially-overwritten histories are detected, not
+  silently mixed;
+- one fused, fully-vectorized ``update_and_score``: sort the batch into
+  per-customer time order, scatter the new events, gather every row's
+  own causal history (events strictly up to and including itself — later
+  same-batch events are excluded by position), and score the row at its
+  own sequence position with the causal transformer.
+
+Event features mirror :func:`..models.sequence.event_features` channel
+for channel (amount, Δt, time-of-day/weekday phases, presence), so a
+transformer trained offline on ``build_sequences`` serves unchanged.
+
+Row ``C`` of every array is a write sink: padding rows route their
+scatters there, keeping scatter indices unique without host-side
+filtering.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from real_time_fraud_detection_system_tpu.config import FeatureConfig
+from real_time_fraud_detection_system_tpu.core.batch import TxBatch
+from real_time_fraud_detection_system_tpu.features.online import _slot
+from real_time_fraud_detection_system_tpu.models.sequence import (
+    N_EVENT_FEATURES,
+    transformer_logits,
+)
+
+
+class HistoryState(NamedTuple):
+    """Per-customer event ring buffers (+1 sink row for padded writes)."""
+
+    events: jnp.ndarray  # f32 [C+1, K, N_EVENT_FEATURES]
+    pos: jnp.ndarray  # int32 [C+1, K] — absolute event index in cell, -1 empty
+    count: jnp.ndarray  # int32 [C+1] — events written per slot
+    last_t: jnp.ndarray  # int32 [C+1] — epoch-seconds of newest event
+
+    @property
+    def capacity(self) -> int:
+        return int(self.events.shape[0]) - 1
+
+    @property
+    def history_len(self) -> int:
+        return int(self.events.shape[1])
+
+
+def init_history_state(cfg: FeatureConfig) -> HistoryState:
+    c, k = cfg.customer_capacity, cfg.history_len
+    return HistoryState(
+        events=jnp.zeros((c + 1, k, N_EVENT_FEATURES), jnp.float32),
+        pos=jnp.full((c + 1, k), -1, jnp.int32),
+        count=jnp.zeros(c + 1, jnp.int32),
+        last_t=jnp.zeros(c + 1, jnp.int32),
+    )
+
+
+def _event_features_dev(
+    amount: jnp.ndarray,  # f32 [B] dollars
+    day: jnp.ndarray,  # int32 [B]
+    tod_s: jnp.ndarray,  # int32 [B]
+    dt_s: jnp.ndarray,  # f32 [B] seconds since the previous event (0 first)
+) -> jnp.ndarray:
+    """[B, 8] — must match models.sequence.event_features bit-for-bit in
+    semantics (that fn computes dt via diff with first=0; here dt is
+    supplied because the previous event may live in state)."""
+    tod = tod_s.astype(jnp.float32) / 86400.0
+    weekday = ((day + 3) % 7).astype(jnp.float32) / 7.0
+    two_pi = 2.0 * np.pi
+    return jnp.stack(
+        [
+            jnp.log1p(jnp.maximum(amount, 0.0)),
+            amount / 100.0,
+            jnp.log1p(jnp.maximum(dt_s, 0.0)) / 10.0,
+            jnp.sin(two_pi * tod),
+            jnp.cos(two_pi * tod),
+            jnp.sin(two_pi * weekday),
+            jnp.cos(two_pi * weekday),
+            jnp.ones_like(tod),
+        ],
+        axis=1,
+    )
+
+
+def update_and_score(
+    state: HistoryState,
+    params,
+    batch: TxBatch,
+    cfg: FeatureConfig,
+) -> Tuple[HistoryState, jnp.ndarray]:
+    """One fused history-update + causal-score step (jit-safe).
+
+    Returns ``(new_state, probs [B])`` in the BATCH's row order, with
+    padded rows scored 0. Each row is scored from events strictly before
+    it plus itself — same-batch later events never leak in (their
+    absolute positions exceed the row's own).
+    """
+    c, k = state.capacity, state.history_len
+    b = batch.size
+    valid = batch.valid
+    slot = _slot(batch.customer_key, c, cfg.key_mode).astype(jnp.int32)
+    slot = jnp.where(valid, slot, c)  # padding → sink row
+    t_s = batch.day * 86400 + batch.tod_s  # int32, ok until 2038
+
+    # --- sort into (slot, time, row) order so same-customer rows form
+    # contiguous time-ordered groups
+    idx = jnp.arange(b, dtype=jnp.int32)
+    order = jnp.lexsort((idx, t_s, slot))
+    s_slot = slot[order]
+    s_t = t_s[order]
+    s_valid = valid[order]
+
+    first = jnp.concatenate(
+        [jnp.ones(1, bool), s_slot[1:] != s_slot[:-1]])
+    last = jnp.concatenate([s_slot[1:] != s_slot[:-1], jnp.ones(1, bool)])
+    seg_start = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(first, idx, 0))
+    seg_end = jax.lax.associative_scan(
+        jnp.minimum, jnp.where(last, idx, b - 1), reverse=True)
+    rank = idx - seg_start
+    gsize = seg_end - seg_start + 1
+
+    # --- Δt: rank 0 reaches back into state (0 for a brand-new customer)
+    prev_in_batch = jnp.concatenate([s_t[:1], s_t[:-1]])
+    has_state = state.count[s_slot] > 0
+    dt_state = jnp.where(has_state, s_t - state.last_t[s_slot], 0)
+    dt = jnp.where(rank == 0, dt_state, s_t - prev_in_batch)
+    f = _event_features_dev(
+        batch.amount[order],
+        batch.day[order],
+        batch.tod_s[order],
+        dt.astype(jnp.float32),
+    )
+
+    # --- scatter the new events at their absolute positions
+    p = state.count[s_slot] + rank  # absolute event index [B]
+    cell = p % k
+    # only the last K of an oversized group materialize (earlier ones
+    # would be overwritten anyway); keeps (slot, cell) pairs unique
+    write = s_valid & (rank >= gsize - k)
+    w_slot = jnp.where(write, s_slot, c)
+    events = state.events.at[w_slot, cell].set(f)
+    pos = state.pos.at[w_slot, cell].set(p)
+    count = state.count.at[w_slot].add(
+        jnp.where(s_valid & last, gsize, 0))
+    last_t = state.last_t.at[
+        jnp.where(s_valid & last, s_slot, c)].set(s_t)
+    new_state = HistoryState(
+        events=events, pos=pos, count=count, last_t=last_t)
+
+    # --- gather each row's causal history, left-aligned, own event last.
+    # Two sources: positions q >= count_old come from THIS batch's
+    # feature rows (only the newest K were scattered, and later same-
+    # batch events may already occupy ring cells); positions q <
+    # count_old come from the PRE-scatter buffer, where every position
+    # in (p - K, count_old) is guaranteed still present.
+    count_old = state.count[s_slot]  # [B] (pre-update)
+    length = jnp.minimum(p + 1, k)  # [B]
+    j = jnp.arange(k, dtype=jnp.int32)[None, :]
+    q = p[:, None] - (length[:, None] - 1) + j  # [B, K] absolute positions
+    in_batch = q >= count_old[:, None]
+    bidx = jnp.clip(seg_start[:, None] + (q - count_old[:, None]), 0, b - 1)
+    ev_batch = f[bidx]  # [B, K, F]
+    cellq = q % k
+    ev_old = state.events[s_slot[:, None], cellq]
+    pos_old = state.pos[s_slot[:, None], cellq]
+    ev = jnp.where(in_batch[..., None], ev_batch, ev_old)
+    ok = (q >= 0) & (q <= p[:, None]) & (in_batch | (pos_old == q))
+    hist = jnp.where(ok[..., None], ev, 0.0)
+    # Training semantics (build_sequences → event_features on the
+    # truncated window): the FIRST event of a window always has Δt = 0 —
+    # its true predecessor fell outside the window. Stored features keep
+    # the true Δt (correct for every other window position); patch the
+    # Δt channel of position 0 at gather time.
+    hist = hist.at[:, 0, 2].set(0.0)
+
+    logits = transformer_logits(params, hist)  # [B, K]
+    own = jnp.take_along_axis(
+        logits, (length - 1)[:, None], axis=1)[:, 0]
+    probs = jnp.where(s_valid, jax.nn.sigmoid(own), 0.0)
+
+    # --- back to the batch's original row order
+    return new_state, jnp.zeros(b, jnp.float32).at[order].set(probs)

@@ -54,6 +54,21 @@ def dump_model_bytes(model: TrainedModel) -> bytes:
         for i, (w, b) in enumerate(p.layers):
             arrays[f"w{i}"] = np.asarray(w)
             arrays[f"b{i}"] = np.asarray(b)
+    elif model.kind == "sequence":
+        import jax
+
+        blk = p.blocks[0]
+        meta["seq"] = {
+            "d_model": int(p.embed_w.shape[1]),
+            "n_in": int(p.embed_w.shape[0]),
+            "n_heads": int(blk.wq.shape[1]),
+            "n_layers": len(p.blocks),
+            "d_ff": int(blk.w1.shape[1]),
+        }
+        # leaves in canonical flatten order; structure is rebuilt from an
+        # init_transformer skeleton of the same dims at load
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(p)):
+            arrays[f"seq{i}"] = np.asarray(leaf)
     else:
         raise ValueError(f"unknown model kind {model.kind}")
     buf = _io.BytesIO()
@@ -170,6 +185,24 @@ def _load_model_npz(z) -> TrainedModel:
                 for i in range(meta["n_layers"])
             ],
             err_scale=jnp.asarray(z["err_scale"]),
+        )
+    elif kind == "sequence":
+        import jax
+
+        from real_time_fraud_detection_system_tpu.models.sequence import (
+            init_transformer,
+        )
+
+        dims = meta["seq"]
+        skeleton = init_transformer(
+            d_model=dims["d_model"], n_heads=dims["n_heads"],
+            n_layers=dims["n_layers"], d_ff=dims["d_ff"],
+            n_in=dims["n_in"],
+        )
+        treedef = jax.tree_util.tree_structure(skeleton)
+        n_leaves = treedef.num_leaves
+        params = jax.tree_util.tree_unflatten(
+            treedef, [jnp.asarray(z[f"seq{i}"]) for i in range(n_leaves)]
         )
     else:
         raise ValueError(f"unknown model kind {kind}")

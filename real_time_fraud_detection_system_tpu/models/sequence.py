@@ -83,6 +83,7 @@ def build_sequences(
     max_len: int = 128,
     min_len: int = 2,
     features: Optional[np.ndarray] = None,
+    start_epoch_s: int = 0,
 ) -> SequenceBatch:
     """Group transactions by customer, time-sorted, pad/truncate to max_len.
 
@@ -91,6 +92,12 @@ def build_sequences(
     the replay kernel) is concatenated onto the intrinsic event channels —
     the reference's FraudDataset fed engineered feature columns per event
     (``shared_functions.py:1312-1400``); terminal risk lives only there.
+
+    ``start_epoch_s`` anchors the table's relative ``tx_time_seconds`` to
+    absolute epoch time. Pass the real start epoch when the model will be
+    SERVED (``features/history.py`` computes weekday/time-of-day from
+    absolute timestamps — training on unanchored times rotates the
+    weekday phase channels between train and serve).
     """
     n_in = N_EVENT_FEATURES + (features.shape[1] if features is not None else 0)
     order = np.lexsort((txs.tx_time_seconds, txs.customer_id))
@@ -105,7 +112,8 @@ def build_sequences(
         sel = order[s:e][-max_len:]
         n = len(sel)
         f = event_features(
-            txs.amount_cents[sel] / 100.0, txs.tx_time_seconds[sel].astype(np.int64)
+            txs.amount_cents[sel] / 100.0,
+            txs.tx_time_seconds[sel].astype(np.int64) + start_epoch_s,
         )
         if features is not None:
             f = np.concatenate([f, features[sel].astype(np.float32)], axis=1)

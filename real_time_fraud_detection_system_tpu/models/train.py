@@ -374,6 +374,91 @@ def fit_and_assess(
     return model, metrics, fit_s, predict_s, probs
 
 
+def train_sequence_model(
+    txs: Transactions,
+    cfg: Config,
+    start_date: Optional[str] = None,
+) -> Tuple[TrainedModel, dict]:
+    """Offline training of the sequence (causal transformer) family.
+
+    Training sequences come from the TRAIN window only
+    (``build_sequences`` over those rows, per-customer last
+    ``history_len`` events). Evaluation is deliberately the ONLINE path:
+    the whole table streams through ``features/history.update_and_score``
+    — the exact serving step — and metrics are computed on the test
+    rows, so the reported numbers measure what serving will produce
+    (train/serve skew shows up here, not in production).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from real_time_fraud_detection_system_tpu.core.batch import make_batch
+    from real_time_fraud_detection_system_tpu.features.history import (
+        init_history_state,
+        update_and_score,
+    )
+    from real_time_fraud_detection_system_tpu.models.sequence import (
+        build_sequences,
+        train_transformer,
+    )
+
+    dtr, dde, dte = scale_split_to_txs(
+        txs,
+        cfg.train.delta_train_days,
+        cfg.train.delta_delay_days,
+        cfg.train.delta_test_days,
+    )
+    train_mask, test_mask = train_delay_test_split(
+        txs, delta_train=dtr, delta_delay=dde, delta_test=dte
+    )
+    from real_time_fraud_detection_system_tpu.utils.timing import (
+        date_to_epoch_s,
+    )
+
+    epoch0 = date_to_epoch_s(start_date or cfg.data.start_date)
+    m = cfg.model
+    seqs = build_sequences(
+        txs.slice(train_mask), max_len=cfg.features.history_len,
+        start_epoch_s=epoch0)
+    params = train_transformer(
+        seqs,
+        d_model=m.seq_d_model,
+        n_heads=m.seq_n_heads,
+        n_layers=m.seq_n_layers,
+        d_ff=m.seq_d_ff,
+        epochs=cfg.train.epochs,
+        seed=cfg.data.seed,
+    )
+
+    # serving-path evaluation: stream the table through the online step
+    t_us = txs.epoch_us(epoch0)
+    state = init_history_state(cfg.features)
+    step = jax.jit(update_and_score, static_argnums=(3,))
+    probs = np.zeros(txs.n, dtype=np.float64)
+    rows = 4096
+    for s in range(0, txs.n, rows):
+        e = min(s + rows, txs.n)
+        batch = make_batch(
+            customer_id=txs.customer_id[s:e],
+            terminal_id=txs.terminal_id[s:e],
+            tx_datetime_us=t_us[s:e],
+            amount_cents=txs.amount_cents[s:e],
+            pad_to=rows,
+        )
+        state, p = step(state, params, jax.tree.map(jnp.asarray, batch),
+                        cfg.features)
+        probs[s:e] = np.asarray(p)[: e - s]
+    metrics = performance_assessment(
+        txs.tx_fraud[test_mask],
+        probs[test_mask],
+        days=txs.tx_time_days[test_mask],
+        customer_ids=txs.customer_id[test_mask],
+    )
+    scaler = Scaler(mean=jnp.zeros(15, jnp.float32),
+                    scale=jnp.ones(15, jnp.float32))
+    return TrainedModel(kind="sequence", scaler=scaler, params=params), metrics
+
+
 def train_model(
     txs: Transactions,
     cfg: Config,

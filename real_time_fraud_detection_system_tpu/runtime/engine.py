@@ -145,6 +145,20 @@ class ScoringEngine:
         self.scorer = scorer or cfg.runtime.scorer
         self.cpu_model = cpu_model
         self.online_lr = online_lr
+        if kind == "sequence":
+            # Long-context serving: per-customer event histories in HBM
+            # scored by the causal transformer — a different state and
+            # step shape, built in its own branch.
+            if self.scorer == "cpu":
+                raise ValueError(
+                    "kind='sequence' has no sklearn oracle — "
+                    "--scorer cpu does not apply")
+            if online_lr > 0.0:
+                raise ValueError(
+                    "online SGD is not wired for kind='sequence'")
+            self._init_sequence(cfg, params, scaler, feature_state,
+                                feature_cache)
+            return
         # Optional runtime.feedback.FeatureCache: every scored row's raw
         # feature vector is cached for the labeled-feedback join.
         self.feature_cache = feature_cache
@@ -203,6 +217,43 @@ class ScoringEngine:
                     lambda p, gi: p - self.online_lr * has * gi, params, g
                 )
             return fstate, params, probs, feats
+
+        self._step = jax.jit(step, donate_argnums=(0,))
+
+    def _init_sequence(self, cfg, params, scaler, feature_state,
+                       feature_cache):
+        """kind='sequence' setup: HistoryState + fused history step.
+
+        The emitted feature matrix is all-zeros ([n, 15]) — the sequence
+        scorer consumes raw event channels, not the engineered features;
+        the analyzed schema stays stable for sinks/queries."""
+        from real_time_fraud_detection_system_tpu.features.history import (
+            init_history_state,
+            update_and_score,
+        )
+
+        if feature_cache is not None:
+            # FeedbackLoop scatters into FeatureState.terminal risk
+            # windows, which a HistoryState does not have
+            raise ValueError(
+                "the labeled-feedback loop is not wired for "
+                "kind='sequence'")
+        self.feature_cache = None
+        self._feedback_step = None
+        self._state_feedback_step = None
+        self.state = EngineState(
+            feature_state=feature_state or init_history_state(cfg.features),
+            params=params,
+            scaler=scaler,
+        )
+        self._predict = None
+        self._loss = None
+        fcfg = cfg.features
+
+        def step(hstate, params, scaler, batch: TxBatch):
+            hstate, probs = update_and_score(hstate, params, batch, fcfg)
+            feats = jnp.zeros((batch.size, N_FEATURES), jnp.float32)
+            return hstate, params, probs, feats
 
         self._step = jax.jit(step, donate_argnums=(0,))
 

@@ -87,6 +87,10 @@ class ShardedScoringEngine(ScoringEngine):
         online_lr: float = 0.0,
         feature_cache=None,
     ):
+        if kind == "sequence":
+            raise ValueError(
+                "multi-device serving is not wired for kind='sequence' "
+                "yet — serve it single-chip (no --devices)")
         super().__init__(
             cfg, kind, params, scaler, online_lr=online_lr,
             feature_cache=feature_cache,

@@ -1,0 +1,191 @@
+"""Online per-customer history state vs an offline causal oracle.
+
+The oracle re-builds, for every transaction, the exact last-K event
+history ending at that transaction (via the offline event_features on
+the full per-customer prefix) and scores it with the same transformer —
+what ``features/history.update_and_score`` must reproduce while
+streaming micro-batches.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from real_time_fraud_detection_system_tpu.config import FeatureConfig
+from real_time_fraud_detection_system_tpu.core.batch import make_batch
+from real_time_fraud_detection_system_tpu.features.history import (
+    HistoryState,
+    init_history_state,
+    update_and_score,
+)
+from real_time_fraud_detection_system_tpu.models.sequence import (
+    event_features,
+    init_transformer,
+    transformer_logits,
+)
+
+
+def _oracle_probs(params, cust, t_s, amount, k):
+    """Per-row causal score from the full offline history."""
+    import jax
+
+    n = len(cust)
+    out = np.zeros(n, dtype=np.float64)
+    for i in range(n):
+        hist_sel = [
+            j for j in range(i + 1)
+            if cust[j] == cust[i]
+        ][-k:]
+        f = event_features(amount[hist_sel], t_s[hist_sel])
+        x = np.zeros((1, k, f.shape[1]), np.float32)
+        x[0, : len(f)] = f
+        logits = transformer_logits(params, jnp.asarray(x))
+        out[i] = jax.nn.sigmoid(logits[0, len(f) - 1])
+    return out
+
+
+def _stream(cfg, params, cust, t_s, amount, batch_rows):
+    state = init_history_state(cfg)
+    n = len(cust)
+    probs = np.zeros(n)
+    for s in range(0, n, batch_rows):
+        e = min(s + batch_rows, n)
+        batch = make_batch(
+            customer_id=cust[s:e],
+            terminal_id=np.zeros(e - s, np.int64),
+            tx_datetime_us=(t_s[s:e] * 1_000_000).astype(np.int64),
+            amount_cents=(amount[s:e] * 100).astype(np.int64),
+            pad_to=batch_rows,
+        )
+        state, p = update_and_score(
+            state, params, jax.tree.map(jnp.asarray, batch), cfg)
+        probs[s:e] = np.asarray(p)[: e - s]
+    return state, probs
+
+
+import jax  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(0)
+    n, n_cust, k = 240, 13, 8
+    cfg = FeatureConfig(customer_capacity=64, terminal_capacity=64,
+                        history_len=k)
+    cust = rng.integers(0, n_cust, n).astype(np.int64)
+    # strictly increasing times so the stream is chronological (the
+    # engine contract), with whole-day jumps mixed in
+    t_s = np.cumsum(rng.integers(30, 40000, n)).astype(np.int64) + 20000 * 86400
+    amount = np.round(rng.gamma(2.0, 40.0, n), 2)
+    params = init_transformer(
+        d_model=16, n_heads=2, n_layers=1, d_ff=32, seed=3)
+    return cfg, params, cust, t_s, amount, k
+
+
+def test_streaming_matches_oracle_small_batches(setup):
+    cfg, params, cust, t_s, amount, k = setup
+    oracle = _oracle_probs(params, cust, t_s, amount, k)
+    _, online = _stream(cfg, params, cust, t_s, amount, batch_rows=16)
+    np.testing.assert_allclose(online, oracle, atol=3e-4)
+
+
+def test_streaming_matches_oracle_one_big_batch(setup):
+    """Whole table in ONE batch: every same-customer group is in-batch,
+    exercising the in-batch rank/Δt/position machinery end to end."""
+    cfg, params, cust, t_s, amount, k = setup
+    oracle = _oracle_probs(params, cust, t_s, amount, k)
+    _, online = _stream(cfg, params, cust, t_s, amount, batch_rows=256)
+    np.testing.assert_allclose(online, oracle, atol=3e-4)
+
+
+def test_batch_splits_are_equivalent(setup):
+    """The state stream is batch-size invariant."""
+    cfg, params, cust, t_s, amount, k = setup
+    _, a = _stream(cfg, params, cust, t_s, amount, batch_rows=32)
+    s1, b = _stream(cfg, params, cust, t_s, amount, batch_rows=64)
+    np.testing.assert_allclose(a, b, atol=1e-6)
+    # state invariants: counts total the rows, ring positions consistent
+    counts = np.asarray(s1.count)[:-1]
+    assert counts.sum() == len(cust)
+    pos = np.asarray(s1.pos)
+    cells_ok = (pos < 0) | (pos % cfg.history_len ==
+                            np.arange(cfg.history_len)[None, :])
+    assert cells_ok.all()
+
+
+def test_oversized_group_truncates_not_corrupts(setup):
+    """More than K events for one customer in ONE batch: the newest K
+    survive, scores still match the oracle (which truncates to last K)."""
+    cfg, params, *_ = setup
+    k = cfg.history_len
+    n = 3 * k
+    cust = np.zeros(n, dtype=np.int64)
+    t_s = (np.arange(n) * 1000 + 20000 * 86400).astype(np.int64)
+    amount = np.linspace(10, 500, n)
+    oracle = _oracle_probs(params, cust, t_s, amount, k)
+    _, online = _stream(cfg, params, cust, t_s, amount, batch_rows=n)
+    np.testing.assert_allclose(online, oracle, atol=3e-4)
+
+
+def test_sequence_serving_e2e_cli(tmp_path, capsys):
+    """The full long-context slice: train the transformer offline
+    (rtfds train --model sequence), then SERVE it through the engine
+    (rtfds score) with the HBM history state — scores written to
+    Parquet, checkpointing on."""
+    import json
+
+    from real_time_fraud_detection_system_tpu.cli import main
+
+    data = tmp_path / "txs.npz"
+    model = tmp_path / "seq.npz"
+    rc = main(["--platform", "cpu", "datagen", "--customers", "60",
+               "--terminals", "120", "--days", "30", "--out", str(data)])
+    assert rc == 0
+    rc = main(["--platform", "cpu", "train", "--data", str(data),
+               "--model", "sequence", "--delta-train", "14",
+               "--delta-delay", "4", "--delta-test", "8",
+               "--epochs", "2", "--out-model", str(model)])
+    assert rc == 0
+    metrics = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert metrics["model"] == "sequence"
+    assert 0.0 <= metrics["auc_roc"] <= 1.0
+    rc = main(["--platform", "cpu", "score", "--data", str(data),
+               "--model-file", str(model), "--scorer", "tpu",
+               "--out", str(tmp_path / "analyzed"),
+               "--checkpoint-dir", str(tmp_path / "ck")])
+    assert rc == 0
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert stats["rows"] > 0
+
+    from real_time_fraud_detection_system_tpu.io.query import load_analyzed
+
+    cols = load_analyzed(str(tmp_path / "analyzed"))
+    assert len(cols["tx_id"]) == stats["rows"]
+    p = cols["prediction"]
+    assert ((p >= 0) & (p <= 1)).all() and len(np.unique(p)) > 10
+
+    # invalid flag combinations fail fast with rc 2, not tracebacks
+    for extra in (["--scorer", "cpu"], ["--devices", "2"],
+                  ["--online-lr", "0.1"],
+                  ["--feedback-bootstrap", "b:9092"]):
+        rc = main(["--platform", "cpu", "score", "--data", str(data),
+                   "--model-file", str(model),
+                   "--out", str(tmp_path / "x")] + extra)
+        assert rc == 2, extra
+    capsys.readouterr()
+
+
+def test_padding_rows_do_not_touch_state(setup):
+    cfg, params, cust, t_s, amount, k = setup
+    state = init_history_state(cfg)
+    batch = make_batch(
+        customer_id=cust[:5], terminal_id=np.zeros(5, np.int64),
+        tx_datetime_us=(t_s[:5] * 1_000_000).astype(np.int64),
+        amount_cents=(amount[:5] * 100).astype(np.int64),
+        pad_to=16,
+    )
+    state2, probs = update_and_score(
+        state, params, jax.tree.map(jnp.asarray, batch), cfg)
+    assert (np.asarray(probs)[5:] == 0).all()
+    # only real customers' slots gained events (sink row absorbs padding)
+    assert np.asarray(state2.count)[:-1].sum() == 5
