@@ -172,9 +172,6 @@ def cmd_score(args) -> int:
         if args.scorer == "cpu":
             bad = ("--scorer cpu does not apply to kind='sequence' "
                    "(no sklearn oracle for the transformer)")
-        elif args.devices > 1:
-            bad = ("multi-device serving is not wired for "
-                   "kind='sequence' yet — drop --devices")
         elif args.online_lr > 0:
             bad = "online SGD is not wired for kind='sequence'"
         elif args.feedback_bootstrap:

@@ -102,6 +102,7 @@ def update_and_score(
     params,
     batch: TxBatch,
     cfg: FeatureConfig,
+    slot_fn=None,
 ) -> Tuple[HistoryState, jnp.ndarray]:
     """One fused history-update + causal-score step (jit-safe).
 
@@ -109,11 +110,18 @@ def update_and_score(
     padded rows scored 0. Each row is scored from events strictly before
     it plus itself — same-batch later events never leak in (their
     absolute positions exceed the row's own).
+
+    ``slot_fn(customer_key) -> slot`` overrides the key→slot mapping
+    (the sharded layout addresses a device-local block: owner shard
+    already selected, local slot = key // n_dev).
     """
     c, k = state.capacity, state.history_len
     b = batch.size
     valid = batch.valid
-    slot = _slot(batch.customer_key, c, cfg.key_mode).astype(jnp.int32)
+    if slot_fn is None:
+        slot = _slot(batch.customer_key, c, cfg.key_mode).astype(jnp.int32)
+    else:
+        slot = slot_fn(batch.customer_key).astype(jnp.int32)
     slot = jnp.where(valid, slot, c)  # padding → sink row
     t_s = batch.day * 86400 + batch.tod_s  # int32, ok until 2038
 

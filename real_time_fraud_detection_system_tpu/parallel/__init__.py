@@ -22,3 +22,7 @@ from real_time_fraud_detection_system_tpu.parallel.tensor_parallel import (  # n
 from real_time_fraud_detection_system_tpu.parallel.pipeline_parallel import (  # noqa: F401
     make_pipeline,
 )
+from real_time_fraud_detection_system_tpu.parallel.sequence_step import (  # noqa: F401
+    init_sharded_history_state,
+    make_sharded_sequence_step,
+)

@@ -1,0 +1,162 @@
+"""Multi-chip serving of the sequence (long-context) scorer.
+
+The history state is the easiest of the engine states to shard: it is
+keyed ONLY by customer, so with rows partitioned by ``customer % n_dev``
+(the same Kafka-partition→device affinity as the window state,
+``partition_batch_spill`` chunk 0) every update and gather is device-
+local — zero collectives on the common path. Transformer params are
+replicated (tiny next to the state).
+
+Hot-key spill chunks place rows on arbitrary devices; those run the
+ROUTED variant: (key, day, tod, amount) quadruples ride one
+``all_to_all`` to the customer's owner, the owner runs the same fused
+history step, and the probabilities ride the inverse ``all_to_all``
+back — exactly the terminal-routing pattern of :mod:`.step`.
+
+Cross-chunk semantics match the window state's: a spill chunk sees
+prior chunks' state updates, i.e. chunks behave like consecutive
+micro-batches. Within any one chunk the fused step time-sorts rows, so
+ordering semantics equal the single-chip engine whenever the source
+delivers per-customer rows in time order (the Kafka per-partition
+guarantee).
+
+Sharded layout: every :class:`~..features.history.HistoryState` leaf
+gains a leading device axis ([n_dev, cap_local+1, ...], sharded on it);
+each device block is a self-contained local HistoryState (with its own
+padding-sink row), so the single-chip kernel runs unchanged inside
+``shard_map``. Local slot for key k on its owner: ``(k // n_dev) &
+(cap_local - 1)`` — mirroring the window layout (``step.py``), and like
+it requiring ``key_mode="direct"``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from real_time_fraud_detection_system_tpu.config import Config
+from real_time_fraud_detection_system_tpu.core.batch import TxBatch
+from real_time_fraud_detection_system_tpu.parallel.mesh import (
+    compat_shard_map,
+)
+
+# NOTE: features.history imports models.sequence, which imports
+# parallel.ring_attention — importing history at module top would close
+# an import cycle through this package's __init__; defer to call time.
+
+
+def init_sharded_history_state(
+    cfg: Config, mesh: Mesh, axis: "str | tuple" = "data"
+):
+    """[n_dev, cap_local+1, ...] leaves, sharded on the device axis."""
+    from real_time_fraud_detection_system_tpu.features.history import (
+        init_history_state,
+    )
+
+    n_dev = int(mesh.devices.size)
+    fcfg = cfg.features
+    if fcfg.customer_capacity % n_dev:
+        raise ValueError("customer_capacity must divide by n_devices")
+    if fcfg.key_mode != "direct":
+        raise ValueError(
+            "sharded sequence serving requires key_mode='direct' "
+            "(owner = key % n_dev, local slot = key // n_dev)")
+    local = init_history_state(
+        dataclasses.replace(
+            fcfg, customer_capacity=fcfg.customer_capacity // n_dev))
+    stacked = jax.tree.map(
+        lambda a: jnp.broadcast_to(a[None], (n_dev,) + a.shape), local)
+    sh = NamedSharding(mesh, P(axis))
+    return jax.tree.map(lambda a: jax.device_put(a, sh), stacked)
+
+
+def shard_history_state(
+    state, mesh: Mesh, axis: "str | tuple" = "data"
+):
+    """Re-place an already-stacked state onto the mesh (checkpoint
+    restore)."""
+    sh = NamedSharding(mesh, P(axis))
+    return jax.tree.map(lambda a: jax.device_put(a, sh), state)
+
+
+def make_sharded_sequence_step(
+    cfg: Config,
+    mesh: Mesh,
+    axis: "str | tuple" = "data",
+    route: bool = False,
+):
+    """→ jitted ``step(hstate, params, batch) -> (hstate, probs)``.
+
+    ``batch`` leaves are [n_dev * B_local], sharded on axis 0 (the
+    engine's partitioned chunk). ``route=False`` expects owner-placed
+    rows; ``route=True`` exchanges rows to their owner first and routes
+    probabilities back (spill chunks).
+    """
+    from real_time_fraud_detection_system_tpu.features.history import (
+        init_history_state,
+        update_and_score,
+    )
+
+    n_dev = int(mesh.devices.size)
+    fcfg = cfg.features
+    cap_local = fcfg.customer_capacity // n_dev
+    lcfg = dataclasses.replace(fcfg, customer_capacity=cap_local)
+
+
+    def slot_fn(key):
+        return ((key // jnp.uint32(n_dev))
+                & jnp.uint32(cap_local - 1)).astype(jnp.int32)
+
+    def local_step(hstate, params, batch: TxBatch):
+        hs = jax.tree.map(lambda x: jnp.squeeze(x, 0), hstate)
+        bl = batch.customer_key.shape[0]
+
+        if route:
+            def xchg(x):
+                return jax.lax.all_to_all(
+                    x.reshape(n_dev, bl), axis, split_axis=0, concat_axis=0,
+                    tiled=False,
+                ).reshape(n_dev * bl)
+
+            dest = (batch.customer_key % jnp.uint32(n_dev)).astype(jnp.int32)
+            from real_time_fraud_detection_system_tpu.parallel.step import (
+                _route,
+            )
+
+            send_pos, _ = _route(dest, batch.valid, n_dev)
+
+            def scatter(x, fill=0):
+                buf = jnp.full((n_dev * bl,), fill, dtype=x.dtype)
+                return buf.at[send_pos].set(x)
+
+            rb = TxBatch(
+                customer_key=xchg(scatter(batch.customer_key)),
+                terminal_key=jnp.zeros(n_dev * bl, jnp.uint32),
+                day=xchg(scatter(batch.day)),
+                tod_s=xchg(scatter(batch.tod_s)),
+                amount=xchg(scatter(batch.amount)),
+                label=jnp.full(n_dev * bl, -1, jnp.int32),
+                valid=xchg(scatter(batch.valid, fill=False)),
+            )
+            hs, r_probs = update_and_score(hs, params, rb, lcfg, slot_fn)
+            probs = xchg(r_probs)[send_pos]
+        else:
+            hs, probs = update_and_score(hs, params, batch, lcfg, slot_fn)
+
+        return jax.tree.map(lambda x: x[None], hs), probs
+
+    state_spec = jax.tree.map(
+        lambda _: P(axis), init_history_state(lcfg))
+    batch_spec = jax.tree.map(
+        lambda _: P(axis),
+        TxBatch(*([0] * len(TxBatch._fields))))
+    fn = compat_shard_map(
+        local_step,
+        mesh,
+        (state_spec, P(), batch_spec),  # P() prefix: params replicated
+        (state_spec, P(axis)),
+    )
+    return jax.jit(fn, donate_argnums=(0,))

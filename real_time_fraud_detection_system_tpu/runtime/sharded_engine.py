@@ -87,10 +87,6 @@ class ShardedScoringEngine(ScoringEngine):
         online_lr: float = 0.0,
         feature_cache=None,
     ):
-        if kind == "sequence":
-            raise ValueError(
-                "multi-device serving is not wired for kind='sequence' "
-                "yet — serve it single-chip (no --devices)")
         super().__init__(
             cfg, kind, params, scaler, online_lr=online_lr,
             feature_cache=feature_cache,
@@ -100,16 +96,29 @@ class ShardedScoringEngine(ScoringEngine):
         self.n_dev = int(self.mesh.devices.size)
         if cfg.features.customer_capacity % self.n_dev:
             raise ValueError("customer_capacity must divide by n_devices")
-        if cfg.features.terminal_capacity % self.n_dev:
-            raise ValueError("terminal_capacity must divide by n_devices")
         # Default: 2× the balanced per-device load, so ordinary partition
-        # imbalance stays in ONE chunk (a spill chunk only sees prior
-        # chunks' in-batch state updates — same semantics as a follow-on
-        # micro-batch, but a needless divergence from the single-chip
-        # scatter-then-gather when the skew is mild).
+        # imbalance stays in ONE chunk (shared by both engine kinds).
         self.rows_per_shard = rows_per_shard or max(
             2 * -(-cfg.runtime.max_batch_rows // self.n_dev), 16
         )
+        if kind == "sequence":
+            # Long-context serving over the mesh: customer-owner-sharded
+            # history state, same partition/spill machinery, routed spill
+            # chunks exchange rows to their owner over ICI.
+            from real_time_fraud_detection_system_tpu.parallel.sequence_step import (
+                init_sharded_history_state,
+                make_sharded_sequence_step,
+            )
+
+            self.state.feature_state = init_sharded_history_state(
+                cfg, self.mesh, axis=self.axis)
+            self._seq_step = make_sharded_sequence_step(
+                cfg, self.mesh, axis=self.axis)
+            self._seq_step_routed = make_sharded_sequence_step(
+                cfg, self.mesh, axis=self.axis, route=True)
+            return
+        if cfg.features.terminal_capacity % self.n_dev:
+            raise ValueError("terminal_capacity must divide by n_devices")
         self.state.feature_state = shard_feature_state(
             init_feature_state(cfg.features), self.mesh, axis=self.axis
         )
@@ -144,6 +153,18 @@ class ShardedScoringEngine(ScoringEngine):
         ``Checkpointer.restore`` rebuilds leaves as plain device arrays;
         the sharded step wants them laid out over the mesh (jit would
         auto-reshard every call otherwise — correct but wasteful)."""
+        if self.kind == "sequence":
+            from real_time_fraud_detection_system_tpu.parallel.sequence_step import (
+                shard_history_state,
+            )
+
+            leaf = self.state.feature_state.count
+            sh = getattr(leaf, "sharding", None)
+            if not (isinstance(sh, NamedSharding) and sh.mesh.shape
+                    == self.mesh.shape):
+                self.state.feature_state = shard_history_state(
+                    self.state.feature_state, self.mesh, axis=self.axis)
+            return
         leaf = self.state.feature_state.customer.count
         sh = getattr(leaf, "sharding", None)
         if not (isinstance(sh, NamedSharding) and sh.mesh.shape
@@ -189,6 +210,21 @@ class ShardedScoringEngine(ScoringEngine):
             )
             batch = batch._replace(valid=part_cols["__valid__"])
             jbatch = jax.tree.map(jnp.asarray, batch)
+            if self.kind == "sequence":
+                step = (self._seq_step_routed
+                        if part_cols.get("__routed__", False)
+                        else self._seq_step)
+                hstate, probs = step(
+                    self.state.feature_state, self.state.params, jbatch)
+                self.state.feature_state = hstate
+                # host-side zeros: the sequence scorer has no engineered
+                # feature matrix, and _finish_batch's buffer is already 0
+                parts.append((
+                    rows, pos, probs,
+                    np.zeros((len(part_cols["__valid__"]), N_FEATURES),
+                             np.float32),
+                ))
+                continue
             if part_cols.get("__routed__", False):
                 if self._sharded_step_routed is None:
                     self._sharded_step_routed = self._sharded_build_routed(
@@ -237,6 +273,10 @@ class ShardedScoringEngine(ScoringEngine):
         (owner shard × local slot, mirroring ``parallel/step.py``). The
         scatter runs as a plain jitted global-array op — GSPMD inserts the
         (off-hot-path) collectives."""
+        if self.kind == "sequence":
+            raise ValueError(
+                "the labeled-feedback loop is not wired for "
+                "kind='sequence'")
         labels = np.asarray(labels)
         mask = labels >= 0
         if not mask.any():

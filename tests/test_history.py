@@ -165,8 +165,7 @@ def test_sequence_serving_e2e_cli(tmp_path, capsys):
     assert ((p >= 0) & (p <= 1)).all() and len(np.unique(p)) > 10
 
     # invalid flag combinations fail fast with rc 2, not tracebacks
-    for extra in (["--scorer", "cpu"], ["--devices", "2"],
-                  ["--online-lr", "0.1"],
+    for extra in (["--scorer", "cpu"], ["--online-lr", "0.1"],
                   ["--feedback-bootstrap", "b:9092"]):
         rc = main(["--platform", "cpu", "score", "--data", str(data),
                    "--model-file", str(model),
