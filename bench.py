@@ -389,6 +389,48 @@ def _child_main(args) -> None:
                 ),
             }
 
+    # ---- long-context scorer: sequence serving throughput --------------
+    # The fused history step (features/history.py): per-customer ring
+    # update + causal-transformer score per row. Guarded — a failure here
+    # must never discard the headline numbers.
+    _progress("sequence scorer")
+    seq_stats = None
+    try:
+        from real_time_fraud_detection_system_tpu.features.history import (
+            init_history_state,
+            update_and_score,
+        )
+        from real_time_fraud_detection_system_tpu.models.sequence import (
+            init_transformer,
+        )
+
+        seq_rows = 4096 if (args.quick or on_cpu) else 65536
+        seq_cfg = FeatureConfig(
+            customer_capacity=8192, terminal_capacity=1024, history_len=32)
+        tparams = init_transformer(
+            d_model=32, n_heads=2, n_layers=2, d_ff=64, seed=0)
+        seq_step = jax.jit(update_and_score, static_argnums=(3,),
+                           donate_argnums=(0,))
+        sc = _make_batch_cols(rng, seq_rows)
+        sbatch2 = jax.tree.map(jnp.asarray, make_batch(**sc))
+        hstate = init_history_state(seq_cfg)
+        hstate, sp = seq_step(hstate, tparams, sbatch2, seq_cfg)
+        jax.block_until_ready(sp)
+        seq_iters = 2 if (args.quick or on_cpu) else 20
+        t0 = time.perf_counter()
+        for _ in range(seq_iters):
+            hstate, sp = seq_step(hstate, tparams, sbatch2, seq_cfg)
+        jax.block_until_ready(sp)
+        seq_wall = time.perf_counter() - t0
+        seq_stats = {
+            "txns_per_sec": round(seq_iters * seq_rows / seq_wall, 1),
+            "batch_rows": seq_rows,
+            "history_len": seq_cfg.history_len,
+            "d_model": 32,
+        }
+    except Exception as e:
+        seq_stats = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+
     # ---- host ingress: Debezium envelope decode rate --------------------
     # SURVEY's hard part: 1M txns/s of JSON envelopes bottlenecks on parse
     # before the TPU; the C++ scanner is the line-rate path.
@@ -453,6 +495,8 @@ def _child_main(args) -> None:
         "ingest_decoder": "native" if native.native_available() else
         "python",
     }
+    if seq_stats is not None:
+        detail["sequence_scorer"] = seq_stats
     if cpu_tps is not None:
         detail["cpu_sklearn_txns_per_sec"] = round(cpu_tps, 1)
         detail["cpu_baseline_rows"] = base_rows

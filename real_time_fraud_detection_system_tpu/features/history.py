@@ -103,6 +103,7 @@ def update_and_score(
     batch: TxBatch,
     cfg: FeatureConfig,
     slot_fn=None,
+    order_key: "jnp.ndarray | None" = None,
 ) -> Tuple[HistoryState, jnp.ndarray]:
     """One fused history-update + causal-score step (jit-safe).
 
@@ -114,6 +115,12 @@ def update_and_score(
     ``slot_fn(customer_key) -> slot`` overrides the key→slot mapping
     (the sharded layout addresses a device-local block: owner shard
     already selected, local slot = key // n_dev).
+
+    ``order_key`` [B] int32 breaks same-second timestamp ties (default:
+    the row index). The routed sharded path passes each row's ORIGINAL
+    chunk position, because the all_to_all regroups rows source-device-
+    major — without it, same-second events of one customer could land in
+    the ring in a different order than the single-chip engine's.
     """
     c, k = state.capacity, state.history_len
     b = batch.size
@@ -125,10 +132,11 @@ def update_and_score(
     slot = jnp.where(valid, slot, c)  # padding → sink row
     t_s = batch.day * 86400 + batch.tod_s  # int32, ok until 2038
 
-    # --- sort into (slot, time, row) order so same-customer rows form
+    # --- sort into (slot, time, tie) order so same-customer rows form
     # contiguous time-ordered groups
     idx = jnp.arange(b, dtype=jnp.int32)
-    order = jnp.lexsort((idx, t_s, slot))
+    tie = idx if order_key is None else order_key.astype(jnp.int32)
+    order = jnp.lexsort((tie, t_s, slot))
     s_slot = slot[order]
     s_t = t_s[order]
     s_valid = valid[order]

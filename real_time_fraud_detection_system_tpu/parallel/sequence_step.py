@@ -88,12 +88,15 @@ def make_sharded_sequence_step(
     axis: "str | tuple" = "data",
     route: bool = False,
 ):
-    """→ jitted ``step(hstate, params, batch) -> (hstate, probs)``.
+    """→ jitted ``step(hstate, params, batch, order_key) -> (hstate, probs)``.
 
     ``batch`` leaves are [n_dev * B_local], sharded on axis 0 (the
-    engine's partitioned chunk). ``route=False`` expects owner-placed
-    rows; ``route=True`` exchanges rows to their owner first and routes
-    probabilities back (spill chunks).
+    engine's partitioned chunk); ``order_key`` [n_dev * B_local] int32
+    carries each row's ORIGINAL batch position (the same-second
+    tiebreaker — chunk packing and routing both permute rows).
+    ``route=False`` expects owner-placed rows; ``route=True`` exchanges
+    rows to their owner first and routes probabilities back (spill
+    chunks).
     """
     from real_time_fraud_detection_system_tpu.features.history import (
         init_history_state,
@@ -110,28 +113,18 @@ def make_sharded_sequence_step(
         return ((key // jnp.uint32(n_dev))
                 & jnp.uint32(cap_local - 1)).astype(jnp.int32)
 
-    def local_step(hstate, params, batch: TxBatch):
+    def local_step(hstate, params, batch: TxBatch, order_key):
+        from real_time_fraud_detection_system_tpu.parallel.step import (
+            owner_route,
+        )
+
         hs = jax.tree.map(lambda x: jnp.squeeze(x, 0), hstate)
         bl = batch.customer_key.shape[0]
 
         if route:
-            def xchg(x):
-                return jax.lax.all_to_all(
-                    x.reshape(n_dev, bl), axis, split_axis=0, concat_axis=0,
-                    tiled=False,
-                ).reshape(n_dev * bl)
-
             dest = (batch.customer_key % jnp.uint32(n_dev)).astype(jnp.int32)
-            from real_time_fraud_detection_system_tpu.parallel.step import (
-                _route,
-            )
-
-            send_pos, _ = _route(dest, batch.valid, n_dev)
-
-            def scatter(x, fill=0):
-                buf = jnp.full((n_dev * bl,), fill, dtype=x.dtype)
-                return buf.at[send_pos].set(x)
-
+            send_pos, xchg, scatter = owner_route(
+                dest, batch.valid, n_dev, axis, bl)
             rb = TxBatch(
                 customer_key=xchg(scatter(batch.customer_key)),
                 terminal_key=jnp.zeros(n_dev * bl, jnp.uint32),
@@ -141,22 +134,32 @@ def make_sharded_sequence_step(
                 label=jnp.full(n_dev * bl, -1, jnp.int32),
                 valid=xchg(scatter(batch.valid, fill=False)),
             )
-            hs, r_probs = update_and_score(hs, params, rb, lcfg, slot_fn)
+            # the ORIGINAL batch row index rides along as the same-second
+            # tiebreaker — both the dense spill packing (round-robin
+            # across devices) and the all_to_all regrouping would
+            # otherwise reorder ties relative to the single-chip engine
+            r_order = xchg(scatter(order_key))
+            hs, r_probs = update_and_score(
+                hs, params, rb, lcfg, slot_fn, order_key=r_order)
             probs = xchg(r_probs)[send_pos]
         else:
-            hs, probs = update_and_score(hs, params, batch, lcfg, slot_fn)
+            hs, probs = update_and_score(
+                hs, params, batch, lcfg, slot_fn, order_key=order_key)
 
         return jax.tree.map(lambda x: x[None], hs), probs
 
+    # eval_shape: spec structure without allocating a throwaway state
     state_spec = jax.tree.map(
-        lambda _: P(axis), init_history_state(lcfg))
+        lambda _: P(axis),
+        jax.eval_shape(lambda: init_history_state(lcfg)))
     batch_spec = jax.tree.map(
         lambda _: P(axis),
         TxBatch(*([0] * len(TxBatch._fields))))
     fn = compat_shard_map(
         local_step,
         mesh,
-        (state_spec, P(), batch_spec),  # P() prefix: params replicated
+        # P() prefix: params replicated; order_key sharded like the batch
+        (state_spec, P(), batch_spec, P(axis)),
         (state_spec, P(axis)),
     )
     return jax.jit(fn, donate_argnums=(0,))

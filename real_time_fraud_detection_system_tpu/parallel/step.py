@@ -149,6 +149,34 @@ def _route(
     return dest * b + rank, rank
 
 
+def owner_route(
+    dest: jnp.ndarray,  # int32 [bl] owner device per row
+    valid: jnp.ndarray,  # bool [bl]
+    n_dev: int,
+    axis,
+    bl: int,
+):
+    """Bucketed-``all_to_all`` primitives shared by the window and
+    sequence routed paths: → (send_pos, xchg, scatter).
+
+    ``scatter(x)`` lays local rows into the [n_dev × bl] send buffer at
+    their owner bucket; ``xchg`` runs the all_to_all (its own inverse,
+    so routing results back is ``xchg(...)[send_pos]``)."""
+    send_pos, _ = _route(dest, valid, n_dev)
+
+    def xchg(x):
+        return jax.lax.all_to_all(
+            x.reshape(n_dev, bl), axis, split_axis=0, concat_axis=0,
+            tiled=False,
+        ).reshape(n_dev * bl)
+
+    def scatter(x, fill=0):
+        buf = jnp.full((n_dev * bl,), fill, dtype=x.dtype)
+        return buf.at[send_pos].set(x)
+
+    return send_pos, xchg, scatter
+
+
 def make_sharded_step(
     cfg: Config,
     predict_fn: Callable,
@@ -193,22 +221,13 @@ def make_sharded_step(
         bl = batch.customer_key.shape[0]
         fraud = jnp.maximum(batch.label, 0).astype(jnp.float32)
 
-        def xchg(x):
-            return jax.lax.all_to_all(
-                x.reshape(n_dev, bl), axis, split_axis=0, concat_axis=0,
-                tiled=False,
-            ).reshape(n_dev * bl)
-
         def owner_exchange(key):
             """Route (key, day, amount, fraud, valid) to the key's owner
             device; returns received fields + a ``back`` that routes
             per-row [*, NW] aggregates to the sending rows."""
             dest = (key % jnp.uint32(n_dev)).astype(jnp.int32)
-            send_pos, _rank = _route(dest, batch.valid, n_dev)
-
-            def scatter(x, fill=0):
-                buf = jnp.full((n_dev * bl,), fill, dtype=x.dtype)
-                return buf.at[send_pos].set(x)
+            send_pos, xchg, scatter = owner_route(
+                dest, batch.valid, n_dev, axis, bl)
 
             r_key = xchg(scatter(key))
             r_day = xchg(scatter(batch.day))

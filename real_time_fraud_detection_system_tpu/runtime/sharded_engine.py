@@ -87,11 +87,22 @@ class ShardedScoringEngine(ScoringEngine):
         online_lr: float = 0.0,
         feature_cache=None,
     ):
+        mesh = mesh if mesh is not None else make_mesh(n_devices)
+        pre_state = None
+        if kind == "sequence":
+            # build the owner-sharded state FIRST and hand it to the base
+            # constructor — a throwaway full-size single-chip HistoryState
+            # would transiently double the state's HBM footprint
+            from real_time_fraud_detection_system_tpu.parallel.sequence_step import (
+                init_sharded_history_state,
+            )
+
+            pre_state = init_sharded_history_state(cfg, mesh, axis=axis)
         super().__init__(
-            cfg, kind, params, scaler, online_lr=online_lr,
-            feature_cache=feature_cache,
+            cfg, kind, params, scaler, feature_state=pre_state,
+            online_lr=online_lr, feature_cache=feature_cache,
         )
-        self.mesh = mesh if mesh is not None else make_mesh(n_devices)
+        self.mesh = mesh
         self.axis = axis
         self.n_dev = int(self.mesh.devices.size)
         if cfg.features.customer_capacity % self.n_dev:
@@ -106,12 +117,11 @@ class ShardedScoringEngine(ScoringEngine):
             # history state, same partition/spill machinery, routed spill
             # chunks exchange rows to their owner over ICI.
             from real_time_fraud_detection_system_tpu.parallel.sequence_step import (
-                init_sharded_history_state,
                 make_sharded_sequence_step,
             )
 
-            self.state.feature_state = init_sharded_history_state(
-                cfg, self.mesh, axis=self.axis)
+            # feature_state is already the owner-sharded HistoryState
+            # (pre_state above)
             self._seq_step = make_sharded_sequence_step(
                 cfg, self.mesh, axis=self.axis)
             self._seq_step_routed = make_sharded_sequence_step(
@@ -214,16 +224,17 @@ class ShardedScoringEngine(ScoringEngine):
                 step = (self._seq_step_routed
                         if part_cols.get("__routed__", False)
                         else self._seq_step)
+                # original batch row index per chunk slot — the
+                # same-second tiebreaker (chunk packing permutes rows)
+                okey = np.zeros(len(part_cols["__valid__"]), np.int32)
+                okey[pos] = rows.astype(np.int32)
                 hstate, probs = step(
-                    self.state.feature_state, self.state.params, jbatch)
+                    self.state.feature_state, self.state.params, jbatch,
+                    jnp.asarray(okey))
                 self.state.feature_state = hstate
-                # host-side zeros: the sequence scorer has no engineered
-                # feature matrix, and _finish_batch's buffer is already 0
-                parts.append((
-                    rows, pos, probs,
-                    np.zeros((len(part_cols["__valid__"]), N_FEATURES),
-                             np.float32),
-                ))
+                # the sequence scorer has no engineered feature matrix;
+                # None skips the feats copy (_finish_batch's buffer is 0)
+                parts.append((rows, pos, probs, None))
                 continue
             if part_cols.get("__routed__", False):
                 if self._sharded_step_routed is None:
@@ -255,7 +266,8 @@ class ShardedScoringEngine(ScoringEngine):
         feats_np = np.zeros((n, N_FEATURES), dtype=np.float32)
         for rows, pos, probs, feats in handle["parts"]:
             probs_np[rows] = np.asarray(probs)[pos]
-            feats_np[rows] = np.asarray(feats)[pos]
+            if feats is not None:
+                feats_np[rows] = np.asarray(feats)[pos]
         return self._emit_result(handle, probs_np, feats_np)
 
     # -- feedback into the owner-partitioned terminal table ----------------

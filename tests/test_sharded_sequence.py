@@ -105,6 +105,35 @@ def test_sharded_sequence_hot_key_spill(params):
     assert len(r2.probs) == rows
 
 
+def test_sharded_sequence_spill_same_second_ties(params):
+    """Same-second bursts from one hot customer must land in the ring in
+    the single-chip order — the routed all_to_all regroups rows source-
+    device-major, so the exchanged chunk-position tiebreaker is what
+    keeps parity (regression for the ordering bug)."""
+    cfg = _cfg(rows=64)
+    rows = 64
+    t_s = np.full(rows, 20000 * 86400 + 1234, dtype=np.int64)  # ONE second
+    amounts = (np.arange(rows) * 137 + 100).astype(np.int64)
+    cols = {
+        "tx_id": np.arange(rows, dtype=np.int64),
+        "tx_datetime_us": (t_s * 1_000_000).astype(np.int64),
+        "customer_id": np.full(rows, 3, dtype=np.int64),
+        "terminal_id": np.zeros(rows, dtype=np.int64),
+        "tx_amount_cents": amounts,
+        "kafka_ts_ms": (t_s * 1000).astype(np.int64),
+    }
+    single = ScoringEngine(cfg, kind="sequence", params=params,
+                           scaler=_scaler())
+    sharded = ShardedScoringEngine(cfg, kind="sequence", params=params,
+                                   scaler=_scaler(), n_devices=8,
+                                   rows_per_shard=8)
+    r1 = single.process_batch(dict(cols))
+    r2 = sharded.process_batch(dict(cols))
+    o1 = np.argsort(r1.tx_id)
+    o2 = np.argsort(r2.tx_id)
+    np.testing.assert_allclose(r2.probs[o2], r1.probs[o1], atol=1e-5)
+
+
 def test_sharded_sequence_feedback_not_wired(params):
     eng = ShardedScoringEngine(_cfg(), kind="sequence", params=params,
                                scaler=_scaler(), n_devices=2)
