@@ -561,6 +561,7 @@ def cmd_compare(args) -> int:
     )
     from real_time_fraud_detection_system_tpu.models.train import (
         fit_and_assess,
+        fit_and_assess_sequence,
         scale_split_to_txs,
         train_delay_test_split,
     )
@@ -576,8 +577,13 @@ def cmd_compare(args) -> int:
             epochs=args.epochs,
         )
     )
-    features = compute_features_replay(
-        txs, cfg.features, start_date=cfg.data.start_date
+    # the sequence family scores from event histories, not the replayed
+    # aggregate features — skip the (minutes-at-scale) replay if no
+    # feature-matrix kind was requested
+    features = (
+        compute_features_replay(
+            txs, cfg.features, start_date=cfg.data.start_date)
+        if any(k != "sequence" for k in args.models) else None
     )
     dtr, dde, dte = scale_split_to_txs(
         txs, cfg.train.delta_train_days, cfg.train.delta_delay_days,
@@ -594,9 +600,14 @@ def cmd_compare(args) -> int:
         os.makedirs(args.plots_dir, exist_ok=True)
     rows = []
     for kind in args.models:
-        _, metrics, fit_s, pred_s, probs = fit_and_assess(
-            txs, features, cfg, kind, train_mask, test_mask
-        )
+        if kind == "sequence":
+            _, metrics, fit_s, pred_s, probs = fit_and_assess_sequence(
+                txs, cfg, train_mask, test_mask
+            )
+        else:
+            _, metrics, fit_s, pred_s, probs = fit_and_assess(
+                txs, features, cfg, kind, train_mask, test_mask
+            )
         row = {
             "model": kind,
             **{k: round(float(v), 4) for k, v in metrics.items()},
@@ -863,7 +874,7 @@ def main(argv=None) -> int:
     p.add_argument("--models", nargs="+",
                    default=["logreg", "tree", "forest", "gbt", "mlp"],
                    choices=["logreg", "mlp", "tree", "forest", "gbt",
-                            "autoencoder"])
+                            "autoencoder", "sequence"])
     p.add_argument("--delta-train", type=int, default=153)
     p.add_argument("--delta-delay", type=int, default=30)
     p.add_argument("--delta-test", type=int, default=30)

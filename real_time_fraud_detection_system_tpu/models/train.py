@@ -374,21 +374,20 @@ def fit_and_assess(
     return model, metrics, fit_s, predict_s, probs
 
 
-def train_sequence_model(
+def fit_and_assess_sequence(
     txs: Transactions,
     cfg: Config,
+    train_mask: np.ndarray,
+    test_mask: np.ndarray,
     start_date: Optional[str] = None,
-) -> Tuple[TrainedModel, dict]:
-    """Offline training of the sequence (causal transformer) family.
+) -> Tuple[TrainedModel, dict, float, float, np.ndarray]:
+    """Sequence-family counterpart of :func:`fit_and_assess`: train on
+    the train-window sequences, evaluate by streaming the table through
+    the ONLINE history step (the exact serving path — train/serve skew
+    shows up here, not in production). Returns (model, test metrics,
+    fit_seconds, predict_seconds, test_probs)."""
+    import time
 
-    Training sequences come from the TRAIN window only
-    (``build_sequences`` over those rows, per-customer last
-    ``history_len`` events). Evaluation is deliberately the ONLINE path:
-    the whole table streams through ``features/history.update_and_score``
-    — the exact serving step — and metrics are computed on the test
-    rows, so the reported numbers measure what serving will produce
-    (train/serve skew shows up here, not in production).
-    """
     import jax
     import jax.numpy as jnp
 
@@ -401,16 +400,6 @@ def train_sequence_model(
         build_sequences,
         train_transformer,
     )
-
-    dtr, dde, dte = scale_split_to_txs(
-        txs,
-        cfg.train.delta_train_days,
-        cfg.train.delta_delay_days,
-        cfg.train.delta_test_days,
-    )
-    train_mask, test_mask = train_delay_test_split(
-        txs, delta_train=dtr, delta_delay=dde, delta_test=dte
-    )
     from real_time_fraud_detection_system_tpu.utils.timing import (
         date_to_epoch_s,
     )
@@ -420,6 +409,7 @@ def train_sequence_model(
     seqs = build_sequences(
         txs.slice(train_mask), max_len=cfg.features.history_len,
         start_epoch_s=epoch0)
+    t0 = time.perf_counter()
     params = train_transformer(
         seqs,
         d_model=m.seq_d_model,
@@ -429,6 +419,7 @@ def train_sequence_model(
         epochs=cfg.train.epochs,
         seed=cfg.data.seed,
     )
+    fit_s = time.perf_counter() - t0
 
     # serving-path evaluation: stream the table through the online step
     t_us = txs.epoch_us(epoch0)
@@ -436,6 +427,7 @@ def train_sequence_model(
     step = jax.jit(update_and_score, static_argnums=(3,))
     probs = np.zeros(txs.n, dtype=np.float64)
     rows = 4096
+    t0 = time.perf_counter()
     for s in range(0, txs.n, rows):
         e = min(s + rows, txs.n)
         batch = make_batch(
@@ -448,6 +440,7 @@ def train_sequence_model(
         state, p = step(state, params, jax.tree.map(jnp.asarray, batch),
                         cfg.features)
         probs[s:e] = np.asarray(p)[: e - s]
+    predict_s = time.perf_counter() - t0
     metrics = performance_assessment(
         txs.tx_fraud[test_mask],
         probs[test_mask],
@@ -456,7 +449,29 @@ def train_sequence_model(
     )
     scaler = Scaler(mean=jnp.zeros(15, jnp.float32),
                     scale=jnp.ones(15, jnp.float32))
-    return TrainedModel(kind="sequence", scaler=scaler, params=params), metrics
+    model = TrainedModel(kind="sequence", scaler=scaler, params=params)
+    return model, metrics, fit_s, predict_s, probs[test_mask]
+
+
+def train_sequence_model(
+    txs: Transactions,
+    cfg: Config,
+    start_date: Optional[str] = None,
+) -> Tuple[TrainedModel, dict]:
+    """Offline training of the sequence (causal transformer) family —
+    see :func:`fit_and_assess_sequence` for the train/eval contract."""
+    dtr, dde, dte = scale_split_to_txs(
+        txs,
+        cfg.train.delta_train_days,
+        cfg.train.delta_delay_days,
+        cfg.train.delta_test_days,
+    )
+    train_mask, test_mask = train_delay_test_split(
+        txs, delta_train=dtr, delta_delay=dde, delta_test=dte
+    )
+    model, metrics, _, _, _ = fit_and_assess_sequence(
+        txs, cfg, train_mask, test_mask, start_date=start_date)
+    return model, metrics
 
 
 def train_model(
