@@ -117,14 +117,7 @@ def cmd_train(args) -> int:
             epochs=args.epochs,
         )
     )
-    if args.model == "sequence":
-        from real_time_fraud_detection_system_tpu.models.train import (
-            train_sequence_model,
-        )
-
-        model, metrics = train_sequence_model(txs, cfg)
-    else:
-        model, metrics = train_model(txs, cfg, kind=args.model)
+    model, metrics = train_model(txs, cfg, kind=args.model)
     save_model(args.out_model, model)
     log.info("model=%s metrics=%s -> %s", args.model,
              {k: round(v, 4) for k, v in metrics.items()}, args.out_model)
@@ -809,7 +802,7 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--model", default="forest",
                    choices=["logreg", "mlp", "tree", "forest", "gbt",
-                            "autoencoder"])
+                            "autoencoder", "sequence"])
     p.add_argument("--model-file", default="")
     p.add_argument("--delta-train", type=int, default=45)
     p.add_argument("--delta-delay", type=int, default=10)

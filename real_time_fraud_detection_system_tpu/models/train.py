@@ -482,6 +482,10 @@ def train_model(
 ) -> Tuple[TrainedModel, dict]:
     """End-to-end offline training; returns (model, test metrics)."""
     kind = kind or cfg.model.kind
+    if kind == "sequence":
+        # the sequence family trains on event histories, not the replayed
+        # aggregate features — dispatch before any replay work
+        return train_sequence_model(txs, cfg)
     if features is None:
         features = compute_features_replay(
             txs, cfg.features, start_date=cfg.data.start_date

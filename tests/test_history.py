@@ -174,6 +174,31 @@ def test_sequence_serving_e2e_cli(tmp_path, capsys):
     capsys.readouterr()
 
 
+def test_run_demo_sequence_kind():
+    """The full demo flow (datagen → CDC → sinks → scorer) serves the
+    sequence family end to end."""
+    from real_time_fraud_detection_system_tpu.config import (
+        Config,
+        DataConfig,
+        FeatureConfig,
+        TrainConfig,
+    )
+    from real_time_fraud_detection_system_tpu.runtime.pipeline import (
+        run_demo,
+    )
+
+    cfg = Config(
+        data=DataConfig(n_customers=25, n_terminals=50, n_days=10),
+        train=TrainConfig(delta_train_days=5, delta_delay_days=1,
+                          delta_test_days=3, epochs=1),
+        features=FeatureConfig(customer_capacity=64, terminal_capacity=64,
+                               history_len=8),
+    )
+    summary = run_demo(cfg, model_kind="sequence")
+    assert summary["streamed_rows"] > 0
+    assert 0.0 <= summary["stream_auc"] <= 1.0
+
+
 def test_padding_rows_do_not_touch_state(setup):
     cfg, params, cust, t_s, amount, k = setup
     state = init_history_state(cfg)
