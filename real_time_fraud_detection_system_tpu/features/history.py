@@ -24,6 +24,14 @@ transformer trained offline on ``build_sequences`` serves unchanged.
 Row ``C`` of every array is a write sink: padding rows route their
 scatters there, keeping scatter indices unique without host-side
 filtering.
+
+Key→slot follows the window state's contract (``features/online._slot``):
+``direct`` mode is collision-free while ids < capacity; past capacity
+(or in ``hash`` mode) colliding customers MERGE into one interleaved
+history — same degradation mode as the window tables, size capacity
+accordingly. Exactly-once across restarts also mirrors the window
+state: the ring buffers live in the checkpointed engine state, so a
+crash replay restores the snapshot and re-applies rows once.
 """
 
 from __future__ import annotations

@@ -199,6 +199,33 @@ def test_run_demo_sequence_kind():
     assert 0.0 <= summary["stream_auc"] <= 1.0
 
 
+def test_fuzz_batch_split_invariance():
+    """Randomized: any micro-batch split of the same stream produces the
+    same scores (the state-stream contract), across K/capacity/duplicate
+    configs."""
+    rng = np.random.default_rng(42)
+    params = init_transformer(
+        d_model=8, n_heads=2, n_layers=1, d_ff=16, seed=1)
+    for trial in range(4):
+        k = int(rng.choice([2, 4, 8]))
+        n_cust = int(rng.integers(2, 30))
+        n = int(rng.integers(40, 160))
+        cfg = FeatureConfig(customer_capacity=64, terminal_capacity=64,
+                            history_len=k)
+        cust = rng.integers(0, n_cust, n).astype(np.int64)
+        # duplicate timestamps on purpose (tie handling)
+        t_s = (20000 * 86400
+               + np.sort(rng.integers(0, 5000, n))).astype(np.int64)
+        amount = np.round(rng.gamma(2.0, 40.0, n), 2)
+        # power-of-two splits share jit cache entries across trials
+        splits = [16, 64]
+        _, ref = _stream(cfg, params, cust, t_s, amount, batch_rows=256)
+        for br in splits:
+            _, got = _stream(cfg, params, cust, t_s, amount, batch_rows=br)
+            np.testing.assert_allclose(got, ref, atol=1e-6,
+                                       err_msg=f"trial {trial} split {br}")
+
+
 def test_padding_rows_do_not_touch_state(setup):
     cfg, params, cust, t_s, amount, k = setup
     state = init_history_state(cfg)
