@@ -36,6 +36,7 @@ from real_time_fraud_detection_system_tpu.models.train import (  # noqa: F401
     fit_classifier,
     train_delay_test_split,
     train_model,
+    train_sequence_model,
 )
 from real_time_fraud_detection_system_tpu.models.autoencoder import (  # noqa: F401
     AutoencoderParams,
