@@ -12,10 +12,15 @@ serving semantics at ``fraud_detection.py:183-195``):
 1. forest GEMM ``predict_proba`` — decision-exact claim on real MXU
    (bf16 z-contraction path, forest.py:226-256);
 2. forest descent form — gather/select path;
-3. logreg forward;
-4. the full 15-feature kernel vs the same kernel on CPU (catches
+3. forest int8 z-contraction mode ≡ the default mode bit-for-bit
+   (both exact integer arithmetic; key ``forest_int8z_…``);
+4. logreg forward;
+5. the full 15-feature kernel vs the same kernel on CPU (catches
    TPU-specific lowering bugs in scatter/gather/window ops);
-5. AUC parity: TPU-scored stream vs sklearn-oracle-scored stream.
+6. the long-context kernel (history ring scatter/gather + causal
+   transformer, features/history.py) vs the same stream on the CPU
+   backend, tolerance 1e-3 (key ``sequence_kernel_…``);
+7. AUC parity: TPU-scored stream vs sklearn-oracle-scored stream.
 
 Prints ONE JSON line; exit 0 iff every gate passes. Evidence files
 ``HWCHECK_r*.json`` are committed when captured in-session.
@@ -172,6 +177,40 @@ def main() -> None:
     results["feature_kernel_max_abs_diff"] = float(
         np.max(np.abs(f_dev - f_cpu)))
     ok &= results["feature_kernel_max_abs_diff"] < 1e-4
+
+    # ---- long-context kernel: history ring + causal transformer ---------
+    from real_time_fraud_detection_system_tpu.features.history import (
+        init_history_state,
+        update_and_score,
+    )
+    from real_time_fraud_detection_system_tpu.models.sequence import (
+        init_transformer,
+    )
+
+    hcfg = FeatureConfig(customer_capacity=1024, terminal_capacity=1024,
+                         history_len=16)
+    tparams = init_transformer(d_model=16, n_heads=2, n_layers=1, d_ff=32,
+                               seed=2)
+
+    def run_seq_stream(device):
+        step = jax.jit(update_and_score, static_argnums=(3,),
+                       device=device)
+        state = jax.device_put(init_history_state(hcfg), device)
+        p = jax.device_put(tparams, device)
+        outs = []
+        for hb in batches:
+            db = jax.device_put(hb, device)
+            state, probs = step(state, p, db, hcfg)
+            outs.append(np.asarray(probs))
+        return np.concatenate(outs)
+
+    _note("sequence stream on device backend")
+    s_dev = run_seq_stream(dev)
+    _note("sequence stream on cpu backend")
+    s_cpu = run_seq_stream(cpu)
+    results["sequence_kernel_max_abs_diff"] = float(
+        np.max(np.abs(s_dev - s_cpu)))
+    ok &= results["sequence_kernel_max_abs_diff"] < 1e-3
 
     # ---- AUC parity on a scored stream ----------------------------------
     from real_time_fraud_detection_system_tpu.models.metrics import roc_auc
