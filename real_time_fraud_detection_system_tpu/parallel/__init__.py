@@ -26,3 +26,8 @@ from real_time_fraud_detection_system_tpu.parallel.sequence_step import (  # noq
     init_sharded_history_state,
     make_sharded_sequence_step,
 )
+from real_time_fraud_detection_system_tpu.parallel.expert_parallel import (  # noqa: F401
+    init_moe,
+    make_ep_apply,
+    moe_apply_dense,
+)

@@ -1,0 +1,127 @@
+"""Expert parallelism: top-1-routed MoE FFN with all_to_all dispatch.
+
+Completes the parallelism suite (dp: sharded engines, tp:
+``tensor_parallel``, pp: ``pipeline_parallel``, sp: ``ring_attention``):
+one expert MLP per device, tokens routed to their expert's owner over
+the same bucketed ``all_to_all`` primitive the terminal/sequence
+exchanges use (:func:`..step.owner_route`), computed there, and routed
+back scaled by the router gate.
+
+The reference has no MoE — this is capacity the framework carries for
+scorers past one chip's FLOPs, in the same spirit as TP/PP. Semantics
+are pinned against :func:`moe_apply_dense` (the single-device oracle
+that computes every token's expert locally): the worst-case exchange
+buffer (n_dev × B_local per device, like the terminal exchange) means
+NO token is ever dropped, so parity is exact — there is no
+capacity-factor approximation to reason about.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class MoEParams(NamedTuple):
+    """E experts, stacked on the leading axis (sharded one-per-device)."""
+
+    w_router: jnp.ndarray  # [D, E] (replicated — tiny)
+    w1: jnp.ndarray  # [E, D, F]
+    b1: jnp.ndarray  # [E, F]
+    w2: jnp.ndarray  # [E, F, D]
+    b2: jnp.ndarray  # [E, D]
+
+    @property
+    def n_experts(self) -> int:
+        return int(self.w1.shape[0])
+
+
+def init_moe(d_model: int, d_ff: int, n_experts: int,
+             seed: int = 0) -> MoEParams:
+    key = jax.random.PRNGKey(seed)
+    kr, k1, k2 = jax.random.split(key, 3)
+    return MoEParams(
+        w_router=jax.random.normal(kr, (d_model, n_experts)) / np.sqrt(d_model),
+        w1=np.sqrt(2.0 / d_model)
+        * jax.random.normal(k1, (n_experts, d_model, d_ff)),
+        b1=jnp.zeros((n_experts, d_ff)),
+        w2=np.sqrt(2.0 / d_ff)
+        * jax.random.normal(k2, (n_experts, d_ff, d_model)),
+        b2=jnp.zeros((n_experts, d_model)),
+    )
+
+
+def _route_and_gate(params: MoEParams, x: jnp.ndarray):
+    """Top-1 router: → (expert id [B], gate value [B])."""
+    logits = x @ params.w_router
+    e = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    gate = jnp.take_along_axis(
+        jax.nn.softmax(logits, axis=-1), e[:, None], axis=1)[:, 0]
+    return e, gate
+
+
+def _expert_ffn(params: MoEParams, e, x):
+    """Per-token expert MLP via stacked-weight gathers (oracle path)."""
+    h = jax.nn.relu(
+        jnp.einsum("bd,bdf->bf", x, params.w1[e]) + params.b1[e])
+    return jnp.einsum("bf,bfd->bd", h, params.w2[e]) + params.b2[e]
+
+
+def moe_apply_dense(params: MoEParams, x: jnp.ndarray) -> jnp.ndarray:
+    """Single-device oracle: every token's expert computed locally."""
+    e, gate = _route_and_gate(params, x)
+    return gate[:, None] * _expert_ffn(params, e, x)
+
+
+def make_ep_apply(mesh: Mesh, params: MoEParams,
+                  axis: Optional[str] = None):
+    """→ (sharded_params, apply(params, x) → y): expert-parallel MoE.
+
+    ``x [B, D]`` rows shard over ``axis`` (dp); experts shard one per
+    device (requires n_experts == axis size). Each device routes its
+    tokens to their expert's owner (one ``all_to_all`` out, the inverse
+    back), computes ONLY its own expert's FFN, and scales by the gate
+    computed where the token lives.
+    """
+    from real_time_fraud_detection_system_tpu.parallel.mesh import (
+        compat_shard_map,
+    )
+
+    axis = axis or mesh.axis_names[0]
+    n_dev = int(mesh.shape[axis])
+    if params.n_experts != n_dev:
+        raise ValueError(
+            f"{params.n_experts} experts on a {n_dev}-device '{axis}' "
+            "axis (want one expert per device)")
+    specs = MoEParams(
+        w_router=P(None, None),
+        w1=P(axis), b1=P(axis), w2=P(axis), b2=P(axis),
+    )
+    sharded = jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+        params, specs)
+
+    def local_apply(p, x):
+        from real_time_fraud_detection_system_tpu.parallel.step import (
+            owner_route,
+        )
+
+        # local expert block: leading axis length 1
+        w1, b1 = p.w1[0], p.b1[0]
+        w2, b2 = p.w2[0], p.b2[0]
+        bl = x.shape[0]
+        e, gate = _route_and_gate(p, x)  # router replicated, tokens local
+        send_pos, xchg, scatter = owner_route(
+            e, jnp.ones(bl, bool), n_dev, axis, bl)
+        received = xchg(scatter(x))  # tokens whose expert lives here
+        out = jax.nn.relu(received @ w1 + b1) @ w2 + b2
+        back = xchg(out)[send_pos]  # inverse exchange, un-bucketed
+        return gate[:, None] * back
+
+    apply_fn = jax.jit(compat_shard_map(
+        local_apply, mesh, (specs, P(axis)), P(axis)))
+    return sharded, apply_fn

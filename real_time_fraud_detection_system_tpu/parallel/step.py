@@ -156,22 +156,25 @@ def owner_route(
     axis,
     bl: int,
 ):
-    """Bucketed-``all_to_all`` primitives shared by the window and
-    sequence routed paths: → (send_pos, xchg, scatter).
+    """Bucketed-``all_to_all`` primitives shared by the window, sequence,
+    and expert routed paths: → (send_pos, xchg, scatter).
 
-    ``scatter(x)`` lays local rows into the [n_dev × bl] send buffer at
-    their owner bucket; ``xchg`` runs the all_to_all (its own inverse,
-    so routing results back is ``xchg(...)[send_pos]``)."""
+    ``scatter(x)`` lays local rows into the [n_dev × bl, ...] send buffer
+    at their owner bucket; ``xchg`` runs the all_to_all (its own inverse,
+    so routing results back is ``xchg(...)[send_pos]``). Both carry
+    arbitrary trailing feature dims (scalars per row, or [*, D]
+    vectors)."""
     send_pos, _ = _route(dest, valid, n_dev)
 
     def xchg(x):
+        rest = x.shape[1:]
         return jax.lax.all_to_all(
-            x.reshape(n_dev, bl), axis, split_axis=0, concat_axis=0,
-            tiled=False,
-        ).reshape(n_dev * bl)
+            x.reshape((n_dev, bl) + rest), axis, split_axis=0,
+            concat_axis=0, tiled=False,
+        ).reshape((n_dev * bl,) + rest)
 
     def scatter(x, fill=0):
-        buf = jnp.full((n_dev * bl,), fill, dtype=x.dtype)
+        buf = jnp.full((n_dev * bl,) + x.shape[1:], fill, dtype=x.dtype)
         return buf.at[send_pos].set(x)
 
     return send_pos, xchg, scatter
