@@ -49,6 +49,10 @@ dashboard:
 connectors:
 	$(CLI) connectors --connect-url $(CONNECT_URL)
 
+dryrun:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
 bench:
 	$(PY) bench.py
 
@@ -61,4 +65,4 @@ install:
 clean:
 	rm -rf $(OUT)
 
-.PHONY: demo datagen train score run-all query dashboard connectors bench test install clean
+.PHONY: demo datagen train score run-all query dashboard connectors dryrun bench test install clean
