@@ -11,7 +11,11 @@
 #   make install     — editable install incl. the `rtfds` console script
 
 PY ?= python
-CLI = $(PY) -m real_time_fraud_detection_system_tpu.cli
+# PLATFORM=cpu pins jax to CPU (e.g. when the TPU tunnel is down; the
+# CLI fails fast with rc 3 instead of hanging when it can't come up).
+PLATFORM ?=
+CLI = $(PY) -m real_time_fraud_detection_system_tpu.cli \
+      $(if $(PLATFORM),--platform $(PLATFORM),)
 OUT ?= out
 CONNECT_URL ?= http://localhost:8083
 # Dataset scale: moderate default so `make run-all` finishes in minutes on

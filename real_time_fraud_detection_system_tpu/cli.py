@@ -31,10 +31,58 @@ import sys
 import numpy as np
 
 
-def _platform_setup(platform: str | None) -> None:
+def _backend_probe_ok(timeout_s: float) -> bool:
+    """Probe jax backend bring-up in a SUBPROCESS with a hard timeout.
+
+    A remote/tunneled TPU backend (the axon plugin a sitecustomize may
+    force) can hang ``jax.devices()`` forever when the tunnel is down —
+    observed repeatedly on this hardware. An in-process probe can wedge
+    the interpreter, so the probe is its own process."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _platform_setup(platform: str | None, needs_backend: bool = True) -> None:
     if platform:
         os.environ["JAX_PLATFORMS"] = platform
     want = os.environ.get("JAX_PLATFORMS")
+    # Guard against an unreachable accelerator backend: without an
+    # explicit CPU pin, a dead TPU tunnel turns every jax-running command
+    # into an indefinite hang inside backend init. Probe first (in a
+    # subprocess — costs one extra backend bring-up on the happy path,
+    # accepted for never hanging); fail fast with an actionable message.
+    # Skipped for commands that run no jax ops (connectors, query,
+    # dashboard, datagen) and for bench, whose harness runs its own
+    # patient attempt + CPU fallback. RTFDS_BACKEND_PROBE_TIMEOUT=0
+    # disables (wait indefinitely); default 600s sits above the longest
+    # healthy bring-up observed on this tunnel (~500s, see bench.py).
+    probe_needed = needs_backend and (
+        (not want) or ("axon" in want) or ("tpu" in want))
+    try:
+        timeout_s = float(
+            os.environ.get("RTFDS_BACKEND_PROBE_TIMEOUT", "600"))
+    except ValueError:
+        timeout_s = 600.0
+    if probe_needed and timeout_s > 0 and not _backend_probe_ok(timeout_s):
+        from real_time_fraud_detection_system_tpu.utils import get_logger
+
+        get_logger("cli").error(
+            "accelerator backend did not come up within %.0fs (dead TPU "
+            "tunnel?) — pass --platform cpu to run on CPU, or set "
+            "RTFDS_BACKEND_PROBE_TIMEOUT=0 to wait indefinitely",
+            timeout_s,
+        )
+        raise SystemExit(3)
     if want:
         import jax
 
@@ -724,7 +772,7 @@ def main(argv=None) -> int:
     p.add_argument("--radius", type=float, default=5.0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--start-date", default="2025-04-01")
-    p.set_defaults(fn=cmd_datagen)
+    p.set_defaults(fn=cmd_datagen, needs_backend=False)
 
     p = sub.add_parser("train", help="offline training on a generated table")
     p.add_argument("--data", required=True)
@@ -826,7 +874,7 @@ def main(argv=None) -> int:
     p.add_argument("--threshold", type=float, default=0.5)
     p.add_argument("--top-k", type=int, default=10)
     p.add_argument("--bucket", default="day", choices=["hour", "day"])
-    p.set_defaults(fn=cmd_query)
+    p.set_defaults(fn=cmd_query, needs_backend=False)
 
     p = sub.add_parser(
         "connectors",
@@ -843,7 +891,7 @@ def main(argv=None) -> int:
     p.add_argument("--schema", default="payment")
     p.add_argument("--topic-prefix", default="debezium")
     p.add_argument("--timeout", type=float, default=10.0)
-    p.set_defaults(fn=cmd_connectors)
+    p.set_defaults(fn=cmd_connectors, needs_backend=False)
 
     p = sub.add_parser(
         "dashboard",
@@ -857,7 +905,7 @@ def main(argv=None) -> int:
     p.add_argument("--bucket", default="day", choices=["hour", "day"])
     p.add_argument("--title", default=None,
                    help="page title (default set in io.dashboard)")
-    p.set_defaults(fn=cmd_dashboard)
+    p.set_defaults(fn=cmd_dashboard, needs_backend=False)
 
     p = sub.add_parser(
         "compare",
@@ -897,10 +945,11 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("bench", help="run the benchmark harness")
     p.add_argument("--quick", action="store_true")
-    p.set_defaults(fn=cmd_bench)
+    p.set_defaults(fn=cmd_bench, needs_backend=False)
 
     args = ap.parse_args(argv)
-    _platform_setup(args.platform)
+    _platform_setup(args.platform,
+                    getattr(args, "needs_backend", True))
     return args.fn(args)
 
 

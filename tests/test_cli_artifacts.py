@@ -225,3 +225,36 @@ def test_cli_select(workdir, capsys):
         "--grid", "tree_maxdepth=2",
         "--start-valid", "15", "--start-test", "20",
     ]) == 2
+
+
+def test_backend_probe_failfast(monkeypatch):
+    """A dead accelerator tunnel must fail fast with rc 3 and a clear
+    message — never hang every CLI command in backend init (the observed
+    failure mode of the axon plugin with its tunnel down)."""
+    import pytest
+
+    from real_time_fraud_detection_system_tpu import cli
+
+    monkeypatch.setattr(cli, "_backend_probe_ok", lambda t: False)
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    monkeypatch.setenv("RTFDS_BACKEND_PROBE_TIMEOUT", "1")
+    with pytest.raises(SystemExit) as e:
+        cli.main(["train", "--data", "nowhere", "--out-model", "x"])
+    assert e.value.code == 3
+    # jax-free commands and bench (own fallback harness) skip the probe;
+    # an explicit cpu pin skips it too. JAX_PLATFORMS stays unset here so
+    # _platform_setup never re-points the test process's jax config.
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    called = []
+    monkeypatch.setattr(cli, "_backend_probe_ok",
+                        lambda t: called.append(t) or False)
+    assert cli.main(["query", "--data", "nowhere",
+                     "--report", "transactions"]) == 2
+    assert cli.main(["--platform", "cpu", "score", "--model-file", "x"]) != 3
+    assert called == []  # query skipped; cpu pin skipped
+    # malformed timeout env falls back to the default instead of crashing
+    monkeypatch.setenv("RTFDS_BACKEND_PROBE_TIMEOUT", "off")
+    assert cli.main(["dashboard", "--data", "nowhere", "--out", "x"]) == 2
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # restore the test pin
