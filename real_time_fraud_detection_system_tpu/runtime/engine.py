@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -44,7 +44,6 @@ from real_time_fraud_detection_system_tpu.models.forest import (
     predict_proba as forest_predict_proba,
 )
 from real_time_fraud_detection_system_tpu.models.logreg import (
-    LogRegParams,
     logreg_loss,
     logreg_predict_proba,
 )
