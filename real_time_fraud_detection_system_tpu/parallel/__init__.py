@@ -25,6 +25,7 @@ from real_time_fraud_detection_system_tpu.parallel.pipeline_parallel import (  #
 from real_time_fraud_detection_system_tpu.parallel.sequence_step import (  # noqa: F401
     init_sharded_history_state,
     make_sharded_sequence_step,
+    reshard_history_state,
 )
 from real_time_fraud_detection_system_tpu.parallel.expert_parallel import (  # noqa: F401
     init_moe,

@@ -48,14 +48,30 @@ from real_time_fraud_detection_system_tpu.parallel.mesh import (
 # an import cycle through this package's __init__; defer to call time.
 
 
-def init_sharded_history_state(
-    cfg: Config, mesh: Mesh, axis: "str | tuple" = "data"
-):
-    """[n_dev, cap_local+1, ...] leaves, sharded on the device axis."""
+def _stacked_blank(fcfg, n_dev: int, as_jnp: bool):
+    """ONE source of truth for the sharded layout: n_dev stacked local
+    blocks, each a self-contained HistoryState (own sink row)."""
+    import numpy as np
+
     from real_time_fraud_detection_system_tpu.features.history import (
         init_history_state,
     )
 
+    local = init_history_state(
+        dataclasses.replace(
+            fcfg, customer_capacity=fcfg.customer_capacity // n_dev))
+    if as_jnp:
+        return jax.tree.map(
+            lambda a: jnp.broadcast_to(a[None], (n_dev,) + a.shape), local)
+    return jax.tree.map(
+        lambda a: np.broadcast_to(
+            np.asarray(a)[None], (n_dev,) + a.shape).copy(), local)
+
+
+def init_sharded_history_state(
+    cfg: Config, mesh: Mesh, axis: "str | tuple" = "data"
+):
+    """[n_dev, cap_local+1, ...] leaves, sharded on the device axis."""
     n_dev = int(mesh.devices.size)
     fcfg = cfg.features
     if fcfg.customer_capacity % n_dev:
@@ -64,11 +80,7 @@ def init_sharded_history_state(
         raise ValueError(
             "sharded sequence serving requires key_mode='direct' "
             "(owner = key % n_dev, local slot = key // n_dev)")
-    local = init_history_state(
-        dataclasses.replace(
-            fcfg, customer_capacity=fcfg.customer_capacity // n_dev))
-    stacked = jax.tree.map(
-        lambda a: jnp.broadcast_to(a[None], (n_dev,) + a.shape), local)
+    stacked = _stacked_blank(fcfg, n_dev, as_jnp=True)
     sh = NamedSharding(mesh, P(axis))
     return jax.tree.map(lambda a: jax.device_put(a, sh), stacked)
 
@@ -80,6 +92,72 @@ def shard_history_state(
     restore)."""
     sh = NamedSharding(mesh, P(axis))
     return jax.tree.map(lambda a: jax.device_put(a, sh), state)
+
+
+def reshard_history_state(state, cfg: Config, n_dev_new: int):
+    """Elastic re-layout of a history state between device counts.
+
+    In ``direct`` key mode with ids < capacity the maps are bijective
+    (single-chip slot = key; sharded owner = key % n, local slot =
+    key // n), so conversion is EXACT — restore a single-chip
+    checkpoint into an 8-way sharded engine, or re-shard n→m after a
+    topology change, with identical serving behavior (SURVEY §5.3's
+    elastic-recovery role for the long-context state).
+
+    Accepts either layout (single-chip ``[C+1, ...]`` leaves or stacked
+    ``[n, C/n+1, ...]``) and returns host-side arrays in the target
+    layout (``n_dev_new == 1`` → single-chip); callers place them on a
+    mesh with :func:`shard_history_state`.
+    """
+    import numpy as np
+
+    from real_time_fraud_detection_system_tpu.features.history import (
+        HistoryState,
+        init_history_state,
+    )
+
+    fcfg = cfg.features
+    cap = fcfg.customer_capacity
+    if fcfg.key_mode != "direct":
+        raise ValueError("elastic re-shard requires key_mode='direct'")
+
+    def to_single(s) -> HistoryState:
+        leaves = [np.asarray(a) for a in s]
+        if leaves[0].ndim == 3:  # already single-chip [C+1, K, F]
+            if leaves[0].shape[0] != cap + 1:
+                raise ValueError(
+                    f"state capacity {leaves[0].shape[0] - 1} != "
+                    f"config capacity {cap}")
+            return HistoryState(*leaves)
+        n_old = leaves[0].shape[0]
+        cap_local = leaves[0].shape[1] - 1
+        if n_old * cap_local != cap:
+            raise ValueError(
+                f"state layout {n_old}x{cap_local} != config "
+                f"capacity {cap} — re-sharding a checkpoint taken under "
+                "a different customer_capacity would silently merge or "
+                "drop customers")
+        single = jax.tree.map(
+            np.asarray, init_history_state(fcfg))
+        out = [np.array(a) for a in single]
+        keys = np.arange(cap)
+        owner, local = keys % n_old, (keys // n_old) & (cap_local - 1)
+        for i, a in enumerate(leaves):
+            out[i][keys] = a[owner, local]
+        return HistoryState(*out)
+
+    single = to_single(state)
+    if n_dev_new == 1:
+        return HistoryState(*[jnp.asarray(a) for a in single])
+    if cap % n_dev_new:
+        raise ValueError("customer_capacity must divide by n_dev_new")
+    cap_local = cap // n_dev_new
+    out = list(_stacked_blank(fcfg, n_dev_new, as_jnp=False))
+    keys = np.arange(cap)
+    owner, local = keys % n_dev_new, (keys // n_dev_new) & (cap_local - 1)
+    for i, a in enumerate(single):
+        out[i][owner, local] = np.asarray(a)[keys]
+    return HistoryState(*[jnp.asarray(a) for a in out])
 
 
 def make_sharded_sequence_step(
