@@ -27,6 +27,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 import numpy as np
 
@@ -52,6 +53,44 @@ def _backend_probe_ok(timeout_s: float) -> bool:
         return False
 
 
+def _probe_cache_path() -> str:
+    import tempfile
+
+    return os.path.join(tempfile.gettempdir(), "rtfds_backend_probe.json")
+
+
+def _probe_cache_fresh(ttl_s: float) -> bool:
+    """A recent probe success (same JAX_PLATFORMS) skips re-probing.
+
+    The probe is a full backend bring-up in a subprocess; on a healthy
+    tunnel that can cost hundreds of seconds, paid on EVERY jax-running
+    CLI call without this cache. The sentinel is keyed by the platform
+    string so switching JAX_PLATFORMS invalidates it."""
+    try:
+        with open(_probe_cache_path()) as f:
+            c = json.load(f)
+        return (
+            isinstance(c, dict)
+            and c.get("platform") == os.environ.get("JAX_PLATFORMS", "")
+            and 0 <= time.time() - float(c.get("t", 0)) < ttl_s
+        )
+    except (OSError, ValueError, TypeError, AttributeError):
+        # fixed world-writable path: any unreadable/garbage content just
+        # means "no cache" — fall back to probing
+        return False
+
+
+def _probe_cache_store() -> None:
+    try:
+        tmp = _probe_cache_path() + f".{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"platform": os.environ.get("JAX_PLATFORMS", ""),
+                       "t": time.time()}, f)
+        os.replace(tmp, _probe_cache_path())
+    except OSError:
+        pass  # cache is best-effort; next call just probes again
+
+
 def _platform_setup(platform: str | None, needs_backend: bool = True) -> None:
     if platform:
         os.environ["JAX_PLATFORMS"] = platform
@@ -73,16 +112,25 @@ def _platform_setup(platform: str | None, needs_backend: bool = True) -> None:
             os.environ.get("RTFDS_BACKEND_PROBE_TIMEOUT", "600"))
     except ValueError:
         timeout_s = 600.0
-    if probe_needed and timeout_s > 0 and not _backend_probe_ok(timeout_s):
-        from real_time_fraud_detection_system_tpu.utils import get_logger
+    try:
+        ttl_s = float(os.environ.get("RTFDS_BACKEND_PROBE_TTL", "600"))
+    except ValueError:
+        ttl_s = 600.0
+    if probe_needed and timeout_s > 0 and ttl_s > 0 \
+            and _probe_cache_fresh(ttl_s):
+        probe_needed = False
+    if probe_needed and timeout_s > 0:
+        if not _backend_probe_ok(timeout_s):
+            from real_time_fraud_detection_system_tpu.utils import get_logger
 
-        get_logger("cli").error(
-            "accelerator backend did not come up within %.0fs (dead TPU "
-            "tunnel?) — pass --platform cpu to run on CPU, or set "
-            "RTFDS_BACKEND_PROBE_TIMEOUT=0 to wait indefinitely",
-            timeout_s,
-        )
-        raise SystemExit(3)
+            get_logger("cli").error(
+                "accelerator backend did not come up within %.0fs (dead "
+                "TPU tunnel?) — pass --platform cpu to run on CPU, or set "
+                "RTFDS_BACKEND_PROBE_TIMEOUT=0 to wait indefinitely",
+                timeout_s,
+            )
+            raise SystemExit(3)
+        _probe_cache_store()
     if want:
         import jax
 

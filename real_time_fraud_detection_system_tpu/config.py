@@ -149,6 +149,17 @@ class RuntimeConfig:
     # ops/pallas_kernels.py). Interpreted (slow, exact) off-TPU.
     use_pallas: bool = False
     trigger_seconds: float = 0.0  # 0 => score as fast as batches arrive
+    # Max micro-batches in flight on the device at once (the engine's
+    # software pipeline). 2 = classic double-buffering (batch N+1's host
+    # prep + H2D overlap batch N's compute); deeper keeps the device fed
+    # when per-dispatch overhead (e.g. a remote-tunnel RTT) exceeds the
+    # step's compute time. Steps still chain through the feature state,
+    # so depth buys dispatch overlap, not device concurrency.
+    pipeline_depth: int = 2
+    # Coalesce consecutive source polls into one device batch of up to
+    # this many rows (0 = off: one poll = one batch). Amortizes per-step
+    # dispatch overhead when the source hands out small batches.
+    coalesce_rows: int = 0
     # Pad/bucket micro-batches to these row counts to keep the jit cache warm.
     batch_buckets: Sequence[int] = (256, 1024, 4096, 16384, 65536)
     max_batch_rows: int = 65536

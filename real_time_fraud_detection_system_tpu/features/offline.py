@@ -147,50 +147,46 @@ def pandas_rolling_features(
         "TX_DURING_NIGHT": (hour <= night_end_hour).astype(np.float64).to_numpy(),
     }
 
-    dfi = df.set_index("TX_DATETIME")
-    g = dfi.groupby("CUSTOMER_ID")["TX_AMOUNT"]
+    # Roll over the TX_DATETIME *column* (``on=``) so the frame keeps its
+    # unique RangeIndex; groupby-rolling then returns a
+    # (key, original_row) MultiIndex and results join back by an explicit
+    # index — no assumption about the traversal order of pandas' output.
+    n = len(df)
+    gc = df.groupby("CUSTOMER_ID")[["TX_DATETIME", "TX_AMOUNT"]]
     for w in windows:
-        cnt = g.rolling(f"{w}D").count()
-        s = g.rolling(f"{w}D").sum()
-        avg = (s / cnt).reset_index(level=0, drop=True)
-        cnt = cnt.reset_index(level=0, drop=True)
-        # groupby-rolling returns rows grouped by key; restore chronological
-        # order via the original index positions.
-        out[f"CUSTOMER_ID_NB_TX_{w}DAY_WINDOW"] = _realign(dfi, cnt, "CUSTOMER_ID")
-        out[f"CUSTOMER_ID_AVG_AMOUNT_{w}DAY_WINDOW"] = _realign(dfi, avg, "CUSTOMER_ID")
+        r = gc.rolling(f"{w}D", on="TX_DATETIME")
+        cnt = _realign(r.count()["TX_AMOUNT"], n)
+        s = _realign(r.sum()["TX_AMOUNT"], n)
+        out[f"CUSTOMER_ID_NB_TX_{w}DAY_WINDOW"] = cnt
+        out[f"CUSTOMER_ID_AVG_AMOUNT_{w}DAY_WINDOW"] = s / cnt
 
-    gt = dfi.groupby("TERMINAL_ID")["TX_FRAUD"]
-    nb_delay = gt.rolling(f"{delay_days}D").count().reset_index(level=0, drop=True)
-    fr_delay = gt.rolling(f"{delay_days}D").sum().reset_index(level=0, drop=True)
+    gt = df.groupby("TERMINAL_ID")[["TX_DATETIME", "TX_FRAUD"]]
+
+    def _roll_ct(days: int):
+        r = gt.rolling(f"{days}D", on="TX_DATETIME")
+        return (_realign(r.count()["TX_FRAUD"], n),
+                _realign(r.sum()["TX_FRAUD"], n))
+
+    nb_delay, fr_delay = _roll_ct(delay_days)
     for w in windows:
-        nb_dw = gt.rolling(f"{delay_days + w}D").count().reset_index(level=0, drop=True)
-        fr_dw = gt.rolling(f"{delay_days + w}D").sum().reset_index(level=0, drop=True)
+        nb_dw, fr_dw = _roll_ct(delay_days + w)
         nb_w = nb_dw - nb_delay
-        risk = (fr_dw - fr_delay) / nb_w
-        risk = risk.fillna(0.0)
-        out[f"TERMINAL_ID_NB_TX_{w}DAY_WINDOW"] = _realign(dfi, nb_w, "TERMINAL_ID")
-        out[f"TERMINAL_ID_RISK_{w}DAY_WINDOW"] = _realign(dfi, risk, "TERMINAL_ID")
+        risk = np.where(nb_w > 0,
+                        (fr_dw - fr_delay) / np.maximum(nb_w, 1.0), 0.0)
+        out[f"TERMINAL_ID_NB_TX_{w}DAY_WINDOW"] = nb_w
+        out[f"TERMINAL_ID_RISK_{w}DAY_WINDOW"] = risk
 
     from real_time_fraud_detection_system_tpu.features.spec import FEATURE_NAMES
 
     return np.stack([np.asarray(out[name], dtype=np.float64) for name in FEATURE_NAMES], axis=1)
 
 
-def _realign(dfi, series, key_col):
-    """Align a groupby-rolling result back to chronological row order."""
-    import pandas as pd
+def _realign(series, n: int) -> np.ndarray:
+    """Groupby-rolling result → chronological row order, by index join.
 
-    tmp = series.copy()
-    # series is indexed by TX_DATETIME within groups; attach TRANSACTION_ID
-    # (unique) to realign. Build mapping via positional concat per group.
-    aligned = np.empty(len(dfi), dtype=np.float64)
-    pos = 0
-    # Fast path: pandas returns values in group-major order matching
-    # dfi.groupby(key).indices traversal order.
-    indices = dfi.groupby(key_col).indices
-    vals = series.to_numpy()
-    for key in indices:
-        idx = indices[key]
-        aligned[idx] = vals[pos : pos + len(idx)]
-        pos += len(idx)
-    return aligned
+    ``series`` carries a (group_key, original_row) MultiIndex; dropping the
+    group level leaves the frame's unique RangeIndex, so ``reindex`` is an
+    exact join regardless of how pandas ordered the output rows.
+    """
+    flat = series.reset_index(level=0, drop=True)
+    return flat.reindex(np.arange(n)).to_numpy(dtype=np.float64)

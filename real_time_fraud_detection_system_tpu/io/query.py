@@ -220,8 +220,20 @@ def _psi(ref: np.ndarray, cur: np.ndarray, n_bins: int = 10) -> float:
     if len(ref) == 0 or len(cur) == 0:
         return 0.0
     edges = np.quantile(ref, np.linspace(0, 1, n_bins + 1)[1:-1])
-    p_ref = np.bincount(np.searchsorted(edges, ref), minlength=n_bins)
-    p_cur = np.bincount(np.searchsorted(edges, cur), minlength=n_bins)
+    edges = np.unique(edges)
+    if len(edges) < n_bins // 2:
+        # Heavily tied reference (common for fraud scores clustered near
+        # 0): duplicate decile edges collapse into one bin and PSI reads
+        # ~0 regardless of the shift. Fall back to fixed-width bins over
+        # the pooled range so movement within the tied region registers.
+        lo = min(float(ref.min()), float(cur.min()))
+        hi = max(float(ref.max()), float(cur.max()))
+        if hi <= lo:
+            return 0.0
+        edges = np.linspace(lo, hi, n_bins + 1)[1:-1]
+    nb = len(edges) + 1
+    p_ref = np.bincount(np.searchsorted(edges, ref), minlength=nb)
+    p_cur = np.bincount(np.searchsorted(edges, cur), minlength=nb)
     p_ref = np.maximum(p_ref / len(ref), 1e-4)
     p_cur = np.maximum(p_cur / len(cur), 1e-4)
     return float(((p_cur - p_ref) * np.log(p_cur / p_ref)).sum())

@@ -68,6 +68,17 @@ def _stacked_blank(fcfg, n_dev: int, as_jnp: bool):
             np.asarray(a)[None], (n_dev,) + a.shape).copy(), local)
 
 
+def _require_pow2_local(cap_local: int) -> None:
+    """Local slot math is ``(key // n) & (cap_local - 1)`` — a modulo only
+    when cap_local is a power of two. A non-pow2 local capacity would pass
+    the divisibility check yet silently merge distinct customers' history
+    (breaking the EXACT elastic-reshard contract), so reject it here."""
+    if cap_local <= 0 or (cap_local & (cap_local - 1)):
+        raise ValueError(
+            f"customer_capacity / n_devices must be a power of two, got "
+            f"{cap_local}")
+
+
 def init_sharded_history_state(
     cfg: Config, mesh: Mesh, axis: "str | tuple" = "data"
 ):
@@ -76,6 +87,7 @@ def init_sharded_history_state(
     fcfg = cfg.features
     if fcfg.customer_capacity % n_dev:
         raise ValueError("customer_capacity must divide by n_devices")
+    _require_pow2_local(fcfg.customer_capacity // n_dev)
     if fcfg.key_mode != "direct":
         raise ValueError(
             "sharded sequence serving requires key_mode='direct' "
@@ -131,6 +143,7 @@ def reshard_history_state(state, cfg: Config, n_dev_new: int):
             return HistoryState(*leaves)
         n_old = leaves[0].shape[0]
         cap_local = leaves[0].shape[1] - 1
+        _require_pow2_local(cap_local)
         if n_old * cap_local != cap:
             raise ValueError(
                 f"state layout {n_old}x{cap_local} != config "
@@ -152,6 +165,7 @@ def reshard_history_state(state, cfg: Config, n_dev_new: int):
     if cap % n_dev_new:
         raise ValueError("customer_capacity must divide by n_dev_new")
     cap_local = cap // n_dev_new
+    _require_pow2_local(cap_local)
     out = list(_stacked_blank(fcfg, n_dev_new, as_jnp=False))
     keys = np.arange(cap)
     owner, local = keys % n_dev_new, (keys // n_dev_new) & (cap_local - 1)

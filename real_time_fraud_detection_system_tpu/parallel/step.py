@@ -214,6 +214,14 @@ def make_sharded_step(
     nw = len(windows)
     c_cap_local = fcfg.customer_capacity // n_dev
     t_cap_local = fcfg.terminal_capacity // n_dev
+    for nm, cl in (("customer", c_cap_local), ("terminal", t_cap_local)):
+        # Local slot placement masks with `& (cap_local - 1)`, which is a
+        # modulo only for powers of two; a non-pow2 local capacity would
+        # silently alias distinct keys' window state.
+        if cl <= 0 or (cl & (cl - 1)):
+            raise ValueError(
+                f"{nm}_capacity / n_devices must be a power of two, "
+                f"got {cl}")
 
     def local_step(fstate: FeatureState, params, scaler: Scaler, batch: TxBatch):
         from real_time_fraud_detection_system_tpu.ops.cms import (

@@ -279,14 +279,16 @@ class ScoringEngine:
             label=cols.get("label"),
             pad_to=pad,
         )
+        t1 = time.perf_counter()
         jbatch = jax.tree.map(jnp.asarray, batch)
         fstate, params, probs, feats = self._step(
             self.state.feature_state, self.state.params, self.state.scaler, jbatch
         )
         self.state.feature_state = fstate
         self.state.params = params
+        t2 = time.perf_counter()
         return {"cols": cols, "n": n, "probs": probs, "feats": feats,
-                "t0": t0, "prep_s": time.perf_counter() - t0}
+                "t0": t0, "prep_s": t1 - t0, "dispatch_s": t2 - t1}
 
     def _finish_batch(self, handle: dict) -> BatchResult:
         """Block on the handle's device futures; build the BatchResult."""
@@ -472,9 +474,14 @@ class ScoringEngine:
         risk windows and (for differentiable models) drive online SGD
         while the stream keeps scoring.
 
-        The loop is double-buffered: batch N+1 is polled, host-prepped,
+        The loop is software-pipelined to ``runtime.pipeline_depth``
+        batches in flight: batch N+k is polled, host-prepped,
         ``device_put`` and dispatched while batch N's device step still
-        runs — H2D overlaps compute (SURVEY §2.3 item 3). The pipeline
+        runs — H2D and dispatch overhead overlap compute (SURVEY §2.3
+        item 3; depth 2 is classic double-buffering, deeper depths keep
+        the device fed when per-dispatch overhead such as a remote-tunnel
+        RTT exceeds step compute). ``runtime.coalesce_rows`` further
+        merges consecutive polls into one device batch. The pipeline
         drains to depth 0 before every checkpoint save, so a saved
         (offsets, state) pair never includes an in-flight batch's effects
         (a replay after restore would double-apply them otherwise).
@@ -491,13 +498,18 @@ class ScoringEngine:
             else trigger_seconds
         )
         every = self.cfg.runtime.checkpoint_every_batches
+        depth = max(1, self.cfg.runtime.pipeline_depth)
+        coalesce = self.cfg.runtime.coalesce_rows
         latencies: List[float] = []
         preps: List[float] = []
+        dispatches: List[float] = []
         blocks: List[float] = []
         t_start = time.perf_counter()
         rows0 = self.state.rows_done  # report THIS run's throughput, not
         batches0 = self.state.batches_done  # lifetime totals (warmup runs)
-        pending: Optional[dict] = None
+        from collections import deque
+
+        q: deque = deque()  # in-flight batch handles, FIFO
         if feedback is not None and checkpointer is not None:
             # Feedback offsets must TRAIL the state checkpoint (the same
             # invariant as the source commit below): defer the loop's
@@ -507,10 +519,11 @@ class ScoringEngine:
         def _finish(handle: dict) -> None:
             t_block = time.perf_counter()
             res = self._finish_batch(handle)
-            # Host-prep vs device-result-wait split: on TPU, prep time is
-            # the H2D/partition cost the double-buffer hides; block time
-            # approximates device step latency (minus overlap).
+            # Loop-time decomposition: host prep (dedup + pad) vs H2D +
+            # dispatch (the per-step overhead pipelining hides) vs the
+            # result wait (device compute minus overlap).
             preps.append(handle.get("prep_s", 0.0))
+            dispatches.append(handle.get("dispatch_s", 0.0))
             blocks.append(time.perf_counter() - t_block)
             self.state.offsets = handle["source_offsets"]
             latencies.append(res.latency_s)
@@ -534,53 +547,90 @@ class ScoringEngine:
             if trigger > 0:
                 time.sleep(max(0.0, trigger - res.latency_s))
 
-        while True:
+        def _add_wait(dt: float) -> None:
+            # Waiting for the NEXT batch to arrive is not part of any
+            # in-flight batch's processing latency — subtract it so the
+            # reported percentiles (and trigger pacing) measure the
+            # pipeline, not source quiescence.
+            for h in q:
+                h["waited"] = h.get("waited", 0.0) + dt
+
+        def _drain() -> None:
+            while q:
+                _finish(q.popleft())
+
+        def _poll():
+            t_poll = time.perf_counter()
+            c = source.poll_batch()
+            _add_wait(time.perf_counter() - t_poll)
+            return c
+
+        exhausted = False
+        carry = None  # (cols, offsets): a poll beyond the coalesce cap
+        cap = max(self.cfg.runtime.batch_buckets)
+        while not exhausted:
             if heartbeat is not None:
                 heartbeat.beat()
-            started = self.state.batches_done + (1 if pending else 0)
+            started = self.state.batches_done + len(q)
             if max_batches and started >= max_batches:
                 break
-            t_poll = time.perf_counter()
-            cols = source.poll_batch()
-            if pending is not None:
-                # Waiting for the NEXT batch to arrive is not part of the
-                # pending batch's processing latency — subtract it so the
-                # reported percentiles (and trigger pacing) measure the
-                # pipeline, not source quiescence.
-                pending["waited"] = (
-                    pending.get("waited", 0.0)
-                    + time.perf_counter() - t_poll
-                )
-            if cols is None:
-                break
-            if len(next(iter(cols.values()), ())) == 0:
-                # Idle live source (e.g. KafkaSource on a quiet topic):
-                # not a batch — no sink append, no step, no checkpoint
-                # cadence, no max_batches consumption. Flush the pending
-                # batch (its results must not wait for future traffic),
-                # then wait a trigger.
-                if pending is not None:
-                    _finish(pending)
-                    pending = None
-                if trigger > 0:
-                    time.sleep(trigger)
-                continue
-            if (
-                pending is not None
-                and checkpointer is not None
-                and (self.state.batches_done + 1) % every == 0
+            if carry is not None:
+                cols, offs = carry
+                carry = None
+            else:
+                cols = _poll()
+                if cols is None:
+                    break
+                if len(next(iter(cols.values()), ())) == 0:
+                    # Idle live source (e.g. KafkaSource on a quiet
+                    # topic): not a batch — no sink append, no step, no
+                    # checkpoint cadence, no max_batches consumption.
+                    # Flush the in-flight batches (their results must not
+                    # wait for future traffic), then wait a trigger.
+                    _drain()
+                    if trigger > 0:
+                        time.sleep(trigger)
+                    continue
+                offs = list(source.offsets)
+            if coalesce > 0:
+                # Never assemble past the largest jit bucket: a poll that
+                # would overflow is carried into the NEXT batch, and its
+                # rows stay excluded from this batch's checkpoint offsets
+                # (a crash must replay them, not skip them).
+                target = min(coalesce, cap)
+                parts = [cols]
+                total = len(next(iter(cols.values())))
+                while total < target:
+                    more = _poll()
+                    if more is None:
+                        exhausted = True  # serve the tail, then stop
+                        break
+                    m = len(next(iter(more.values()), ()))
+                    if m == 0:
+                        break  # idle: serve what we have now
+                    if total + m > cap:
+                        carry = (more, list(source.offsets))
+                        break
+                    parts.append(more)
+                    total += m
+                    offs = list(source.offsets)
+                if len(parts) > 1:
+                    cols = {k: np.concatenate([p[k] for p in parts])
+                            for k in parts[0]}
+            if checkpointer is not None and any(
+                h["index"] % every == 0 for h in q
             ):
-                # The pending batch's completion will checkpoint: drain
+                # A queued batch's completion will checkpoint: drain
                 # first so no newer batch is in flight at save time.
-                _finish(pending)
-                pending = None
+                _drain()
+            idx = self.state.batches_done + len(q) + 1
             handle = self._start_batch(cols)
-            handle["source_offsets"] = list(source.offsets)
-            if pending is not None:
-                _finish(pending)
-            pending = handle
-        if pending is not None:
-            _finish(pending)
+            handle["index"] = idx
+            handle["source_offsets"] = offs
+            q.append(handle)
+            while len(q) >= depth:
+                _finish(q.popleft())
+        _drain()
         wall = time.perf_counter() - t_start
         lat = np.asarray(latencies) if latencies else np.zeros(1)
         return {
@@ -596,8 +646,14 @@ class ScoringEngine:
                 np.percentile(np.asarray(preps) if preps else np.zeros(1),
                               50) * 1e3
             ),
+            "dispatch_p50_ms": float(
+                np.percentile(
+                    np.asarray(dispatches) if dispatches else np.zeros(1),
+                    50) * 1e3
+            ),
             "result_wait_p50_ms": float(
                 np.percentile(np.asarray(blocks) if blocks else np.zeros(1),
                               50) * 1e3
             ),
+            "pipeline_depth": depth,
         }

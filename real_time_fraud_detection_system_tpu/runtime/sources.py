@@ -242,6 +242,14 @@ class RawTableSource:
     ``poll_batch``/``offsets``/``seek`` protocol. Rows written to the
     table after construction are not seen (snapshot isolation, matching
     the read_all contract).
+
+    Checkpoint-resume across re-constructions is watermark-guarded:
+    ``offsets`` carries ``[pos, n_snapshot, max_ts, max_tx_id]``, and
+    ``seek`` verifies the first ``n_snapshot`` sorted rows still match
+    that construction-time watermark. Appends beyond the watermark are
+    safe (they sort after the snapshot and get served once the resumed
+    stream reaches them); late data at-or-below it raises instead of
+    silently corrupting the resume positions.
     """
 
     def __init__(
@@ -289,6 +297,19 @@ class RawTableSource:
                       for k, v in cols.items()}
         self.batch_rows = batch_rows
         self._pos = 0
+        # Snapshot watermark for checkpoint-resume: offsets are positions
+        # into THIS lexsort, so they stay valid across a re-construction
+        # only if the first n_snap sorted rows are unchanged. Rows appended
+        # later with (ts, tx_id) beyond the watermark sort strictly after
+        # every snapshot row (resume correct, new rows served at the end);
+        # late data at-or-before it shifts positions — seek() detects that
+        # and raises instead of silently skipping/re-serving rows.
+        n = len(self._cols["tx_id"])
+        if n:
+            self._snapshot = (n, int(self._cols["tx_datetime_us"][-1]),
+                              int(self._cols["tx_id"][-1]))
+        else:
+            self._snapshot = (0, -1, -1)
 
     @property
     def n(self) -> int:
@@ -306,9 +327,26 @@ class RawTableSource:
 
     @property
     def offsets(self) -> List[int]:
-        return [self._pos]
+        n_snap, wts, wtx = self._snapshot
+        return [self._pos, n_snap, wts, wtx]
 
     def seek(self, offsets: Sequence[int]) -> None:
+        if len(offsets) >= 4:
+            _, n_snap, wts, wtx = (int(x) for x in offsets[:4])
+            ts = self._cols["tx_datetime_us"]
+            tid = self._cols["tx_id"]
+            in_snap = (ts < wts) | ((ts == wts) & (tid <= wtx))
+            got = int(in_snap.sum())
+            if got != n_snap or not bool(in_snap[:got].all()):
+                raise ValueError(
+                    "RawTableSource resume: the table changed at or below "
+                    f"the checkpoint watermark (ts={wts}, tx_id={wtx}): "
+                    f"expected {n_snap} snapshot rows, found {got}. Late "
+                    "or rewritten data shifts sort positions, so resuming "
+                    "by offset would skip or re-serve rows — re-run the "
+                    "backfill from scratch (or bound it with "
+                    "from_day/to_day)."
+                )
         self._pos = int(offsets[0])
 
 

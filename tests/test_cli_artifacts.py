@@ -258,3 +258,23 @@ def test_backend_probe_failfast(monkeypatch):
     import jax
 
     jax.config.update("jax_platforms", "cpu")  # restore the test pin
+
+
+def test_probe_cache_roundtrip_and_garbage(monkeypatch, tmp_path):
+    from real_time_fraud_detection_system_tpu import cli
+
+    path = str(tmp_path / "probe.json")
+    monkeypatch.setattr(cli, "_probe_cache_path", lambda: path)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert not cli._probe_cache_fresh(600)  # no cache yet
+    cli._probe_cache_store()
+    assert cli._probe_cache_fresh(600)
+    assert not cli._probe_cache_fresh(0)  # ttl zero = expired
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    assert not cli._probe_cache_fresh(600)  # platform change invalidates
+    # garbage content (valid JSON, wrong shape) must mean "no cache",
+    # never a crash
+    for garbage in ("[]", '"x"', '{"t": null}', '{"t": []}', "{not json"):
+        with open(path, "w") as f:
+            f.write(garbage)
+        assert not cli._probe_cache_fresh(600)

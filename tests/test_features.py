@@ -105,3 +105,31 @@ def test_feedback_label_application(small_dataset):
 
     i = FEATURE_NAMES.index("TERMINAL_ID_RISK_1DAY_WINDOW")
     assert float(feats[0, i]) == 1.0
+
+
+def test_oracle_shuffled_input_identical():
+    """The oracle's realignment is an explicit index join: a shuffled copy
+    of the same rows (unique timestamps) must produce the identical
+    feature matrix after its internal chronological sort."""
+    from real_time_fraud_detection_system_tpu.data.generator import (
+        Transactions,
+    )
+
+    rng = np.random.default_rng(7)
+    n = 800
+    secs = rng.choice(40 * 86400, size=n, replace=False).astype(np.int64)
+    secs.sort()
+    txs = Transactions(
+        tx_id=np.arange(n, dtype=np.int64),
+        tx_time_seconds=secs,
+        tx_time_days=(secs // 86400).astype(np.int32),
+        customer_id=rng.integers(0, 20, n),
+        terminal_id=rng.integers(0, 30, n),
+        amount_cents=rng.integers(100, 30000, n),
+        tx_fraud=(rng.random(n) < 0.05).astype(np.int8),
+        tx_fraud_scenario=np.zeros(n, dtype=np.int8),
+    )
+    perm = rng.permutation(n)
+    a = pandas_rolling_features(txs)
+    b = pandas_rolling_features(txs.slice(perm))
+    np.testing.assert_array_equal(a, b)

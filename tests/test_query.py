@@ -173,3 +173,25 @@ def test_raw_transactions_report(tmp_path):
     assert rep["total_amount"] == 21.0
     assert [x["transactions"] for x in rep["days"]] == [3, 2, 1]
     assert rep["days"][0]["day"].startswith("2025-")
+
+
+def test_psi_tied_reference_detects_shift():
+    """A heavily tied reference (most scores identical) must not collapse
+    all bins into one and report 'stable' for a genuinely shifted current
+    window (fallback to fixed-width bins over the pooled range)."""
+    from real_time_fraud_detection_system_tpu.io.query import _psi
+
+    rng = np.random.default_rng(0)
+    ref = np.zeros(5000)  # all deciles identical
+    ref[:50] = rng.uniform(0.8, 1.0, 50)
+    cur = rng.uniform(0.4, 0.9, 5000)  # mass moved well away from 0
+    assert _psi(ref, cur) > 0.25
+    # identical tied samples still read stable
+    assert _psi(ref, ref.copy()) < 0.1
+
+
+def test_psi_constant_identical_samples():
+    from real_time_fraud_detection_system_tpu.io.query import _psi
+
+    ref = np.full(100, 0.5)
+    assert _psi(ref, ref.copy()) == 0.0

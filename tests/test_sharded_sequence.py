@@ -269,3 +269,27 @@ def test_sharded_sequence_run_loop_and_sink(params):
     assert len(got["tx_id"]) == 3 * 64
     p = got["prediction"]
     assert ((p >= 0) & (p <= 1)).all()
+
+
+def test_non_pow2_local_capacity_rejected():
+    """capacity 24576 / 4 devices = 6144 passes divisibility but is not a
+    power of two — the `& (cap_local - 1)` slot math would silently merge
+    distinct customers' histories, so it must be rejected loudly."""
+    import pytest
+
+    from real_time_fraud_detection_system_tpu.config import (
+        Config,
+        FeatureConfig,
+    )
+    from real_time_fraud_detection_system_tpu.parallel.sequence_step import (
+        reshard_history_state,
+    )
+    from real_time_fraud_detection_system_tpu.features.history import (
+        init_history_state,
+    )
+
+    cfg = Config(features=FeatureConfig(
+        customer_capacity=24576, terminal_capacity=1024, history_len=8))
+    state = init_history_state(cfg.features)
+    with pytest.raises(ValueError, match="power of two"):
+        reshard_history_state(state, cfg, 4)
