@@ -15,16 +15,20 @@ slow, hung, or down):
   STREAMED: the child prints ``BENCH_ALIVE`` the moment ``jax.devices()``
   returns and ``BENCH_PROGRESS`` lines as it works, so the parent can
   tell a live-but-slow child (extend the budget) from a truly hung one
-  (kill it). Bring-up has been observed blocking > 500 s, so the single
-  TPU attempt waits up to 1100 s for liveness — one patient attempt
-  beats two impatient ones (round-2 lesson: 2×480 s lost to a ~500 s+
-  bring-up);
+  (kill it);
+- the tunnel has been observed to hang for hours then recover suddenly
+  (round-3 log in BASELINE.md), so the parent runs a LADDER of spaced
+  TPU attempts across a ``BENCH_WINDOW_S`` wall clock (default 2700 s):
+  one 600 s-liveness attempt, then — with the CPU fallback result
+  banked as insurance — 300 s-liveness re-attempts every ~60 s. The
+  first attempt that goes live wins; SIGTERM mid-ladder still emits the
+  banked CPU line;
 - after liveness, every progress line re-arms a settle timer; a child
   that stalls mid-measurement is killed, bounded by a hard cap;
-- if the TPU attempt fails, the harness falls back to CPU — and there
-  the headline is the sklearn-oracle path (``--scorer cpu``, the
-  reference-equivalent serving pipeline), NOT the MXU-shaped GEMM
-  kernel on CPU, which is reported under ``detail.jax_cpu`` instead;
+- when every TPU attempt fails, the emitted headline is the CPU
+  sklearn-oracle path (``--scorer cpu``, the reference-equivalent
+  serving pipeline), NOT the MXU-shaped GEMM kernel on CPU, which is
+  reported under ``detail.jax_cpu`` instead;
 - batch size starts modest (16k) and scales up, keeping the best
   successful size — a failed 256k-row first allocation no longer kills
   the run;
@@ -222,6 +226,10 @@ def _child_main(args) -> None:
         flush=True,
     )
     on_cpu = jax.default_backend() == "cpu"
+    # All measurement sections, scaled down (CI coverage of the TPU-only
+    # code paths on CPU; never set by the driver).
+    full = (not (on_cpu or args.quick)
+            or os.environ.get("BENCH_FULL_SECTIONS") == "1")
     rng = np.random.default_rng(0)
 
     cfg = Config(
@@ -288,7 +296,64 @@ def _child_main(args) -> None:
     if best_rows == 0:
         raise RuntimeError(f"no batch size succeeded ({size_error})")
 
-    # ---- classify latency percentiles at the serving batch size ----
+    # ---- z-mode shootout: bf16 vs int8 on the MXU (forest only) --------
+    # gemm_leaf_sum's dominant contraction is exact in int8 (operands are
+    # tiny integers); the int8 MXU path peaks at 2× bf16 on v5e. Measure
+    # both, assert exactness, and let the winner take the headline.
+    z_stats = None
+    if args.model == "forest" and full:
+        try:
+            from real_time_fraud_detection_system_tpu.models.forest import (
+                gemm_predict_proba,
+            )
+
+            c = _make_batch_cols(rng, best_rows)
+            zbatch = jax.tree.map(jnp.asarray, make_batch(**c))
+            z_stats = {}
+
+            def _z_step(zm):
+                def s(fstate, params, batch):
+                    fstate, feats = update_and_featurize(fstate, batch,
+                                                         fcfg)
+                    p = gemm_predict_proba(params,
+                                           transform(scaler, feats),
+                                           z_mode=zm)
+                    return fstate, jnp.where(batch.valid, p, 0.0)
+
+                return jax.jit(s, donate_argnums=(0,))
+
+            probs_by_mode = {}
+            for zm in ("bf16", "int8", "f32"):
+                _progress(f"z_mode={zm}")
+                zstep = _z_step(zm)
+                fs = init_feature_state(fcfg)
+                fs, zp = zstep(fs, params, zbatch)
+                jax.block_until_ready(zp)
+                probs_by_mode[zm] = np.asarray(zp)
+                if zm == "f32":
+                    continue  # exactness oracle only — not timed
+                t0 = time.perf_counter()
+                iters = 0
+                while time.perf_counter() - t0 < args.seconds:
+                    for _ in range(4):
+                        fs, zp = zstep(fs, params, zbatch)
+                    jax.block_until_ready(zp)
+                    iters += 4
+                wall = time.perf_counter() - t0
+                z_stats[zm] = round(iters * best_rows / wall, 1)
+            z_stats["max_abs_delta_int8_vs_f32"] = float(
+                np.abs(probs_by_mode["int8"] - probs_by_mode["f32"]).max())
+            z_stats["max_abs_delta_bf16_vs_f32"] = float(
+                np.abs(probs_by_mode["bf16"] - probs_by_mode["f32"]).max())
+            winner = max(("bf16", "int8"), key=lambda m: z_stats[m])
+            z_stats["winner"] = winner
+            if z_stats[winner] > best_tps:
+                best_tps = z_stats[winner]
+                best_ms = best_rows / best_tps * 1e3
+        except Exception as e:
+            z_stats = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+
+    # ---- classify latency: p50/p99 across serving batch sizes ----------
     _progress("latency percentiles")
     serve_rows = 4096
     # Engine-loop batch: on TPU, per-call overhead (tunnel RTT when
@@ -296,21 +361,47 @@ def _child_main(args) -> None:
     # at a size where the device does real work per round trip, like the
     # throughput headline does.
     engine_rows = 65536 if not (args.quick or on_cpu) else serve_rows
-    lat_iters = 10 if args.quick or on_cpu else 100
-    c = _make_batch_cols(rng, serve_rows)
-    sbatch = jax.tree.map(jnp.asarray, make_batch(**c))
-    sstate = init_feature_state(fcfg)
-    sstate, probs = step(sstate, params, sbatch)  # warmup/compile
-    jax.block_until_ready(probs)
-    lats = []
-    for _ in range(lat_iters):
-        t0 = time.perf_counter()
-        sstate, probs = step(sstate, params, sbatch)
+    lat_iters = 10 if args.quick or on_cpu else 40
+    lat_sizes = ([1024, 4096, 16384, 65536] if (full and not on_cpu)
+                 else [1024, serve_rows] if full else [serve_rows])
+    latency_by_batch = {}
+    step_p50_ms = step_p99_ms = 0.0
+    for n_rows in lat_sizes:
+        c = _make_batch_cols(rng, n_rows)
+        sbatch = jax.tree.map(jnp.asarray, make_batch(**c))
+        sstate = init_feature_state(fcfg)
+        sstate, probs = step(sstate, params, sbatch)  # warmup/compile
         jax.block_until_ready(probs)
-        lats.append(time.perf_counter() - t0)
-    lats = np.asarray(lats)
-    step_p50_ms = float(np.percentile(lats, 50) * 1e3)
-    step_p99_ms = float(np.percentile(lats, 99) * 1e3)
+        lats = []
+        for _ in range(lat_iters):
+            t0 = time.perf_counter()
+            sstate, probs = step(sstate, params, sbatch)
+            jax.block_until_ready(probs)
+            lats.append(time.perf_counter() - t0)
+        lats = np.asarray(lats)
+        p50 = float(np.percentile(lats, 50) * 1e3)
+        p99 = float(np.percentile(lats, 99) * 1e3)
+        latency_by_batch[str(n_rows)] = {"p50_ms": round(p50, 3),
+                                         "p99_ms": round(p99, 3)}
+        if n_rows == serve_rows:
+            step_p50_ms, step_p99_ms = p50, p99
+        _progress(f"latency size={n_rows} p50={p50:.1f}ms")
+
+    # ---- per-call overhead probe (tunnel RTT / dispatch floor) ---------
+    # One trivial op round trip: upper-bounds the fixed cost every
+    # dispatch pays. Over the axon tunnel this IS the serving-latency
+    # floor; locally attached it is ~dispatch overhead. Separates "the
+    # loop is slow" from "the wire is slow" in the engine numbers below.
+    _progress("rtt probe")
+    tiny = jnp.zeros((8, 128), jnp.float32)
+    tiny_f = jax.jit(lambda a: a.sum())
+    jax.block_until_ready(tiny_f(tiny))
+    rtts = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        jax.block_until_ready(tiny_f(tiny))
+        rtts.append(time.perf_counter() - t0)
+    rtt_p50_ms = float(np.percentile(np.asarray(rtts), 50) * 1e3)
 
     # ---- engine-loop latency (host decode + device step per micro-batch)
     _progress("engine loop")
@@ -321,28 +412,80 @@ def _child_main(args) -> None:
         )
 
         n_eng = 8 if args.quick or on_cpu else 50
+        # Depth-8 pipelining on TPU: per-dispatch overhead (tunnel RTT
+        # when benched remotely) overlaps across in-flight batches
+        # instead of serializing the loop.
+        depth = 2 if (args.quick or on_cpu) else 8
         ecfg = Config(
             features=FeatureConfig(customer_capacity=8192,
                                    terminal_capacity=16384),
             runtime=RuntimeConfig(batch_buckets=(engine_rows,),
                                   max_batch_rows=engine_rows,
-                                  trigger_seconds=0.0),
+                                  trigger_seconds=0.0,
+                                  pipeline_depth=depth),
         )
-        def _engine_stats(e) -> dict:
+        def _engine_stats(e, rows=None, n=None) -> dict:
             """Warmup run (jit compile outside the stats), measured run,
             rounded stats dict — shared by every engine-loop variant."""
-            e.run(_RandSource(1, engine_rows, seed=3), trigger_seconds=0.0)
-            s = e.run(_RandSource(n_eng, engine_rows), trigger_seconds=0.0)
+            rows = rows or engine_rows
+            n = n or n_eng
+            e.run(_RandSource(1, rows, seed=3), trigger_seconds=0.0)
+            s = e.run(_RandSource(n, rows), trigger_seconds=0.0)
             return {
-                "batch_rows": engine_rows,
+                "batch_rows": rows,
                 "rows_per_s": round(s["rows_per_s"], 1),
                 "latency_p50_ms": round(s["latency_p50_ms"], 3),
                 "latency_p99_ms": round(s["latency_p99_ms"], 3),
+                "host_prep_p50_ms": round(s["host_prep_p50_ms"], 3),
+                "dispatch_p50_ms": round(s["dispatch_p50_ms"], 3),
+                "result_wait_p50_ms": round(s["result_wait_p50_ms"], 3),
+                "pipeline_depth": s["pipeline_depth"],
             }
 
         engine_stats = _engine_stats(
             ScoringEngine(ecfg, kind="forest", params=params, scaler=scaler)
         )
+        # RTT-vs-device-time decomposition (VERDICT r3 item 2): what the
+        # loop would do with the per-call overhead removed — i.e. with a
+        # locally attached chip instead of the tunnel.
+        dev_ms = None
+        lb = latency_by_batch.get(str(engine_rows))
+        if lb is not None:
+            dev_ms = max(lb["p50_ms"] - rtt_p50_ms, 1e-3)
+        if dev_ms is not None:
+            bound_ms = max(dev_ms, engine_stats["host_prep_p50_ms"])
+            engine_stats["decomposition"] = {
+                "rtt_per_call_ms": round(rtt_p50_ms, 3),
+                "device_step_ms_est": round(dev_ms, 3),
+                "loop_ms_per_batch": round(
+                    engine_rows / max(engine_stats["rows_per_s"], 1e-9)
+                    * 1e3, 3),
+                "projected_local_rows_per_s": round(
+                    engine_rows / (bound_ms / 1e3), 1),
+            }
+        if full:
+            # Big-batch loop: amortize the per-batch fixed costs further
+            # (the serving analogue of the 1M-row throughput headline).
+            _progress("engine loop 262k")
+            try:
+                big = 262144 if not on_cpu else 8192
+                bcfg = Config(
+                    features=FeatureConfig(customer_capacity=8192,
+                                           terminal_capacity=16384),
+                    runtime=RuntimeConfig(batch_buckets=(big,),
+                                          max_batch_rows=big,
+                                          trigger_seconds=0.0,
+                                          pipeline_depth=depth),
+                )
+                engine_stats["big_batch"] = _engine_stats(
+                    ScoringEngine(bcfg, kind="forest", params=params,
+                                  scaler=scaler),
+                    rows=big, n=12,
+                )
+            except Exception as e:
+                engine_stats["big_batch"] = {
+                    "error": f"{type(e).__name__}: {str(e)[:160]}"
+                }
         if not (on_cpu or args.quick):
             # Sharded serving loop on a 1-chip mesh: the shard_map step +
             # partition/spill machinery running on real hardware (the
@@ -389,6 +532,61 @@ def _child_main(args) -> None:
                 ),
             }
 
+    # ---- fused Pallas featurize+score vs plain-jnp composition ---------
+    # The linear-scorer kernel (ops/pallas_kernels.py). On CPU it only
+    # interprets (slow, exact) — measured on TPU only. Answers VERDICT r3
+    # item 8: quantify the fused kernel against XLA's own fusion.
+    pallas_stats = None
+    if full:
+        _progress("pallas fused vs unfused")
+        try:
+            from real_time_fraud_detection_system_tpu.features.online import (
+                update_and_score_pallas,
+            )
+            from real_time_fraud_detection_system_tpu.models.logreg import (
+                init_logreg,
+                logreg_predict_proba,
+            )
+
+            lp = init_logreg(15)
+            pl_rows = 65536 if not on_cpu else 1024
+            c = _make_batch_cols(rng, pl_rows)
+            pbatch = jax.tree.map(jnp.asarray, make_batch(**c))
+
+            def unfused(fstate, batch):
+                fstate, feats = update_and_featurize(fstate, batch, fcfg)
+                pr = logreg_predict_proba(lp, transform(scaler, feats))
+                return fstate, jnp.where(batch.valid, pr, 0.0)
+
+            def fused(fstate, batch):
+                fstate, pr, _ = update_and_score_pallas(
+                    fstate, batch, fcfg, scaler.mean, scaler.scale,
+                    lp.w, lp.b)
+                return fstate, jnp.where(batch.valid, pr, 0.0)
+
+            pallas_stats = {}
+            outs = {}
+            for name, fn in (("unfused", unfused), ("fused", fused)):
+                jfn = jax.jit(fn, donate_argnums=(0,))
+                fs = init_feature_state(fcfg)
+                fs, pr = jfn(fs, pbatch)
+                jax.block_until_ready(pr)
+                outs[name] = np.asarray(pr)
+                t0 = time.perf_counter()
+                iters = 0
+                while time.perf_counter() - t0 < min(args.seconds, 3.0):
+                    for _ in range(4):
+                        fs, pr = jfn(fs, pbatch)
+                    jax.block_until_ready(pr)
+                    iters += 4
+                wall = time.perf_counter() - t0
+                pallas_stats[f"{name}_rows_per_s"] = round(
+                    iters * pl_rows / wall, 1)
+            pallas_stats["max_abs_delta"] = float(
+                np.abs(outs["fused"] - outs["unfused"]).max())
+        except Exception as e:
+            pallas_stats = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+
     # ---- long-context scorer: sequence serving throughput --------------
     # The fused history step (features/history.py): per-customer ring
     # update + causal-transformer score per row. Guarded — a failure here
@@ -427,6 +625,7 @@ def _child_main(args) -> None:
             "batch_rows": seq_rows,
             "history_len": seq_cfg.history_len,
             "d_model": 32,
+            "backend": jax.default_backend(),
         }
     except Exception as e:
         seq_stats = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
@@ -484,6 +683,8 @@ def _child_main(args) -> None:
         "txns_per_sec_by_batch": by_size,
         "p50_classify_ms": round(step_p50_ms, 3),
         "p99_classify_ms": round(step_p99_ms, 3),
+        "latency_by_batch": latency_by_batch,
+        "rtt_per_call_ms": round(rtt_p50_ms, 3),
         "engine_loop": engine_stats,
         "mfu": round(mfu, 4),
         "model_flops_per_row": flops_row,
@@ -495,6 +696,10 @@ def _child_main(args) -> None:
         "ingest_decoder": "native" if native.native_available() else
         "python",
     }
+    if z_stats is not None:
+        detail["z_mode"] = z_stats
+    if pallas_stats is not None:
+        detail["pallas_fused"] = pallas_stats
     if seq_stats is not None:
         detail["sequence_scorer"] = seq_stats
     if cpu_tps is not None:
@@ -629,37 +834,97 @@ def main() -> None:
             and "tpu" not in ambient:
         # Caller pinned a CPU-only platform (sandbox smoke run): one
         # attempt. An ambient TPU platform (the driver's tunnel env sets
-        # JAX_PLATFORMS=axon) still gets the patient TPU attempt.
-        plan = [(ambient, 300.0, 300.0, 900.0, None)]
-    else:
-        # ONE patient TPU attempt (bring-up observed >500 s; round 2 lost
-        # 2×480 s to exactly that), then the CPU fallback. The liveness
-        # probe means a dead tunnel is detected by silence, not guessed
-        # at by a fixed overall timeout.
-        liveness = 300.0 if args.quick else 1100.0
-        plan = [
-            (None, liveness, 420.0, liveness + 900.0, None),
-            ("cpu", 300.0, 300.0, 1200.0, "cpu"),
-        ]
-
-    errors = []
-    for platform, liveness_s, settle_s, cap_s, fallback in plan:
-        result, err = _run_child(args, platform, liveness_s, settle_s,
-                                 cap_s)
+        # JAX_PLATFORMS=axon) still gets the TPU attempt ladder.
+        result, err = _run_child(args, ambient, 300.0, 300.0, 900.0)
         if result is not None:
-            if fallback:
-                result.setdefault("detail", {})["fallback"] = fallback
-                result.setdefault("detail", {})["tpu_errors"] = errors[-2:]
             print(json.dumps(result))
             return
-        errors.append(err)
+        print(json.dumps({
+            "metric": "score_txns_per_sec", "value": 0.0,
+            "unit": "txns/s", "vs_baseline": 0.0, "error": str(err)[-600:],
+        }))
+        sys.exit(1)
 
+    # The tunnel's observed behavior (rounds 1-3): when healthy,
+    # jax.devices() returns in <1 s (occasionally ~500 s while warming);
+    # when sick, it hangs forever — and can come back at ANY point in a
+    # multi-hour window. One patient attempt therefore loses whenever the
+    # tunnel recovers after its liveness budget expires. The ladder:
+    #
+    #   1. one TPU attempt with a 600 s liveness budget (covers the
+    #      slow-but-live bring-up);
+    #   2. bank the CPU fallback measurement (the honest sklearn-oracle
+    #      headline) — an answer now exists no matter what;
+    #   3. keep re-attempting TPU with 300 s budgets, 60 s apart, until
+    #      the BENCH_WINDOW_S wall clock (default 2700 s) runs out;
+    #   4. emit the TPU result the moment an attempt lands; else the
+    #      banked CPU result with the attempt log.
+    #
+    # SIGTERM/SIGINT mid-ladder prints the banked result before dying so
+    # an impatient caller still gets a parseable line.
+    import signal
+
+    try:
+        window_s = float(os.environ.get("BENCH_WINDOW_S",
+                                        "600" if args.quick else "2700"))
+    except ValueError:
+        window_s = 2700.0
+    t_start = time.monotonic()
+
+    def _remaining() -> float:
+        return window_s - (time.monotonic() - t_start)
+
+    errors: list = []
+    banked: list = []  # [result] once the CPU fallback lands
+
+    def _emit_banked_and_exit(signum=None, frame=None):
+        if banked:
+            banked[0].setdefault("detail", {})["fallback"] = "cpu"
+            banked[0]["detail"]["tpu_errors"] = errors[-3:]
+            print(json.dumps(banked[0]), flush=True)
+            sys.exit(0)
+        sys.exit(1)
+
+    signal.signal(signal.SIGTERM, _emit_banked_and_exit)
+    signal.signal(signal.SIGINT, _emit_banked_and_exit)
+
+    def _tpu_attempt(liveness_s: float):
+        result, err = _run_child(args, None, liveness_s, 420.0,
+                                 liveness_s + 1500.0)
+        if result is not None:
+            d = result.setdefault("detail", {})
+            d["tpu_attempts"] = len(errors) + 1
+            if errors:
+                d["tpu_errors"] = errors[-3:]
+            print(json.dumps(result))
+            sys.exit(0)
+        errors.append(err)
+        print(f"# tpu attempt {len(errors)} failed: {err}",
+              file=sys.stderr, flush=True)
+
+    _tpu_attempt(300.0 if args.quick else 600.0)
+
+    cpu_result, cpu_err = _run_child(args, "cpu", 300.0, 300.0, 1200.0)
+    cpu_errors: list = []
+    if cpu_result is not None:
+        banked.append(cpu_result)
+    else:
+        # kept OUT of `errors`: that list counts TPU attempts and feeds
+        # detail.tpu_errors; a CPU failure would misreport both
+        cpu_errors.append(f"cpu fallback: {cpu_err}")
+
+    while _remaining() > 300.0:
+        time.sleep(min(60.0, max(0.0, _remaining() - 300.0)))
+        _tpu_attempt(min(300.0, _remaining() - 60.0))
+
+    if banked:
+        _emit_banked_and_exit()
     print(json.dumps({
         "metric": "score_txns_per_sec",
         "value": 0.0,
         "unit": "txns/s",
         "vs_baseline": 0.0,
-        "error": " || ".join(errors)[-600:],
+        "error": " || ".join(str(e) for e in errors + cpu_errors)[-600:],
     }))
     sys.exit(1)
 

@@ -28,6 +28,8 @@ from real_time_fraud_detection_system_tpu.core.batch import (
     TxBatch,
     bucket_size,
     make_batch,
+    pack_batch,
+    unpack_batch,
 )
 from real_time_fraud_detection_system_tpu.features.online import (
     FeatureState,
@@ -188,7 +190,10 @@ class ScoringEngine:
             and cfg.features.customer_source == "table"
         )
 
-        def step(fstate: FeatureState, params, scaler: Scaler, batch: TxBatch):
+        def step(fstate: FeatureState, params, scaler: Scaler, packed):
+            # One packed H2D array per batch (see core.batch.pack_batch):
+            # the unpack is free bitcasts inside the fused program.
+            batch = unpack_batch(packed)
             if use_pallas:
                 fstate, probs, feats = update_and_score_pallas(
                     fstate, batch, fcfg, scaler.mean, scaler.scale,
@@ -249,7 +254,8 @@ class ScoringEngine:
         self._loss = None
         fcfg = cfg.features
 
-        def step(hstate, params, scaler, batch: TxBatch):
+        def step(hstate, params, scaler, packed):
+            batch = unpack_batch(packed)
             hstate, probs = update_and_score(hstate, params, batch, fcfg)
             feats = jnp.zeros((batch.size, N_FEATURES), jnp.float32)
             return hstate, params, probs, feats
@@ -280,7 +286,7 @@ class ScoringEngine:
             pad_to=pad,
         )
         t1 = time.perf_counter()
-        jbatch = jax.tree.map(jnp.asarray, batch)
+        jbatch = jnp.asarray(pack_batch(batch))
         fstate, params, probs, feats = self._step(
             self.state.feature_state, self.state.params, self.state.scaler, jbatch
         )

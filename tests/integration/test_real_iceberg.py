@@ -1,0 +1,101 @@
+"""IcebergSink against a REAL pyiceberg catalog (local sqlite + warehouse).
+
+Opt-in: skipped unless ``pyiceberg`` is installed. The hermetic twin
+(``tests/test_iceberg_raw_table.py``) pins the sink logic against a fake
+catalog; this test closes the library-level gap — real catalog, real
+Iceberg metadata, real Parquet data files, read back through a pyiceberg
+table scan (the reference MERGEs into live Iceberg at
+``pyspark/scripts/kafka_s3_sink_transactions.py:193-222``).
+
+No server is needed: pyiceberg's sql catalog over sqlite with a local
+filesystem warehouse is a complete Iceberg implementation.
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("pyiceberg")
+
+from real_time_fraud_detection_system_tpu.io.sink import (  # noqa: E402
+    IcebergSink,
+    make_iceberg_sink,
+)
+from real_time_fraud_detection_system_tpu.runtime.engine import (  # noqa: E402
+    BatchResult,
+)
+
+
+def _fake_result(n: int, seed: int, batch_index: int) -> BatchResult:
+    rng = np.random.default_rng(seed)
+    return BatchResult(
+        tx_id=np.arange(batch_index * n, (batch_index + 1) * n,
+                        dtype=np.int64),
+        tx_datetime_us=np.sort(
+            rng.integers(0, 10 * 86_400_000_000, n).astype(np.int64)),
+        customer_id=rng.integers(0, 50, n, dtype=np.int64),
+        terminal_id=rng.integers(0, 100, n, dtype=np.int64),
+        amount_cents=rng.integers(100, 50000, n, dtype=np.int64),
+        features=rng.normal(0, 1, (n, 15)).astype(np.float32),
+        probs=rng.uniform(0, 1, n),
+        latency_s=0.0,
+        batch_index=batch_index,
+    )
+
+
+@pytest.fixture()
+def catalog(tmp_path):
+    from pyiceberg.catalog import load_catalog
+
+    return load_catalog(
+        "it",
+        **{
+            "type": "sql",
+            "uri": f"sqlite:///{tmp_path}/catalog.db",
+            "warehouse": f"file://{tmp_path}/warehouse",
+        },
+    )
+
+
+def test_append_and_scan_roundtrip(catalog):
+    try:
+        catalog.create_namespace("payment")
+    except Exception:
+        pass  # already exists
+    sink = make_iceberg_sink(catalog=catalog)
+    r0 = _fake_result(200, seed=0, batch_index=0)
+    r1 = _fake_result(150, seed=1, batch_index=1)
+    sink.append(r0)
+    sink.append(r1)
+
+    table = catalog.load_table(IcebergSink.TABLE_DEFAULT)
+    got = table.scan().to_arrow()
+    assert got.num_rows == 350
+    ids = np.sort(got["tx_id"].to_numpy())
+    np.testing.assert_array_equal(
+        ids, np.concatenate([r0.tx_id, r1.tx_id]))
+    # µs timestamp fidelity through the Iceberg schema (the binary
+    # decimal + µs precision the reference sink preserves)
+    t_us = {int(i): v for i, v in zip(
+        got["tx_id"].to_numpy(),
+        got["tx_datetime"].cast("int64").to_numpy())}
+    for i, ts in zip(r0.tx_id.tolist(), r0.tx_datetime_us.tolist()):
+        assert t_us[i] == ts
+    # prediction column round-trips as float64
+    p = {int(i): v for i, v in zip(got["tx_id"].to_numpy(),
+                                   got["prediction"].to_numpy())}
+    np.testing.assert_allclose(
+        [p[int(i)] for i in r1.tx_id], r1.probs, atol=0)
+
+
+def test_second_sink_loads_existing_table(catalog):
+    try:
+        catalog.create_namespace("payment")
+    except Exception:
+        pass
+    sink1 = make_iceberg_sink(catalog=catalog)
+    sink1.append(_fake_result(50, seed=2, batch_index=0))
+    # a fresh sink against the same catalog must LOAD, not re-create
+    sink2 = make_iceberg_sink(catalog=catalog)
+    sink2.append(_fake_result(50, seed=3, batch_index=1))
+    table = catalog.load_table(IcebergSink.TABLE_DEFAULT)
+    assert table.scan().to_arrow().num_rows == 100

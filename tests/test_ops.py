@@ -169,3 +169,31 @@ def test_hashing_ranges_and_dispersion():
     assert (h[0] == h[1]).mean() < 0.01
     # determinism
     assert np.array_equal(np.asarray(hash_u32(keys)), np.asarray(hash_u32(keys)))
+
+
+def test_pack_unpack_batch_bitexact():
+    """The single-array H2D packing must round-trip every TxBatch field
+    bit-exactly (uint32 high bits, float32 amounts, -1 labels, padding)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from real_time_fraud_detection_system_tpu.core.batch import (
+        make_batch,
+        pack_batch,
+        unpack_batch,
+    )
+
+    rng = np.random.default_rng(3)
+    n = 200
+    b = make_batch(
+        rng.integers(0, 2**63 - 1, n), rng.integers(0, 2**63 - 1, n),
+        rng.integers(0, 2**45, n), rng.integers(0, 10**7, n),
+        label=rng.integers(-1, 2, n), pad_to=256,
+    )
+    packed = pack_batch(b)
+    assert packed.shape == (7, 256) and packed.dtype == np.int32
+    u = unpack_batch(jnp.asarray(packed))
+    for name, a, c in zip(b._fields, b, u):
+        assert np.asarray(c).dtype == np.asarray(a).dtype, name
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c),
+                                      err_msg=name)

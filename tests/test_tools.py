@@ -43,3 +43,52 @@ def test_step_profile_variants_exact_cpu():
         assert out[variant]["max_abs_diff_vs_sklearn"] < 1e-5, (
             variant, out[variant],
         )
+
+
+def test_parquet_sql_check():
+    """The SQL read-back proof must pass on the bare image (sqlite path;
+    uses DuckDB instead when installed)."""
+    p = _run([sys.executable, "tools/parquet_sql_check.py"], timeout=600)
+    assert p.returncode == 0, (p.stdout[-400:], p.stderr[-800:])
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert out["ok"] is True
+    assert out["mismatches"] == []
+    assert out["rows"] > 1000
+
+
+def test_parquet_sql_check_dedups_replayed_parts(tmp_path):
+    """A directory holding re-scored rows (crash-replay) must still pass:
+    both the SQL view and the numpy oracle apply latest-wins by tx_id."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    d = tmp_path / "analyzed"
+    d.mkdir()
+    rng = np.random.default_rng(0)
+
+    def part(path, tx_ids, processed_at, pred):
+        n = len(tx_ids)
+        pq.write_table(pa.table({
+            "tx_id": pa.array(tx_ids, pa.int64()),
+            "tx_datetime_us": pa.array(
+                np.sort(rng.integers(0, 5 * 86_400_000_000, n)),
+                pa.int64()),
+            "customer_id": pa.array(rng.integers(0, 10, n), pa.int64()),
+            "terminal_id": pa.array(rng.integers(0, 20, n), pa.int64()),
+            "tx_amount": pa.array(rng.uniform(1, 100, n), pa.float64()),
+            "prediction": pa.array(pred, pa.float64()),
+            "processed_at_us": pa.array(
+                np.full(n, processed_at), pa.int64()),
+        }), str(path))
+
+    part(d / "part-00000001.parquet", np.arange(100), 1_000_000,
+         rng.uniform(0, 1, 100))
+    # replay re-scores rows 50..99 later with different predictions
+    part(d / "part-00000002.parquet", np.arange(50, 100), 2_000_000,
+         rng.uniform(0, 1, 50))
+    p = _run([sys.executable, "tools/parquet_sql_check.py",
+              "--dir", str(d)], timeout=300)
+    assert p.returncode == 0, (p.stdout[-400:], p.stderr[-800:])
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert out["ok"] is True and out["rows"] == 100
