@@ -55,6 +55,11 @@ import numpy as np
 ALIVE_LINE = "BENCH_ALIVE"
 PROGRESS_LINE = "BENCH_PROGRESS"
 
+# Live measurement children (parent side): the SIGTERM handler must kill
+# these before exiting, or an orphaned child keeps measuring on the TPU
+# for up to its hard cap after the parent is gone.
+_LIVE_PROCS: list = []
+
 
 def _progress(msg: str) -> None:
     """Child-side liveness breadcrumb (parent re-arms its settle timer)."""
@@ -442,9 +447,35 @@ def _child_main(args) -> None:
                 "pipeline_depth": s["pipeline_depth"],
             }
 
+        import dataclasses as _dc
+
+        def _alerts_cfg(base: Config) -> Config:
+            """emit_features=False twin of an engine config: the [B, 15]
+            feature matrix never leaves HBM — the dominant per-batch D2H
+            when the chip is remote. Same scores, no feature columns."""
+            return Config(
+                features=base.features,
+                runtime=_dc.replace(base.runtime, emit_features=False),
+            )
+
+        def _guarded(key: str, fn) -> None:
+            """A failed variant records ITS OWN error key and never
+            clobbers earlier successful measurements."""
+            try:
+                engine_stats[key] = fn()
+            except Exception as e:
+                engine_stats[key] = {
+                    "error": f"{type(e).__name__}: {str(e)[:160]}"
+                }
+
         engine_stats = _engine_stats(
             ScoringEngine(ecfg, kind="forest", params=params, scaler=scaler)
         )
+        if full:
+            _progress("engine loop alerts-only")
+            _guarded("alerts_only", lambda: _engine_stats(
+                ScoringEngine(_alerts_cfg(ecfg), kind="forest",
+                              params=params, scaler=scaler)))
         # RTT-vs-device-time decomposition (VERDICT r3 item 2): what the
         # loop would do with the per-call overhead removed — i.e. with a
         # locally attached chip instead of the tunnel.
@@ -467,25 +498,22 @@ def _child_main(args) -> None:
             # Big-batch loop: amortize the per-batch fixed costs further
             # (the serving analogue of the 1M-row throughput headline).
             _progress("engine loop 262k")
-            try:
-                big = 262144 if not on_cpu else 8192
-                bcfg = Config(
-                    features=FeatureConfig(customer_capacity=8192,
-                                           terminal_capacity=16384),
-                    runtime=RuntimeConfig(batch_buckets=(big,),
-                                          max_batch_rows=big,
-                                          trigger_seconds=0.0,
-                                          pipeline_depth=depth),
-                )
-                engine_stats["big_batch"] = _engine_stats(
-                    ScoringEngine(bcfg, kind="forest", params=params,
-                                  scaler=scaler),
-                    rows=big, n=12,
-                )
-            except Exception as e:
-                engine_stats["big_batch"] = {
-                    "error": f"{type(e).__name__}: {str(e)[:160]}"
-                }
+            big = 262144 if not on_cpu else 8192
+            bcfg = Config(
+                features=FeatureConfig(customer_capacity=8192,
+                                       terminal_capacity=16384),
+                runtime=RuntimeConfig(batch_buckets=(big,),
+                                      max_batch_rows=big,
+                                      trigger_seconds=0.0,
+                                      pipeline_depth=depth),
+            )
+            _guarded("big_batch", lambda: _engine_stats(
+                ScoringEngine(bcfg, kind="forest", params=params,
+                              scaler=scaler), rows=big, n=12))
+            _guarded("big_batch_alerts", lambda: _engine_stats(
+                ScoringEngine(_alerts_cfg(bcfg), kind="forest",
+                              params=params, scaler=scaler),
+                rows=big, n=12))
         if not (on_cpu or args.quick):
             # Sharded serving loop on a 1-chip mesh: the shard_map step +
             # partition/spill machinery running on real hardware (the
@@ -760,6 +788,7 @@ def _run_child(args, platform, liveness_s, settle_s, hard_cap_s):
 
     proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                             stderr=subprocess.PIPE, text=True, bufsize=1)
+    _LIVE_PROCS.append(proc)
     lines: list = []
     last_line_t = [time.monotonic()]
     alive_t: list = []
@@ -807,6 +836,8 @@ def _run_child(args, platform, liveness_s, settle_s, hard_cap_s):
         time.sleep(1.0)
     t_out.join(timeout=10.0)
     t_err.join(timeout=10.0)
+    if proc in _LIVE_PROCS:
+        _LIVE_PROCS.remove(proc)
 
     if killed_why:
         return None, killed_why
@@ -878,6 +909,11 @@ def main() -> None:
     banked: list = []  # [result] once the CPU fallback lands
 
     def _emit_banked_and_exit(signum=None, frame=None):
+        for p in list(_LIVE_PROCS):  # no orphans holding the TPU
+            try:
+                p.kill()
+            except OSError:
+                pass
         if banked:
             banked[0].setdefault("detail", {})["fallback"] = "cpu"
             banked[0]["detail"]["tpu_errors"] = errors[-3:]

@@ -191,6 +191,35 @@ def cmd_datagen(args) -> int:
         txs.n, cfg.n_customers, cfg.n_terminals, cfg.n_days,
         txs.tx_fraud.mean(), args.out,
     )
+    if args.pg_dsn:
+        # Live-OLTP seeding (the reference datagen container's role,
+        # datagen/data_gen.py:67-147): rows land in real Postgres for a
+        # Debezium connector to CDC out. --pg-rate > 0 drip-feeds.
+        from real_time_fraud_detection_system_tpu.io.pg import PgLive
+        from real_time_fraud_detection_system_tpu.utils.timing import (
+            date_to_epoch_s,
+        )
+
+        pg = PgLive(args.pg_dsn)
+        pg.ensure_schema()
+        pg.upsert_dimension("customers", "customer_id",
+                            customers.customer_id, customers.x,
+                            customers.y)
+        pg.upsert_dimension("terminals", "terminal_id",
+                            terminals.terminal_id, terminals.x,
+                            terminals.y)
+        n = pg.upsert_transactions(
+            {
+                "tx_id": txs.tx_id,
+                "tx_datetime_us": txs.epoch_us(
+                    date_to_epoch_s(cfg.start_date)),
+                "customer_id": txs.customer_id,
+                "terminal_id": txs.terminal_id,
+                "tx_amount_cents": txs.amount_cents,
+            },
+            rate_per_s=args.pg_rate,
+        )
+        log.info("seeded live postgres with %d transactions", n)
     return 0
 
 
@@ -820,6 +849,11 @@ def main(argv=None) -> int:
     p.add_argument("--radius", type=float, default=5.0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--start-date", default="2025-04-01")
+    p.add_argument("--pg-dsn", default=None,
+                   help="also seed a live Postgres (psycopg2 DSN) — the "
+                        "reference datagen container's role")
+    p.add_argument("--pg-rate", type=float, default=0.0,
+                   help="paced rows/s for --pg-dsn (0 = bulk)")
     p.set_defaults(fn=cmd_datagen, needs_backend=False)
 
     p = sub.add_parser("train", help="offline training on a generated table")

@@ -160,6 +160,13 @@ class RuntimeConfig:
     # this many rows (0 = off: one poll = one batch). Amortizes per-step
     # dispatch overhead when the source hands out small batches.
     coalesce_rows: int = 0
+    # False = alerts-only serving: BatchResult.features is zeros and the
+    # [B, 15] feature matrix never leaves the device — the dominant D2H
+    # cost per batch when the chip is remote. Only valid with the device
+    # scorer and no feature cache (both consume host-side features);
+    # sinks that persist feature columns (the analyzed table) should
+    # keep the default.
+    emit_features: bool = True
     # Pad/bucket micro-batches to these row counts to keep the jit cache warm.
     batch_buckets: Sequence[int] = (256, 1024, 4096, 16384, 65536)
     max_batch_rows: int = 65536

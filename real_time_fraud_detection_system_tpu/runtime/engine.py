@@ -163,6 +163,13 @@ class ScoringEngine:
         # Optional runtime.feedback.FeatureCache: every scored row's raw
         # feature vector is cached for the labeled-feedback join.
         self.feature_cache = feature_cache
+        if not cfg.runtime.emit_features and (
+            self.scorer == "cpu" or feature_cache is not None
+        ):
+            raise ValueError(
+                "emit_features=False (alerts-only serving) cannot be "
+                "combined with --scorer cpu or a feature cache: both "
+                "consume host-side feature rows")
         self._feedback_step = None
         self._state_feedback_step = None
         # Depth-bounded tree ensembles score ~100× faster on TPU in the GEMM
@@ -299,7 +306,11 @@ class ScoringEngine:
     def _finish_batch(self, handle: dict) -> BatchResult:
         """Block on the handle's device futures; build the BatchResult."""
         n = handle["n"]
-        feats_np = np.asarray(handle["feats"])[:n]
+        if not self.cfg.runtime.emit_features:
+            # alerts-only mode: the feature matrix stays in HBM
+            feats_np = np.zeros((n, N_FEATURES), np.float32)
+        else:
+            feats_np = np.asarray(handle["feats"])[:n]
         if self.scorer == "cpu":
             # parity/baseline oracle: host-side pipeline on the same features
             # (sklearn pipeline, or a TrainedModel's pure-NumPy path)

@@ -1,0 +1,74 @@
+"""Live Postgres probe (reference ``datagen/data_gen.py:67-147`` role).
+
+Opt-in: skipped unless ``psycopg2`` is installed AND ``RTFDS_PG_DSN``
+points at a reachable server (e.g. the reference's
+``docker-compose up postgres`` →
+``export RTFDS_PG_DSN="dbname=postgres user=postgres password=postgres
+host=localhost"``). Seeds the payment schema, drip-feeds transactions,
+reads them back, and verifies the int64-cents / µs-timestamp fidelity the
+CDC envelopes depend on.
+"""
+
+import os
+import uuid
+
+import numpy as np
+import pytest
+
+pytest.importorskip("psycopg2")
+
+DSN = os.environ.get("RTFDS_PG_DSN")
+if not DSN:
+    pytest.skip("RTFDS_PG_DSN not set (no server to test against)",
+                allow_module_level=True)
+
+from real_time_fraud_detection_system_tpu.io.pg import PgLive  # noqa: E402
+
+
+@pytest.fixture()
+def pg():
+    schema = f"it_{uuid.uuid4().hex[:10]}"
+    live = PgLive(DSN, schema=schema)
+    live.ensure_schema()
+    yield live
+    cur = live.conn.cursor()
+    cur.execute(f"DROP SCHEMA {schema} CASCADE")
+    live.conn.commit()
+    live.conn.close()
+
+
+def _cols(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "tx_id": np.arange(n, dtype=np.int64),
+        "tx_datetime_us": np.sort(
+            rng.integers(0, 200 * 86_400_000_000, n).astype(np.int64)),
+        "customer_id": rng.integers(0, 50, n, dtype=np.int64),
+        "terminal_id": rng.integers(0, 100, n, dtype=np.int64),
+        "tx_amount_cents": rng.integers(1, 10**9, n, dtype=np.int64),
+    }
+
+
+def test_seed_write_read_exact(pg):
+    rng = np.random.default_rng(1)
+    pg.upsert_dimension("customers", "customer_id", np.arange(50),
+                        rng.uniform(0, 100, 50), rng.uniform(0, 100, 50))
+    pg.upsert_dimension("terminals", "terminal_id", np.arange(100),
+                        rng.uniform(0, 100, 100), rng.uniform(0, 100, 100))
+    cols = _cols(500)
+    assert pg.upsert_transactions(cols, batch_rows=128) == 500
+    back = pg.read_transactions()
+    for k in cols:
+        np.testing.assert_array_equal(back[k], cols[k], err_msg=k)
+
+
+def test_upsert_is_idempotent_and_updates(pg):
+    cols = _cols(100, seed=2)
+    pg.upsert_transactions(cols)
+    cols2 = dict(cols)
+    cols2["tx_amount_cents"] = cols["tx_amount_cents"] + 1
+    pg.upsert_transactions(cols2)  # same keys → CDC-visible UPDATEs
+    back = pg.read_transactions()
+    assert len(back["tx_id"]) == 100  # no duplicates
+    np.testing.assert_array_equal(back["tx_amount_cents"],
+                                  cols2["tx_amount_cents"])

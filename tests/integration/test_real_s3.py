@@ -1,0 +1,56 @@
+"""S3Store against a REAL object store via boto3 (MinIO or AWS).
+
+Opt-in: skipped unless ``boto3`` is installed AND ``RTFDS_S3_BUCKET`` is
+set (plus optional ``RTFDS_S3_ENDPOINT`` for MinIO — the reference's
+object store, ``docker-compose.yml`` minio service, used by
+``load_initial_data.py:269-287``). The hermetic twin
+(``tests/test_store.py``) runs the same store contract against fakes.
+"""
+
+import os
+import uuid
+
+import pytest
+
+pytest.importorskip("boto3")
+
+BUCKET = os.environ.get("RTFDS_S3_BUCKET")
+if not BUCKET:
+    pytest.skip("RTFDS_S3_BUCKET not set (no object store to test "
+                "against)", allow_module_level=True)
+
+from real_time_fraud_detection_system_tpu.io.store import (  # noqa: E402
+    S3Store,
+)
+
+
+@pytest.fixture()
+def store():
+    kwargs = {}
+    if os.environ.get("RTFDS_S3_ENDPOINT"):
+        kwargs["endpoint_url"] = os.environ["RTFDS_S3_ENDPOINT"]
+    s = S3Store(BUCKET, prefix=f"it-{uuid.uuid4().hex[:10]}", **kwargs)
+    yield s
+    for key in s.list():
+        s.delete(key)
+
+
+def test_put_get_list_move_delete(store):
+    store.put("a/x.bin", b"\x00\x01payload")
+    store.put("a/y.bin", b"second")
+    assert store.exists("a/x.bin")
+    assert store.get("a/x.bin") == b"\x00\x01payload"
+    assert sorted(store.list("a/")) == ["a/x.bin", "a/y.bin"]
+    store.move("a/y.bin", "b/y.bin")
+    assert not store.exists("a/y.bin")
+    assert store.get("b/y.bin") == b"second"
+    store.delete("a/x.bin")
+    assert not store.exists("a/x.bin")
+
+
+def test_missing_key_tolerated(store):
+    """The 404 tolerance the reference's loader relies on
+    (``load_initial_data.py`` catches missing feature objects)."""
+    assert not store.exists("nope/missing.bin")
+    with pytest.raises(Exception):
+        store.get("nope/missing.bin")

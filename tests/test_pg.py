@@ -1,0 +1,123 @@
+"""Live-Postgres boundary logic, hermetically (fake DB-API connection).
+
+The wire-level twin lives in ``tests/integration/test_real_postgres.py``
+(opt-in, needs psycopg2 + a server); here the conversion fidelity and the
+batched-upsert mechanics are pinned without either.
+"""
+
+import datetime as dt
+
+import numpy as np
+
+from real_time_fraud_detection_system_tpu.io.pg import (
+    PgLive,
+    ddl_statements,
+    pg_rows_to_transactions,
+    transactions_to_pg_rows,
+)
+
+
+class _FakeCursor:
+    def __init__(self, log):
+        self.log = log
+        self._rows = []
+
+    def execute(self, sql, params=None):
+        self.log.append(("execute", " ".join(sql.split()), params))
+
+    def executemany(self, sql, rows):
+        self.log.append(("executemany", " ".join(sql.split()), list(rows)))
+
+    def fetchall(self):
+        return self._rows
+
+
+class _FakeConn:
+    def __init__(self):
+        self.log = []
+        self.commits = 0
+
+    def cursor(self):
+        return _FakeCursor(self.log)
+
+    def commit(self):
+        self.commits += 1
+
+
+def _cols(n=7, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "tx_id": np.arange(n, dtype=np.int64),
+        "tx_datetime_us": np.sort(
+            rng.integers(0, 10 * 86_400_000_000, n).astype(np.int64)),
+        "customer_id": rng.integers(0, 5, n, dtype=np.int64),
+        "terminal_id": rng.integers(0, 9, n, dtype=np.int64),
+        "tx_amount_cents": np.asarray(
+            [1, 99, 100, 101, 12345, 999999999, 50], np.int64)[:n],
+    }
+
+
+def test_row_conversion_roundtrip_exact():
+    cols = _cols()
+    rows = transactions_to_pg_rows(cols)
+    # DECIMAL(10,2) string form carries exact cents, incl. sub-dollar
+    assert rows[0][4] == "0.01" and rows[1][4] == "0.99"
+    assert rows[5][4] == "9999999.99"
+    back = pg_rows_to_transactions(rows)
+    for k in cols:
+        np.testing.assert_array_equal(back[k], cols[k], err_msg=k)
+
+
+def test_roundtrip_through_decimal_type():
+    """The read path sees decimal.Decimal from the driver, not str."""
+    from decimal import Decimal
+
+    cols = _cols()
+    rows = [
+        (t, ts, c, m, Decimal(a))
+        for t, ts, c, m, a in transactions_to_pg_rows(cols)
+    ]
+    back = pg_rows_to_transactions(rows)
+    np.testing.assert_array_equal(back["tx_amount_cents"],
+                                  cols["tx_amount_cents"])
+
+
+def test_ddl_matches_reference_layout():
+    stmts = " ".join(ddl_statements())
+    for frag in ("payment.customers", "payment.terminals",
+                 "payment.transactions", "DECIMAL(10,2)",
+                 "REPLICA IDENTITY FULL", "TIMESTAMP"):
+        assert frag in stmts, frag
+
+
+def test_batched_upserts_and_pacing():
+    conn = _FakeConn()
+    pg = PgLive(connection=conn)
+    pg.ensure_schema()
+    assert conn.commits == 1
+    cols = _cols()
+    n = pg.upsert_transactions(cols, batch_rows=3)
+    assert n == 7
+    ups = [e for e in conn.log if e[0] == "executemany"]
+    assert [len(e[2]) for e in ups] == [3, 3, 1]  # batches, not per-row
+    assert "ON CONFLICT (tx_id) DO UPDATE" in ups[0][1]
+    # one commit per batch (reference commits per ROW: data_gen.py:135)
+    assert conn.commits == 1 + 3
+
+    pg.upsert_dimension("customers", "customer_id",
+                        np.arange(4), np.zeros(4), np.ones(4))
+    dim = [e for e in conn.log if "customers" in e[1]
+           and e[0] == "executemany"]
+    assert len(dim) == 1 and len(dim[0][2]) == 4
+
+
+def test_paced_mode_holds_rate():
+    import time
+
+    conn = _FakeConn()
+    pg = PgLive(connection=conn)
+    cols = _cols()
+    t0 = time.perf_counter()
+    pg.upsert_transactions(cols, batch_rows=4, rate_per_s=50.0)
+    wall = time.perf_counter() - t0
+    assert wall >= 7 / 50.0 * 0.8  # ~0.14 s floor

@@ -298,3 +298,42 @@ def test_coalesce_never_exceeds_largest_bucket(cfg, trained):
     assert stats["rows"] == 3000
     np.testing.assert_array_equal(np.sort(out["tx_id"]),
                                   np.sort(sub.tx_id))
+
+
+def test_alerts_only_mode_same_scores_zero_features(cfg, trained):
+    """emit_features=False must change only the features payload (zeros,
+    no D2H) — predictions byte-identical to the full mode."""
+    import dataclasses
+
+    model, _, txs = trained
+    sub = txs.slice(slice(0, 1500))
+
+    def run_with(emit):
+        rcfg = dataclasses.replace(cfg.runtime, emit_features=emit)
+        eng = ScoringEngine(cfg.replace(runtime=rcfg), kind="logreg",
+                            params=model.params, scaler=model.scaler)
+        sink = MemorySink()
+        eng.run(ReplaySource(sub, START_EPOCH_S, batch_rows=500),
+                sink=sink)
+        return sink.concat()
+
+    full = run_with(True)
+    alerts = run_with(False)
+    np.testing.assert_array_equal(full["tx_id"], alerts["tx_id"])
+    np.testing.assert_array_equal(full["prediction"],
+                                  alerts["prediction"])
+    assert np.all(alerts["customer_id_nb_tx_7day_window"] == 0)
+    assert np.any(full["customer_id_nb_tx_7day_window"] != 0)
+
+
+def test_alerts_only_mode_rejects_feature_consumers(cfg, trained):
+    import dataclasses
+
+    import pytest
+
+    model, _, _ = trained
+    rcfg = dataclasses.replace(cfg.runtime, emit_features=False)
+    c = cfg.replace(runtime=rcfg)
+    with pytest.raises(ValueError, match="alerts-only"):
+        ScoringEngine(c, kind="logreg", params=model.params,
+                      scaler=model.scaler, scorer="cpu", cpu_model=object())

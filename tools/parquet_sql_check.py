@@ -121,7 +121,13 @@ def _rows_sqlite(directory: str, queries: dict) -> dict:
 
     import pyarrow.dataset as ds
 
-    table = ds.dataset(directory, format="parquet").to_table()
+    # explicit *.parquet list: a crashed ParquetSink write can leave a
+    # part-*.parquet.tmp behind, which a whole-directory mount would try
+    # to read (load_analyzed and the DuckDB glob both filter the same way)
+    files = sorted(
+        os.path.join(directory, f) for f in os.listdir(directory)
+        if f.endswith(".parquet"))
+    table = ds.dataset(files, format="parquet").to_table()
     want = ["tx_id", "tx_datetime_us", "customer_id", "terminal_id",
             "tx_amount", "prediction", "processed_at_us"]
     con = sqlite3.connect(":memory:")
