@@ -615,6 +615,57 @@ def _child_main(args) -> None:
         except Exception as e:
             pallas_stats = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
 
+    # ---- training throughput on the device -----------------------------
+    # The reference records per-classifier training_execution_time hooks
+    # (shared_functions.py:312-320) but never publishes values; here the
+    # jax training loops (logreg SGD + MLP) are timed on whatever backend
+    # is live — the on-chip analogue of those hooks.
+    train_stats = None
+    if full:
+        _progress("train throughput")
+        try:
+            from real_time_fraud_detection_system_tpu.models.logreg import (
+                train_logreg,
+            )
+            from real_time_fraud_detection_system_tpu.models.mlp import (
+                train_mlp,
+            )
+
+            tr_rows = 262_144 if not on_cpu else 16_384
+            xtr2 = rng.normal(0, 1, (tr_rows, 15)).astype(np.float32)
+            ytr2 = (xtr2[:, 0] - 0.3 * xtr2[:, 2] > 0.7).astype(np.int32)
+            train_stats = {"rows": tr_rows, "batch_size": 16384}
+
+            def _timed_fit(fit, epochs: int) -> float:
+                t0 = time.perf_counter()
+                params_out = fit(epochs)
+                jax.block_until_ready(jax.tree.leaves(params_out))
+                return time.perf_counter() - t0
+
+            for name, fit in (
+                ("logreg", lambda e: train_logreg(
+                    xtr2, ytr2, batch_size=16384, epochs=e)),
+                ("mlp", lambda e: train_mlp(
+                    xtr2, ytr2, hidden=(64, 32), batch_size=16384,
+                    epochs=e)),
+            ):
+                # train_* builds its jitted step per call, so any single
+                # call includes one compile. Report the cold number (what
+                # one call costs) AND a steady-state estimate from
+                # differencing a 1-epoch and a 9-epoch call — the compile
+                # cancels, leaving 8 epochs of step time. When the delta
+                # is below timer resolution (tiny CPU problems), the
+                # steady figure is omitted rather than fabricated.
+                w1 = _timed_fit(fit, 1)
+                w9 = _timed_fit(fit, 9)
+                train_stats[f"{name}_cold_rows_per_s"] = round(
+                    tr_rows / w1, 1)
+                if w9 - w1 > 0.05:
+                    train_stats[f"{name}_rows_per_s"] = round(
+                        8 * tr_rows / (w9 - w1), 1)
+        except Exception as e:
+            train_stats = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+
     # ---- long-context scorer: sequence serving throughput --------------
     # The fused history step (features/history.py): per-customer ring
     # update + causal-transformer score per row. Guarded — a failure here
@@ -726,6 +777,8 @@ def _child_main(args) -> None:
     }
     if z_stats is not None:
         detail["z_mode"] = z_stats
+    if train_stats is not None:
+        detail["train"] = train_stats
     if pallas_stats is not None:
         detail["pallas_fused"] = pallas_stats
     if seq_stats is not None:

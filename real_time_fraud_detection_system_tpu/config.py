@@ -83,6 +83,14 @@ class FeatureConfig:
     # (features/history.py) — the serving-side max_len of
     # models/sequence.build_sequences.
     history_len: int = 32
+    # Attention form for the serving transformer over the history ring:
+    # "naive" materializes [B, H, K, K] scores (fastest for short K),
+    # "blockwise" runs the flash recurrence ([B, H, K, block] memory —
+    # long histories on one chip), "auto" switches to blockwise once
+    # history_len exceeds seq_attn_block (naive at K=512/B=64k wants a
+    # 137 GB score tensor; blockwise caps it at K/block that).
+    seq_attn: str = "auto"
+    seq_attn_block: int = 128
     # Canonical flag definitions (see module docstring).
     night_end_hour: int = 6
     weekend_start_weekday: int = 5  # Monday == 0
@@ -96,6 +104,11 @@ class FeatureConfig:
         if self.key_mode not in ("direct", "hash"):
             raise ValueError(
                 f"key_mode must be 'direct' or 'hash', got {self.key_mode!r}"
+            )
+        if self.seq_attn not in ("naive", "blockwise", "auto"):
+            raise ValueError(
+                f"seq_attn must be 'naive', 'blockwise' or 'auto', "
+                f"got {self.seq_attn!r}"
             )
 
 

@@ -51,6 +51,26 @@ from real_time_fraud_detection_system_tpu.models.sequence import (
 )
 
 
+def _attn_fn_for(cfg: FeatureConfig, k: int):
+    """Serving attention policy (see FeatureConfig.seq_attn).
+
+    None → transformer_logits' naive causal attention ([B, H, K, K]
+    scores — fine for short rings, 137 GB at K=512/B=64k); blockwise →
+    the flash recurrence from parallel/ring_attention.py, whose score
+    memory is [B, H, K, block] (linear in K at fixed block), exact same
+    math (online softmax), so long histories serve on one chip."""
+    mode = cfg.seq_attn
+    if mode == "naive" or (mode == "auto" and k <= cfg.seq_attn_block):
+        return None
+    from real_time_fraud_detection_system_tpu.parallel.ring_attention import (
+        blockwise_attention,
+    )
+
+    block = max(16, min(cfg.seq_attn_block, k))
+    return lambda q, kk, v: blockwise_attention(
+        q, kk, v, block_size=block, causal=True)
+
+
 class HistoryState(NamedTuple):
     """Per-customer event ring buffers (+1 sink row for padded writes)."""
 
@@ -213,7 +233,8 @@ def update_and_score(
     # Δt channel of position 0 at gather time.
     hist = hist.at[:, 0, 2].set(0.0)
 
-    logits = transformer_logits(params, hist)  # [B, K]
+    logits = transformer_logits(
+        params, hist, attn_fn=_attn_fn_for(cfg, k))  # [B, K]
     own = jnp.take_along_axis(
         logits, (length - 1)[:, None], axis=1)[:, 0]
     probs = jnp.where(s_valid, jax.nn.sigmoid(own), 0.0)

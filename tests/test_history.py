@@ -240,3 +240,51 @@ def test_padding_rows_do_not_touch_state(setup):
     assert (np.asarray(probs)[5:] == 0).all()
     # only real customers' slots gained events (sink row absorbs padding)
     assert np.asarray(state2.count)[:-1].sum() == 5
+
+
+def test_blockwise_attention_serving_matches_naive():
+    """The long-history attention policy (seq_attn) must not change
+    scores: blockwise flash recurrence == naive materialized attention
+    on the same stream (same online-softmax math, fp tolerance only)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from real_time_fraud_detection_system_tpu.core.batch import make_batch
+    from real_time_fraud_detection_system_tpu.models.sequence import (
+        init_transformer,
+    )
+
+    k = 160  # > default seq_attn_block -> auto picks blockwise
+    base = FeatureConfig(customer_capacity=64, terminal_capacity=64,
+                         history_len=k)
+    tp = init_transformer(d_model=16, n_heads=2, n_layers=2, d_ff=32,
+                          seed=1)
+    rng = np.random.default_rng(5)
+    n = 512
+    cols = dict(
+        customer_id=rng.integers(0, 40, n),
+        terminal_id=rng.integers(0, 50, n),
+        tx_datetime_us=np.sort(
+            rng.integers(0, 30 * 86_400_000_000, n)).astype(np.int64),
+        amount_cents=rng.integers(100, 40000, n),
+    )
+    assert base.seq_attn == "auto"
+
+    def run(cfg):
+        state = init_history_state(cfg)
+        step = jax.jit(update_and_score, static_argnums=(3,))
+        out = []
+        for s in range(0, n, 128):
+            b = jax.tree.map(
+                jnp.asarray,
+                make_batch(**{kk: v[s:s + 128] for kk, v in cols.items()}))
+            state, p = step(state, tp, b, cfg)
+            out.append(np.asarray(p))
+        return np.concatenate(out)
+
+    p_block = run(base)  # auto -> blockwise at K=160
+    p_naive = run(dataclasses.replace(base, seq_attn="naive"))
+    assert np.abs(p_block - p_naive).max() < 2e-5
+    assert p_block.std() > 0  # non-degenerate scores
