@@ -978,8 +978,11 @@ def main() -> None:
     signal.signal(signal.SIGINT, _emit_banked_and_exit)
 
     def _tpu_attempt(liveness_s: float):
+        # Hard cap: a full measurement pass is ~25 min warm, ~30+ cold
+        # (every section recompiles over the tunnel) — the cap must
+        # outlast a COLD pass or the driver's run dies mid-measurement.
         result, err = _run_child(args, None, liveness_s, 420.0,
-                                 liveness_s + 1500.0)
+                                 liveness_s + 2700.0)
         if result is not None:
             d = result.setdefault("detail", {})
             d["tpu_attempts"] = len(errors) + 1
