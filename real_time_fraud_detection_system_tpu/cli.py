@@ -570,6 +570,43 @@ def cmd_query(args) -> int:
     return 0
 
 
+def cmd_sql(args) -> int:
+    """Ad-hoc SQL over the analyzed output — the Trino role, in-process.
+
+    Mounts the ParquetSink directory as an ``analyzed`` table (DuckDB
+    when installed, else pyarrow+sqlite; latest-wins dedup view either
+    way) and prints the result as JSON lines, one object per row.
+    """
+    from real_time_fraud_detection_system_tpu.io.sqlquery import (
+        AnalyzedSql,
+    )
+
+    limit = max(0, args.limit)  # <= 0 means unlimited
+    try:
+        db = AnalyzedSql(args.data)
+    except Exception as e:
+        # corrupt part file / permissions / missing dir: the JSON error
+        # contract holds for every failure, not just FileNotFoundError
+        print(_json_line({"error": f"{type(e).__name__}: {e}"}))
+        return 2
+    try:
+        # fetch one row past the limit: bounds memory on huge results
+        # while still detecting truncation
+        names, rows = db.query(args.query,
+                               max_rows=limit + 1 if limit else 0)
+    except Exception as e:
+        print(_json_line({"error": f"{type(e).__name__}: {e}"}))
+        return 2
+    finally:
+        db.close()
+    shown = rows[:limit] if limit else rows
+    for r in shown:
+        print(_json_line(dict(zip(names, r))))
+    if limit and len(rows) > limit:
+        print(_json_line({"truncated": True, "limit": limit}))
+    return 0
+
+
 def cmd_connectors(args) -> int:
     """Register the Debezium Postgres source connector with Kafka Connect.
 
@@ -957,6 +994,19 @@ def main(argv=None) -> int:
     p.add_argument("--top-k", type=int, default=10)
     p.add_argument("--bucket", default="day", choices=["hour", "day"])
     p.set_defaults(fn=cmd_query, needs_backend=False)
+
+    p = sub.add_parser(
+        "sql",
+        help="ad-hoc SQL over analyzed parquet output (Trino's role, "
+             "in-process; table name: analyzed)",
+    )
+    p.add_argument("--data", required=True,
+                   help="analyzed output directory (ParquetSink)")
+    p.add_argument("query", help="SQL, e.g. \"SELECT COUNT(*) FROM "
+                                 "analyzed WHERE prediction >= 0.5\"")
+    p.add_argument("--limit", type=int, default=1000,
+                   help="max rows printed (default 1000; 0 = unlimited)")
+    p.set_defaults(fn=cmd_sql, needs_backend=False)
 
     p = sub.add_parser(
         "connectors",

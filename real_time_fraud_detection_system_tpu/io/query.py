@@ -35,11 +35,11 @@ def load_analyzed(directory: str) -> Dict[str, np.ndarray]:
     import pyarrow as pa
     import pyarrow.parquet as pq
 
-    files = sorted(
-        os.path.join(directory, f)
-        for f in os.listdir(directory)
-        if f.endswith(".parquet")
+    from real_time_fraud_detection_system_tpu.io.sqlquery import (
+        parquet_files,
     )
+
+    files = parquet_files(directory)
     if not files:
         return {}
     table = pa.concat_tables([pq.read_table(f) for f in files])

@@ -1,0 +1,129 @@
+"""io.sqlquery — SQL over analyzed Parquet (the in-process Trino role)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from real_time_fraud_detection_system_tpu.io.sqlquery import (
+    AnalyzedSql,
+    parquet_files,
+    run_queries,
+)
+
+
+def _part(path, tx_ids, processed_at, pred, seed=0):
+    rng = np.random.default_rng(seed)
+    n = len(tx_ids)
+    pq.write_table(pa.table({
+        "tx_id": pa.array(np.asarray(tx_ids, np.int64), pa.int64()),
+        "tx_datetime_us": pa.array(
+            np.sort(rng.integers(0, 5 * 86_400_000_000, n)), pa.int64()),
+        "customer_id": pa.array(rng.integers(0, 10, n), pa.int64()),
+        "terminal_id": pa.array(rng.integers(0, 20, n), pa.int64()),
+        "tx_amount": pa.array(rng.uniform(1, 100, n), pa.float64()),
+        # a feature column, like real ParquetSink output carries — the
+        # sqlite fallback must mount EVERY column, not a fixed subset
+        "customer_id_nb_tx_7day_window": pa.array(
+            rng.integers(1, 9, n).astype(np.int32), pa.int32()),
+        "prediction": pa.array(np.asarray(pred, np.float64), pa.float64()),
+        "processed_at_us": pa.array(np.full(n, processed_at), pa.int64()),
+    }), str(path))
+
+
+@pytest.fixture()
+def analyzed_dir(tmp_path):
+    d = tmp_path / "analyzed"
+    d.mkdir()
+    _part(d / "part-00000001.parquet", np.arange(100), 1_000_000,
+          np.linspace(0, 1, 100))
+    return d
+
+
+def test_basic_query(analyzed_dir):
+    db = AnalyzedSql(str(analyzed_dir))
+    names, rows = db.query("SELECT COUNT(*) AS n FROM analyzed")
+    assert names == ["n"] and rows[0][0] == 100
+    _, rows = db.query(
+        "SELECT COUNT(*) FROM analyzed WHERE prediction >= 0.5")
+    assert rows[0][0] == 50
+    # feature columns are queryable on both engines
+    _, rows = db.query(
+        "SELECT SUM(customer_id_nb_tx_7day_window) FROM analyzed")
+    assert rows[0][0] > 0
+    # the internal dedup ranking column never leaks into SELECT *
+    names, _ = db.query("SELECT * FROM analyzed LIMIT 1")
+    assert "rn" not in names
+    # bounded fetch
+    _, rows = db.query("SELECT tx_id FROM analyzed", max_rows=7)
+    assert len(rows) == 7
+    db.close()
+
+
+def test_dedup_view_latest_wins(analyzed_dir):
+    # replay re-scores rows 40..99 later; they must count once, with the
+    # NEW predictions
+    _part(analyzed_dir / "part-00000002.parquet", np.arange(40, 100),
+          2_000_000, np.zeros(60), seed=1)
+    db = AnalyzedSql(str(analyzed_dir))
+    _, rows = db.query("SELECT COUNT(*), SUM(prediction) FROM analyzed")
+    assert rows[0][0] == 100
+    # old rows 0..39 keep linspace predictions; 40..99 became 0.0
+    expect = np.linspace(0, 1, 100)[:40].sum()
+    assert abs(rows[0][1] - expect) < 1e-9
+    db.close()
+
+
+def test_tmp_files_ignored(analyzed_dir):
+    (analyzed_dir / "part-00000009.parquet.tmp").write_bytes(b"garbage")
+    assert len(parquet_files(str(analyzed_dir))) == 1
+    db = AnalyzedSql(str(analyzed_dir))
+    _, rows = db.query("SELECT COUNT(*) FROM analyzed")
+    assert rows[0][0] == 100
+    db.close()
+
+
+def test_missing_dir_raises(tmp_path):
+    (tmp_path / "empty").mkdir()
+    with pytest.raises(FileNotFoundError):
+        AnalyzedSql(str(tmp_path / "empty"))
+
+
+def test_run_queries_helper(analyzed_dir):
+    engine, rows = run_queries(str(analyzed_dir), {
+        "n": "SELECT COUNT(*) FROM analyzed",
+        "flagged": "SELECT COUNT(*) FROM analyzed WHERE prediction>=0.5",
+    })
+    assert engine in ("duckdb", "sqlite")
+    assert rows["n"][0][0] == 100 and rows["flagged"][0][0] == 50
+
+
+def test_cli_sql_command(analyzed_dir):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run(
+        [sys.executable, "-m", "real_time_fraud_detection_system_tpu.cli",
+         "sql", "--data", str(analyzed_dir), "--limit", "3",
+         "SELECT tx_id FROM analyzed ORDER BY tx_id"],
+        capture_output=True, text=True, cwd=repo,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert p.returncode == 0, p.stderr[-500:]
+    lines = [json.loads(ln) for ln in p.stdout.strip().splitlines()]
+    assert [r.get("tx_id") for r in lines[:3]] == [0, 1, 2]
+    assert lines[-1] == {"truncated": True, "limit": 3}
+
+    # --limit 0 = unlimited: all 100 rows, no truncation marker
+    p = subprocess.run(
+        [sys.executable, "-m", "real_time_fraud_detection_system_tpu.cli",
+         "sql", "--data", str(analyzed_dir), "--limit", "0",
+         "SELECT tx_id FROM analyzed"],
+        capture_output=True, text=True, cwd=repo,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert p.returncode == 0, p.stderr[-500:]
+    assert len(p.stdout.strip().splitlines()) == 100

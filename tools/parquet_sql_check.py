@@ -78,19 +78,6 @@ GROUP BY 1
 ORDER BY 1
 """
 
-# Latest-wins by tx_id across part files — the reference's own dedup
-# pattern (ROW_NUMBER per key, kafka_s3_sink_transactions.py:173-186) and
-# the contract io/query.py::load_analyzed applies on read: a transaction
-# re-scored by a crash-replay counts once, its latest scoring wins.
-# processed_at_us orders re-scorings (a replayed batch is written later).
-SQL_DEDUP_VIEW = """
-CREATE VIEW analyzed AS
-SELECT * FROM (
-    SELECT *, ROW_NUMBER() OVER (
-        PARTITION BY tx_id ORDER BY processed_at_us DESC) AS rn
-    FROM analyzed_raw
-) WHERE rn = 1
-"""
 
 
 def _bind(sql: str, params: dict) -> str:
@@ -102,47 +89,6 @@ def _bind(sql: str, params: dict) -> str:
     return sql
 
 
-def _rows_duckdb(directory: str, queries: dict) -> dict:
-    import duckdb
-
-    con = duckdb.connect()
-    glob = os.path.join(directory, "*.parquet")
-    con.execute(
-        f"CREATE VIEW analyzed_raw AS SELECT * FROM read_parquet('{glob}')")
-    con.execute(SQL_DEDUP_VIEW)
-    return {name: con.execute(sql).fetchall()
-            for name, sql in queries.items()}
-
-
-def _rows_sqlite(directory: str, queries: dict) -> dict:
-    """pyarrow.dataset mounts the part files (the same scan layer Trino
-    and DuckDB build on), sqlite3 serves the SQL."""
-    import sqlite3
-
-    import pyarrow.dataset as ds
-
-    # explicit *.parquet list: a crashed ParquetSink write can leave a
-    # part-*.parquet.tmp behind, which a whole-directory mount would try
-    # to read (load_analyzed and the DuckDB glob both filter the same way)
-    files = sorted(
-        os.path.join(directory, f) for f in os.listdir(directory)
-        if f.endswith(".parquet"))
-    table = ds.dataset(files, format="parquet").to_table()
-    want = ["tx_id", "tx_datetime_us", "customer_id", "terminal_id",
-            "tx_amount", "prediction", "processed_at_us"]
-    con = sqlite3.connect(":memory:")
-    con.execute(
-        "CREATE TABLE analyzed_raw (tx_id INTEGER, tx_datetime_us INTEGER, "
-        "customer_id INTEGER, terminal_id INTEGER, tx_amount REAL, "
-        "prediction REAL, processed_at_us INTEGER)")
-    cols = [table[c].to_numpy() for c in want]
-    con.executemany(
-        "INSERT INTO analyzed_raw VALUES (?,?,?,?,?,?,?)",
-        zip(*[c.tolist() for c in cols]),
-    )
-    con.execute(SQL_DEDUP_VIEW)
-    return {name: con.execute(sql).fetchall()
-            for name, sql in queries.items()}
 
 
 def _close(a, b, tol=1e-6) -> bool:
@@ -215,14 +161,11 @@ def main() -> int:
         "alerts": _bind(SQL_ALERTS, {"thr": args.threshold, "k": 100000}),
         "daily": SQL_DAILY,
     }
-    try:
-        import duckdb  # noqa: F401
+    from real_time_fraud_detection_system_tpu.io.sqlquery import (
+        run_queries,
+    )
 
-        engine = "duckdb"
-        rows = _rows_duckdb(directory, queries)
-    except ImportError:
-        engine = "sqlite"
-        rows = _rows_sqlite(directory, queries)
+    engine, rows = run_queries(directory, queries)
 
     # ---- oracle: io/query.py over the same files --------------------
     from real_time_fraud_detection_system_tpu.io.query import (
