@@ -41,36 +41,49 @@ def _repo_root() -> str:
     )
 
 
+def _build_and_load(name: str, configure) -> "Tuple[Optional[ctypes.CDLL], Optional[str]]":
+    """Shared compile-on-first-use recipe for every native unit:
+    recompile when the source is newer than the .so, load via ctypes,
+    hand the handle to ``configure(lib)`` for argtype setup, and report
+    (lib, None) or (None, error). Caller holds ``_lock``."""
+    src = os.path.join(_repo_root(), "native", f"{name}.cc")
+    so = os.path.join(_repo_root(), "native", f"lib{name}.so")
+    try:
+        if not os.path.exists(so) or \
+                os.path.getmtime(so) < os.path.getmtime(src):
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-o", so, src],
+                check=True, capture_output=True, text=True, timeout=120,
+            )
+        lib = ctypes.CDLL(so)
+        configure(lib)
+        return lib, None
+    except (subprocess.CalledProcessError, OSError,
+            subprocess.TimeoutExpired) as exc:
+        return None, str(exc)
+
+
+def _configure_envelope(lib) -> None:
+    out_cols = [
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    ] * 5 + [
+        np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+    ]
+    lib.decode_envelopes.restype = ctypes.c_int64
+    lib.decode_envelopes.argtypes = [
+        ctypes.c_char_p,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ctypes.c_int64,
+    ] + out_cols
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _build_error
     with _lock:
-        if _lib is not None or _build_error is not None:
-            return _lib
-        src = os.path.join(_repo_root(), "native", "envelope.cc")
-        so = os.path.join(_repo_root(), "native", "libenvelope.so")
-        try:
-            if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
-                subprocess.run(
-                    ["g++", "-O3", "-shared", "-fPIC", "-o", so, src],
-                    check=True, capture_output=True, text=True, timeout=120,
-                )
-            lib = ctypes.CDLL(so)
-            out_cols = [
-                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
-            ] * 5 + [
-                np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS"),
-                np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
-            ]
-            lib.decode_envelopes.restype = ctypes.c_int64
-            lib.decode_envelopes.argtypes = [
-                ctypes.c_char_p,
-                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
-                ctypes.c_int64,
-            ] + out_cols
-            _lib = lib
-        except (subprocess.CalledProcessError, OSError,
-                subprocess.TimeoutExpired) as exc:
-            _build_error = str(exc)
+        if _lib is None and _build_error is None:
+            _lib, _build_error = _build_and_load(
+                "envelope", _configure_envelope)
         return _lib
 
 
@@ -163,3 +176,84 @@ def decode_transaction_envelopes_native(
         "kafka_ts_ms": kts,
     }
     return cols, valid == 0
+
+
+# ---------------------------------------------------------------------------
+# host-prep library (native/hostprep.cc): dedup + pack for the serving loop
+# ---------------------------------------------------------------------------
+
+_hp_lib: Optional[ctypes.CDLL] = None
+_hp_error: Optional[str] = None
+
+
+def _configure_hostprep(lib) -> None:
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    lib.latest_wins_keep.restype = ctypes.c_int64
+    lib.latest_wins_keep.argtypes = [
+        i64p, i64p, ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+    ]
+    lib.pack_rows.restype = None
+    lib.pack_rows.argtypes = [
+        i64p, i64p, i64p, i64p,
+        ctypes.c_void_p,  # label, nullable
+        ctypes.c_int64, ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+    ]
+
+
+def _load_hostprep() -> Optional[ctypes.CDLL]:
+    global _hp_lib, _hp_error
+    with _lock:
+        if _hp_lib is None and _hp_error is None:
+            _hp_lib, _hp_error = _build_and_load(
+                "hostprep", _configure_hostprep)
+        return _hp_lib
+
+
+def hostprep_available() -> bool:
+    return _load_hostprep() is not None
+
+
+def latest_wins_keep(tx_id: np.ndarray, ts: np.ndarray) -> np.ndarray:
+    """bool [n] latest-wins mask (same semantics as
+    ops.dedup.latest_wins_mask_np with all rows valid), O(n) hash pass."""
+    lib = _load_hostprep()
+    if lib is None:
+        raise RuntimeError(f"native hostprep unavailable: {_hp_error}")
+    n = len(tx_id)
+    keep = np.zeros(n, dtype=np.uint8)
+    if n:
+        lib.latest_wins_keep(
+            np.ascontiguousarray(tx_id, np.int64),
+            np.ascontiguousarray(ts, np.int64), n, keep)
+    return keep.view(bool)
+
+
+def pack_rows(
+    tx_datetime_us: np.ndarray,
+    customer_id: np.ndarray,
+    terminal_id: np.ndarray,
+    amount_cents: np.ndarray,
+    label: Optional[np.ndarray],
+    pad: int,
+) -> np.ndarray:
+    """Fused make_batch + pack_batch: → int32 [7, pad] (zeros-padded),
+    bit-identical to the NumPy composition (tests/test_native.py)."""
+    lib = _load_hostprep()
+    if lib is None:
+        raise RuntimeError(f"native hostprep unavailable: {_hp_error}")
+    n = len(tx_datetime_us)
+    if pad < n:
+        raise ValueError(f"pad={pad} < batch rows {n}")
+    packed = np.empty((7, pad), dtype=np.int32)
+    lab = (np.ascontiguousarray(label, np.int64)
+           if label is not None else None)
+    lib.pack_rows(
+        np.ascontiguousarray(tx_datetime_us, np.int64),
+        np.ascontiguousarray(customer_id, np.int64),
+        np.ascontiguousarray(terminal_id, np.int64),
+        np.ascontiguousarray(amount_cents, np.int64),
+        lab.ctypes.data if lab is not None else None,
+        n, pad, packed)
+    return packed

@@ -129,3 +129,119 @@ def test_native_parity_differential_fuzz(rng):
     # Control group sanity: some mutated-but-intact and all clean cases
     # must decode on both paths.
     assert both_ok.sum() > 50
+
+
+def test_hostprep_latest_wins_matches_numpy_fuzz():
+    """C++ hash dedup ≡ ops.dedup.latest_wins_mask_np, incl. ts ties
+    (later position wins) and heavy duplication."""
+    from real_time_fraud_detection_system_tpu.core import native
+    from real_time_fraud_detection_system_tpu.ops.dedup import (
+        latest_wins_mask_np,
+    )
+
+    if not native.hostprep_available():
+        pytest.skip("native hostprep unavailable")
+    rng = np.random.default_rng(17)
+    for _ in range(30):
+        n = int(rng.integers(1, 4000))
+        tx = rng.integers(0, max(1, n // 3), n)  # heavy duplicates
+        ts = rng.integers(0, 20, n)  # many ties
+        np.testing.assert_array_equal(
+            native.latest_wins_keep(tx, ts),
+            latest_wins_mask_np(tx, ts))
+
+
+def test_hostprep_pack_rows_bitexact_fuzz():
+    """C++ fused pack ≡ make_batch + pack_batch bit-for-bit (key folds,
+    floor day/tod split, cents→f32, labels, zero padding)."""
+    from real_time_fraud_detection_system_tpu.core import native
+    from real_time_fraud_detection_system_tpu.core.batch import (
+        make_batch,
+        pack_batch,
+    )
+
+    if not native.hostprep_available():
+        pytest.skip("native hostprep unavailable")
+    rng = np.random.default_rng(23)
+    for trial in range(20):
+        n = int(rng.integers(1, 3000))
+        dt = rng.integers(0, 2**45, n)
+        cu = rng.integers(0, 2**63 - 1, n)
+        te = rng.integers(0, 2**63 - 1, n)
+        am = rng.integers(0, 10**9, n)
+        lab = rng.integers(-1, 2, n) if trial % 2 else None
+        pad = int(n + rng.integers(0, 64))
+        ref = pack_batch(make_batch(cu, te, dt, am, label=lab,
+                                    pad_to=pad))
+        got = native.pack_rows(dt, cu, te, am, lab, pad)
+        np.testing.assert_array_equal(got, ref, err_msg=f"trial {trial}")
+
+
+def test_hostprep_engine_parity_native_vs_numpy(monkeypatch):
+    """The engine produces identical results whether the native host-prep
+    path or the NumPy fallback runs."""
+    import jax.numpy as jnp
+
+    from real_time_fraud_detection_system_tpu.config import (
+        Config,
+        FeatureConfig,
+        RuntimeConfig,
+    )
+    from real_time_fraud_detection_system_tpu.core import native
+    from real_time_fraud_detection_system_tpu.models.logreg import (
+        init_logreg,
+    )
+    from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+    from real_time_fraud_detection_system_tpu.runtime.engine import (
+        ScoringEngine,
+    )
+
+    if not native.hostprep_available():
+        pytest.skip("native hostprep unavailable")
+    cfg = Config(
+        features=FeatureConfig(customer_capacity=128,
+                               terminal_capacity=256),
+        runtime=RuntimeConfig(batch_buckets=(256,), max_batch_rows=256),
+    )
+    rng = np.random.default_rng(3)
+    n = 200
+    batch = {
+        "tx_id": np.concatenate([np.arange(n - 20), np.arange(20)]),
+        "tx_datetime_us": np.sort(
+            rng.integers(0, 5 * 86_400_000_000, n)).astype(np.int64),
+        "customer_id": rng.integers(0, 60, n),
+        "terminal_id": rng.integers(0, 90, n),
+        "tx_amount_cents": rng.integers(100, 10**6, n),
+        "kafka_ts_ms": np.arange(n, dtype=np.int64),
+    }
+
+    def run():
+        eng = ScoringEngine(
+            cfg, kind="logreg", params=init_logreg(15),
+            scaler=Scaler(mean=jnp.zeros(15), scale=jnp.ones(15)))
+        return eng.process_batch(dict(batch))
+
+    r_nat = run()
+    monkeypatch.setattr(native, "hostprep_available", lambda: False)
+    r_np = run()
+    np.testing.assert_array_equal(r_nat.tx_id, r_np.tx_id)
+    np.testing.assert_array_equal(r_nat.probs, r_np.probs)
+    np.testing.assert_array_equal(r_nat.features, r_np.features)
+
+
+def test_hostprep_sentinel_key_parity():
+    """tx_id == INT64_MIN doubles as the NumPy mask's invalid sentinel
+    and is dropped there — the native path must match."""
+    from real_time_fraud_detection_system_tpu.core import native
+    from real_time_fraud_detection_system_tpu.ops.dedup import (
+        latest_wins_mask_np,
+    )
+
+    if not native.hostprep_available():
+        pytest.skip("native hostprep unavailable")
+    lo = np.iinfo(np.int64).min
+    tx = np.array([5, lo, 5, lo, 7], dtype=np.int64)
+    ts = np.array([1, 2, 3, 4, 5], dtype=np.int64)
+    got = native.latest_wins_keep(tx, ts)
+    np.testing.assert_array_equal(got, latest_wins_mask_np(tx, ts))
+    assert not got[1] and not got[3]
