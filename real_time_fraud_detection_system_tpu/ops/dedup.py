@@ -59,3 +59,16 @@ def latest_wins_mask_np(
     mask = np.zeros(b, dtype=bool)
     mask[order] = win_sorted
     return mask
+
+
+def latest_wins_mask_host(key: np.ndarray, ts: np.ndarray) -> np.ndarray:
+    """Host-side dispatcher: the C++ O(n) hash pass when the native unit
+    is available (``native/hostprep.cc``), else :func:`latest_wins_mask_np`
+    — bit-identical either way (differential-pinned,
+    ``tests/test_native.py``). The single entry point both serving
+    engines use, so dedup semantics cannot diverge between them."""
+    from real_time_fraud_detection_system_tpu.core import native
+
+    if native.hostprep_available():
+        return native.latest_wins_keep(key, ts)
+    return latest_wins_mask_np(key, ts)

@@ -55,7 +55,9 @@ from real_time_fraud_detection_system_tpu.models.mlp import (
 )
 from real_time_fraud_detection_system_tpu.models.scaler import Scaler, transform
 from real_time_fraud_detection_system_tpu.core import native
-from real_time_fraud_detection_system_tpu.ops.dedup import latest_wins_mask_np
+from real_time_fraud_detection_system_tpu.ops.dedup import (
+    latest_wins_mask_host,
+)
 
 
 def predict_fn_for(kind: str) -> Callable:
@@ -286,11 +288,7 @@ class ScoringEngine:
         # (differential-pinned); it lifts the host ceiling past what a
         # locally attached chip can consume. NumPy is the fallback.
         use_native = native.hostprep_available()
-        if use_native:
-            keep = native.latest_wins_keep(cols["tx_id"],
-                                           cols["kafka_ts_ms"])
-        else:
-            keep = latest_wins_mask_np(cols["tx_id"], cols["kafka_ts_ms"])
+        keep = latest_wins_mask_host(cols["tx_id"], cols["kafka_ts_ms"])
         cols = {k: v[keep] for k, v in cols.items()}
         n = len(cols["tx_id"])
         pad = bucket_size(n, self.cfg.runtime.batch_buckets)
@@ -327,8 +325,10 @@ class ScoringEngine:
     def _finish_batch(self, handle: dict) -> BatchResult:
         """Block on the handle's device futures; build the BatchResult."""
         n = handle["n"]
-        if not self.cfg.runtime.emit_features:
-            # alerts-only mode: the feature matrix stays in HBM
+        if not self.cfg.runtime.emit_features or self.kind == "sequence":
+            # alerts-only mode: the feature matrix stays in HBM. The
+            # sequence scorer's matrix is definitionally zeros (raw event
+            # channels replace engineered features) — never worth a D2H.
             feats_np = np.zeros((n, N_FEATURES), np.float32)
         else:
             feats_np = np.asarray(handle["feats"])[:n]

@@ -43,7 +43,9 @@ from real_time_fraud_detection_system_tpu.features.online import (
 )
 from real_time_fraud_detection_system_tpu.features.spec import N_FEATURES
 from real_time_fraud_detection_system_tpu.models.scaler import Scaler
-from real_time_fraud_detection_system_tpu.ops.dedup import latest_wins_mask_np
+from real_time_fraud_detection_system_tpu.ops.dedup import (
+    latest_wins_mask_host,
+)
 from real_time_fraud_detection_system_tpu.parallel.mesh import (
     make_mesh,
     shard_feature_state,
@@ -193,7 +195,7 @@ class ShardedScoringEngine(ScoringEngine):
         next batch's partition + H2D with this batch's mesh compute.
         """
         t0 = time.perf_counter()
-        keep = latest_wins_mask_np(cols["tx_id"], cols["kafka_ts_ms"])
+        keep = latest_wins_mask_host(cols["tx_id"], cols["kafka_ts_ms"])
         cols = {k: v[keep] for k, v in cols.items()}
         n = len(cols["tx_id"])
         self._ensure_sharded()
