@@ -229,6 +229,23 @@ def test_pipeline_depth_equivalence(cfg, trained):
                                atol=1e-6)
 
 
+def _assert_resumed_equals_clean(clean_sink, *resumed_sinks):
+    """Interrupted+resumed output ≡ the clean run's, after latest-wins on
+    replayed rows (checkpoint offsets trail, so replays may duplicate —
+    keep the LAST occurrence per tx_id)."""
+    a = clean_sink.concat()
+    parts = [s.concat() for s in resumed_sinks]
+    ids = np.concatenate([p["tx_id"] for p in parts])
+    preds = np.concatenate([p["prediction"] for p in parts])
+    _, last = np.unique(ids[::-1], return_index=True)
+    keep = len(ids) - 1 - last
+    np.testing.assert_array_equal(np.sort(ids[keep]),
+                                  np.sort(a["tx_id"]))
+    np.testing.assert_allclose(
+        preds[keep][np.argsort(ids[keep])],
+        np.asarray(a["prediction"])[np.argsort(a["tx_id"])], atol=1e-6)
+
+
 def test_pipeline_depth_checkpoint_resume_identity(cfg, trained, tmp_path):
     """Crash-replay identity must hold at depth 4: the checkpoint drain
     keeps (offsets, state) consistent with no batch in flight."""
@@ -264,21 +281,7 @@ def test_pipeline_depth_checkpoint_resume_identity(cfg, trained, tmp_path):
     sink_c = MemorySink()
     eng_c.run(src_c, sink=sink_c, checkpointer=chk)
 
-    a = sink_a.concat()
-    bc_ids = np.concatenate([sink_b.concat()["tx_id"],
-                             sink_c.concat()["tx_id"]])
-    bc_pred = np.concatenate([sink_b.concat()["prediction"],
-                              sink_c.concat()["prediction"]])
-    # replayed rows (offsets trail the checkpoint) may duplicate — keep
-    # the last occurrence per tx_id, then compare against the clean run
-    order = np.argsort(a["tx_id"])
-    _, last = np.unique(bc_ids[::-1], return_index=True)
-    keep = len(bc_ids) - 1 - last
-    np.testing.assert_array_equal(
-        np.asarray(a["tx_id"])[order], np.sort(bc_ids[keep]))
-    np.testing.assert_allclose(
-        np.asarray(a["prediction"])[order],
-        bc_pred[keep][np.argsort(bc_ids[keep])], atol=1e-6)
+    _assert_resumed_equals_clean(sink_a, sink_b, sink_c)
 
 
 def test_coalesce_never_exceeds_largest_bucket(cfg, trained):
@@ -337,3 +340,49 @@ def test_alerts_only_mode_rejects_feature_consumers(cfg, trained):
     with pytest.raises(ValueError, match="alerts-only"):
         ScoringEngine(c, kind="logreg", params=model.params,
                       scaler=model.scaler, scorer="cpu", cpu_model=object())
+
+
+def test_coalesce_carry_checkpoint_resume_identity(cfg, trained, tmp_path):
+    """Checkpoint offsets never include a carried-but-unprocessed poll:
+    interrupt a coalescing run mid-stream, resume, and the merged output
+    must equal the uninterrupted run's (latest-wins on replayed rows)."""
+    import dataclasses
+
+    model, _, txs = trained
+    sub = txs.slice(slice(0, 9000))
+    # coalesce target = bucket cap (4096): 1800-row polls build
+    # 3600-row batches and the 3rd poll always overflows into a carry
+    rcfg = dataclasses.replace(cfg.runtime, coalesce_rows=4096,
+                               checkpoint_every_batches=2)
+    c = cfg.replace(runtime=rcfg)
+
+    def engine():
+        return ScoringEngine(c, kind="logreg", params=model.params,
+                             scaler=model.scaler)
+
+    # clean run
+    sink_a = MemorySink()
+    sa = engine().run(ReplaySource(sub, START_EPOCH_S, batch_rows=1800),
+                      sink=sink_a)
+    # pin the premise: coalescing produced exactly 3600/3600/tail — a
+    # regression that bypasses coalesce would give 5 plain batches and
+    # this test would stop exercising the carry path
+    assert sa["batches"] == 3
+
+    # interrupted after 2 coalesced batches (carry was in flight at the
+    # checkpoint), resumed
+    chk = Checkpointer(str(tmp_path / "ck"))
+    eng_b = engine()
+    src_b = ReplaySource(sub, START_EPOCH_S, batch_rows=1800)
+    sink_b = MemorySink()
+    eng_b.run(src_b, sink=sink_b, max_batches=2, checkpointer=chk)
+    eng_c = engine()
+    state = chk.restore(eng_c.state)
+    assert state is not None
+    eng_c.state = state
+    src_c = ReplaySource(sub, START_EPOCH_S, batch_rows=1800)
+    src_c.seek(state.offsets)
+    sink_c = MemorySink()
+    eng_c.run(src_c, sink=sink_c, checkpointer=chk)
+
+    _assert_resumed_equals_clean(sink_a, sink_b, sink_c)
