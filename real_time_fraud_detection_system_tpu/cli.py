@@ -272,7 +272,24 @@ def cmd_score(args) -> int:
     txs = (load_transactions(args.data)
            if args.data and args.source == "replay" else None)
     model = load_model(args.model_file)
+    import dataclasses as _dc
+
     cfg = Config()
+    if args.alerts_only and (args.scorer == "cpu"
+                             or args.feedback_bootstrap):
+        log.error("--alerts-only keeps features in HBM; it does not "
+                  "compose with --scorer cpu or the feedback loop "
+                  "(both consume host-side feature rows)")
+        return 2
+    if args.alerts_only and args.out:
+        log.warning("--alerts-only: the analyzed output at %s will carry "
+                    "zero feature columns (predictions only)", args.out)
+    cfg = cfg.replace(runtime=_dc.replace(
+        cfg.runtime,
+        emit_features=not args.alerts_only,
+        pipeline_depth=args.pipeline_depth,
+        coalesce_rows=args.coalesce_rows,
+    ))
     cpu_model = None
     if args.scorer == "cpu":
         cpu_model = model  # TrainedModel.predict_proba runs host-side numpy
@@ -940,6 +957,16 @@ def main(argv=None) -> int:
                         "parquet table at this directory (the reference's "
                         "nessie.payment.transactions)")
     p.add_argument("--batch-rows", type=int, default=4096)
+    p.add_argument("--alerts-only", action="store_true",
+                   help="serve predictions only: the feature matrix "
+                        "never leaves the device (the highest-throughput "
+                        "mode; incompatible with --scorer cpu/feedback)")
+    p.add_argument("--pipeline-depth", type=int, default=2,
+                   help="micro-batches in flight (2 = double-buffering; "
+                        "deeper hides per-dispatch overhead)")
+    p.add_argument("--coalesce-rows", type=int, default=0,
+                   help="merge consecutive source polls into one device "
+                        "batch up to this many rows (0 = off)")
     p.add_argument("--start-date", default="2025-04-01")
     p.add_argument("--checkpoint-dir", default="")
     p.add_argument("--resume", action="store_true")

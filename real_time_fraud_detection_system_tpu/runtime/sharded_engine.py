@@ -263,11 +263,14 @@ class ShardedScoringEngine(ScoringEngine):
 
     def _finish_batch(self, handle: dict) -> BatchResult:
         n = handle["n"]
+        emit = self.cfg.runtime.emit_features
         probs_np = np.zeros(n, dtype=np.float32)
         feats_np = np.zeros((n, N_FEATURES), dtype=np.float32)
         for rows, pos, probs, feats in handle["parts"]:
             probs_np[rows] = np.asarray(probs)[pos]
-            if feats is not None:
+            if feats is not None and emit:
+                # alerts-only mode skips the per-shard feature D2H, same
+                # contract as the single-chip engine
                 feats_np[rows] = np.asarray(feats)[pos]
         return self._emit_result(handle, probs_np, feats_np)
 

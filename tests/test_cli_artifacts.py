@@ -278,3 +278,45 @@ def test_probe_cache_roundtrip_and_garbage(monkeypatch, tmp_path):
         with open(path, "w") as f:
             f.write(garbage)
         assert not cli._probe_cache_fresh(600)
+
+
+def test_score_alerts_only_flag(tmp_path):
+    """--alerts-only serves predictions with zero feature columns; the
+    incompatible --scorer cpu combination fails fast."""
+    import subprocess
+    import sys
+
+    import numpy as np
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               RTFDS_BACKEND_PROBE_TIMEOUT="0")
+
+    def cli(*a):
+        return subprocess.run(
+            [sys.executable, "-m",
+             "real_time_fraud_detection_system_tpu.cli", *a],
+            capture_output=True, text=True, cwd=repo, env=env)
+
+    p = cli("datagen", "--out", str(tmp_path / "txs.npz"),
+            "--customers", "60", "--terminals", "120", "--days", "25")
+    assert p.returncode == 0, p.stderr[-500:]
+    p = cli("train", "--data", str(tmp_path / "txs.npz"),
+            "--out-model", str(tmp_path / "m.npz"), "--model", "logreg")
+    assert p.returncode == 0, p.stderr[-500:]
+    p = cli("score", "--data", str(tmp_path / "txs.npz"),
+            "--model-file", str(tmp_path / "m.npz"),
+            "--out", str(tmp_path / "analyzed"),
+            "--alerts-only", "--pipeline-depth", "4",
+            "--coalesce-rows", "2048")
+    assert p.returncode == 0, p.stderr[-800:]
+    from real_time_fraud_detection_system_tpu.io.query import load_analyzed
+
+    cols = load_analyzed(str(tmp_path / "analyzed"))
+    assert len(cols["prediction"]) > 0
+    assert np.all(cols["customer_id_nb_tx_7day_window"] == 0)
+    # incompatible combination fails fast with rc 2
+    p = cli("score", "--data", str(tmp_path / "txs.npz"),
+            "--model-file", str(tmp_path / "m.npz"),
+            "--alerts-only", "--scorer", "cpu")
+    assert p.returncode == 2

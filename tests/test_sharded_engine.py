@@ -474,3 +474,31 @@ def test_dense_spill_matches_single_chip(source):
     np.testing.assert_allclose(res.features,
                                np.concatenate([r1.features, r2.features]),
                                rtol=1e-5, atol=1e-4)
+
+
+def test_sharded_alerts_only_same_probs_zero_features(small_dataset):
+    """emit_features=False on the mesh: identical probabilities, zero
+    feature payload (the per-shard feats D2H is skipped)."""
+    import dataclasses
+
+    _, _, _, txs = small_dataset
+    part = txs.slice(slice(0, 4096))
+    cfg = _cfg()
+    params, scaler = _model()
+
+    s_full, s_alerts = MemorySink(), MemorySink()
+    ShardedScoringEngine(cfg, kind="logreg", params=params, scaler=scaler,
+                         n_devices=N_DEV).run(
+        ReplaySource(part, EPOCH0, batch_rows=1024), sink=s_full)
+    acfg = cfg.replace(runtime=dataclasses.replace(
+        cfg.runtime, emit_features=False))
+    ShardedScoringEngine(acfg, kind="logreg", params=params, scaler=scaler,
+                         n_devices=N_DEV).run(
+        ReplaySource(part, EPOCH0, batch_rows=1024), sink=s_alerts)
+
+    f, a = s_full.concat(), s_alerts.concat()
+    np.testing.assert_array_equal(f["tx_id"], a["tx_id"])
+    np.testing.assert_allclose(f["prediction"], a["prediction"],
+                               atol=1e-6)
+    assert np.all(a["customer_id_nb_tx_7day_window"] == 0)
+    assert np.any(f["customer_id_nb_tx_7day_window"] != 0)
