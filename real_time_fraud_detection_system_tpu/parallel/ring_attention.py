@@ -174,25 +174,93 @@ def ring_attention(
     return _finalize(m, l, o, q.dtype)
 
 
-def make_ring_attention_sharded(
-    mesh: Mesh,
-    axis: str = "data",
-    causal: bool = True,
-):
-    """jit-able wrapper: global [B, T, H, D] arrays, T sharded over ``axis``.
-
-    Returns ``fn(q, k, v) -> out`` with out sharded like q. The caller's
-    arrays may live anywhere; jit will insert the resharding collectives.
-    """
+def _make_seq_sharded_attn(kernel, mesh: Mesh, axis: str):
+    """Shared wrapper for the sequence-parallel attention forms: global
+    [B, T, H, D] arrays with T sharded over ``axis``; returns
+    ``fn(q, k, v) -> out`` sharded like q. The caller's arrays may live
+    anywhere; jit inserts the resharding collectives. One factory keeps
+    the ring and Ulysses contracts drop-in interchangeable."""
     from real_time_fraud_detection_system_tpu.parallel.mesh import (
         compat_shard_map,
     )
 
     spec = P(None, axis, None, None)
-    fn = compat_shard_map(
-        partial(ring_attention, axis_name=axis, causal=causal),
-        mesh,
-        (spec, spec, spec),
-        spec,
-    )
-    return jax.jit(fn)
+    return jax.jit(compat_shard_map(kernel, mesh, (spec, spec, spec),
+                                    spec))
+
+
+def make_ring_attention_sharded(
+    mesh: Mesh,
+    axis: str = "data",
+    causal: bool = True,
+):
+    """Ring form of the sequence-parallel attention wrapper (see
+    :func:`_make_seq_sharded_attn`)."""
+    return _make_seq_sharded_attn(
+        partial(ring_attention, axis_name=axis, causal=causal), mesh, axis)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    causal: bool = True,
+    block_size: int = 512,
+) -> jnp.ndarray:
+    """All-to-all sequence parallelism (the DeepSpeed-Ulysses form).
+
+    The complementary long-context layout to :func:`ring_attention`
+    (SURVEY's "ring attention or all-to-all sequence/context
+    parallelism"): instead of rotating K/V blocks around the ring while
+    the sequence stays sharded, two ``all_to_all`` collectives (one
+    stacked q/k/v exchange in, one out) re-shard the tensors from
+    sequence-sharded to HEAD-sharded for the attention itself — each
+    device then holds the FULL sequence for H/n heads and runs an
+    ordinary (here: flash/blockwise) causal attention with zero inner
+    communication, before the inverse exchange restores the
+    sequence-sharded layout.
+
+    Trade-off vs the ring: 2 all-to-alls of activation size (bandwidth,
+    all-at-once) vs n_dev ppermute hops (latency, overlapped with
+    compute); Ulysses needs ``n_heads % n_dev == 0`` while the ring
+    shards any head count. Both are exact (same online-softmax math) —
+    parity is test-pinned against :func:`blockwise_attention`.
+
+    Local view: q/k/v [B, T_local, H, D] with the global sequence
+    device-major over the axis; returns the same layout.
+    """
+    n = jax.lax.psum(1, axis_name)
+    h = q.shape[2]
+    if h % n:
+        raise ValueError(
+            f"ulysses_attention needs n_heads ({h}) divisible by the "
+            f"mesh axis size ({n}); use ring_attention otherwise")
+    # sequence-sharded -> head-sharded: split heads, gather sequence
+    # (device order along T = global order, since T blocks are
+    # device-major). q/k/v ride ONE stacked exchange — a collective
+    # launch is latency-bound on a real mesh, so one [3, ...] all_to_all
+    # beats three.
+    qkv = jnp.stack((q, k, v))  # [3, B, T_local, H, D]
+    qh, kh, vh = jax.lax.all_to_all(
+        qkv, axis_name, split_axis=3, concat_axis=2, tiled=True)
+    out = blockwise_attention(qh, kh, vh, block_size=block_size,
+                              causal=causal)
+    # head-sharded -> sequence-sharded (inverse exchange)
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def make_ulysses_attention_sharded(
+    mesh: Mesh,
+    axis: str = "data",
+    causal: bool = True,
+    block_size: int = 512,
+):
+    """Ulysses form of the sequence-parallel attention wrapper — same
+    contract as :func:`make_ring_attention_sharded` (see
+    :func:`_make_seq_sharded_attn`), so the two forms are drop-in
+    interchangeable."""
+    return _make_seq_sharded_attn(
+        partial(ulysses_attention, axis_name=axis, causal=causal,
+                block_size=block_size), mesh, axis)

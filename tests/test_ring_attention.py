@@ -67,3 +67,39 @@ def test_ring_bf16(rng):
     np.testing.assert_allclose(
         np.asarray(out16.astype(jnp.float32)), np.asarray(ref), atol=0.1
     )
+
+
+def test_ulysses_matches_blockwise_and_ring():
+    """All-to-all (Ulysses) sequence parallelism ≡ single-device flash ≡
+    ring, on the 8-virtual-device mesh (exact online-softmax math)."""
+    from real_time_fraud_detection_system_tpu.parallel.mesh import make_mesh
+    from real_time_fraud_detection_system_tpu.parallel.ring_attention import (
+        blockwise_attention,
+        make_ring_attention_sharded,
+        make_ulysses_attention_sharded,
+    )
+
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(4)
+    b, t, h, d = 2, 8 * 16, 8, 16  # T and H both divisible by 8
+    q = jnp.asarray(rng.normal(0, 1, (b, t, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(0, 1, (b, t, h, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(0, 1, (b, t, h, d)), jnp.float32)
+    ref = np.asarray(blockwise_attention(q, k, v, block_size=16))
+    uly = np.asarray(make_ulysses_attention_sharded(mesh)(q, k, v))
+    ring = np.asarray(make_ring_attention_sharded(mesh)(q, k, v))
+    np.testing.assert_allclose(uly, ref, atol=2e-5)
+    np.testing.assert_allclose(uly, ring, atol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    from real_time_fraud_detection_system_tpu.parallel.mesh import make_mesh
+    from real_time_fraud_detection_system_tpu.parallel.ring_attention import (
+        make_ulysses_attention_sharded,
+    )
+
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.normal(0, 1, (1, 64, 6, 8)), jnp.float32)  # 6 % 8 != 0
+    with pytest.raises(ValueError, match="divisible"):
+        make_ulysses_attention_sharded(mesh)(q, q, q)
