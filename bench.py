@@ -681,31 +681,65 @@ def _child_main(args) -> None:
             init_transformer,
         )
 
-        seq_rows = 4096 if (args.quick or on_cpu) else 65536
-        seq_cfg = FeatureConfig(
-            customer_capacity=8192, terminal_capacity=1024, history_len=32)
         tparams = init_transformer(
             d_model=32, n_heads=2, n_layers=2, d_ff=64, seed=0)
         seq_step = jax.jit(update_and_score, static_argnums=(3,),
                            donate_argnums=(0,))
-        sc = _make_batch_cols(rng, seq_rows)
-        sbatch2 = jax.tree.map(jnp.asarray, make_batch(**sc))
-        hstate = init_history_state(seq_cfg)
-        hstate, sp = seq_step(hstate, tparams, sbatch2, seq_cfg)
-        jax.block_until_ready(sp)
-        seq_iters = 2 if (args.quick or on_cpu) else 20
-        t0 = time.perf_counter()
-        for _ in range(seq_iters):
-            hstate, sp = seq_step(hstate, tparams, sbatch2, seq_cfg)
-        jax.block_until_ready(sp)
-        seq_wall = time.perf_counter() - t0
-        seq_stats = {
-            "txns_per_sec": round(seq_iters * seq_rows / seq_wall, 1),
-            "batch_rows": seq_rows,
-            "history_len": seq_cfg.history_len,
-            "d_model": 32,
-            "backend": jax.default_backend(),
-        }
+
+        def _measure_seq(history_len: int, rows: int, iters: int) -> dict:
+            """One sequence-scorer measurement: build, warmup, timed
+            loop, stats — shared by the K=32 base and long-K variants."""
+            from real_time_fraud_detection_system_tpu.features.history import (
+                _attn_fn_for,
+            )
+
+            cfg_k = FeatureConfig(
+                customer_capacity=8192, terminal_capacity=1024,
+                history_len=history_len)
+            c = _make_batch_cols(rng, rows)
+            b = jax.tree.map(jnp.asarray, make_batch(**c))
+            st = init_history_state(cfg_k)
+            st, p = seq_step(st, tparams, b, cfg_k)
+            jax.block_until_ready(p)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                st, p = seq_step(st, tparams, b, cfg_k)
+            jax.block_until_ready(p)
+            return {
+                "txns_per_sec": round(
+                    iters * rows / (time.perf_counter() - t0), 1),
+                "batch_rows": rows,
+                "history_len": history_len,
+                # derived from the real dispatch, never hardcoded
+                "attn": ("naive" if _attn_fn_for(cfg_k, history_len)
+                         is None else "blockwise"),
+            }
+
+        seq_rows = 4096 if (args.quick or on_cpu) else 65536
+        seq_stats = _measure_seq(
+            32, seq_rows, iters=2 if (args.quick or on_cpu) else 20)
+        seq_stats["d_model"] = 32
+        seq_stats["backend"] = jax.default_backend()
+
+        if full:
+            # Long-context variant: K past seq_attn_block so the serving
+            # transformer runs the blockwise (flash) attention — the
+            # [B, H, K, K] naive form would OOM at production batch
+            # sizes (137 GB at K=512/B=64k). Own guard: a failure here
+            # records its own error key, never the base measurement's.
+            _progress("sequence scorer long-history")
+            try:
+                seq_stats["long_history"] = _measure_seq(
+                    256, 8192 if not on_cpu else 1024,
+                    iters=2 if on_cpu else 10)
+                # the point of this row is the flash path — refuse to
+                # record a mislabeled naive measurement if the auto
+                # threshold ever moves past 256
+                assert seq_stats["long_history"]["attn"] == "blockwise"
+            except Exception as e:
+                seq_stats["long_history"] = {
+                    "error": f"{type(e).__name__}: {str(e)[:160]}"
+                }
     except Exception as e:
         seq_stats = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
 
