@@ -1,5 +1,6 @@
 from real_time_fraud_detection_system_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
+    reshard_feature_state,
     shard_feature_state,
 )
 from real_time_fraud_detection_system_tpu.parallel.step import (  # noqa: F401

@@ -109,3 +109,119 @@ def shard_feature_state(
         terminal=place_windows(state.terminal),
         cms=cms,
     )
+
+
+def _layout_perm(cap: int, n_dev: int) -> np.ndarray:
+    """Global table row of key k under the n-device owner layout.
+
+    Single-chip (n=1): row = k. Sharded: device ``k % n`` owns contiguous
+    rows ``[owner * cap/n, (owner+1) * cap/n)`` and places k at local slot
+    ``k // n`` (``parallel/step.py``'s ``(key // n) & (cap_local - 1)``,
+    a no-op mask for k < cap) — so row = (k % n) * (cap/n) + k // n.
+    A bijection for pow2 cap/n, which the sharded step validates."""
+    k = np.arange(cap)
+    if n_dev == 1:
+        return k
+    return (k % n_dev) * (cap // n_dev) + k // n_dev
+
+
+def reshard_feature_state(
+    state: FeatureState, cfg, n_old: int, n_new: int
+) -> FeatureState:
+    """Elastic re-layout of the window feature state between device
+    counts — the :func:`..parallel.sequence_step.reshard_history_state`
+    analogue for the flagship state (SURVEY §5.3 elastic recovery).
+
+    In ``direct`` key mode the slot maps are bijections, so converting a
+    single-chip checkpoint into an 8-way layout (or n→m after a topology
+    change) is EXACT for the customer/terminal window tables: restore,
+    reshard, and serving continues as if the stream had always run at the
+    new width. Layouts are positional, so the CALLER states ``n_old``
+    (the checkpoint's device count; shapes alone cannot distinguish
+    layouts). Returns host-side arrays; place them with
+    :func:`shard_feature_state` (or use directly at ``n_new == 1``).
+
+    The CMS is approximate by nature and its conversion preserves the
+    upper-bound guarantee rather than exactness: sharded→single merges
+    per-slice with the NEWEST day stamp winning (quiet shards whose ring
+    lags contribute zero for days they provably never saw — lag-tolerant
+    and exact-preserving), which over-counts any replicated warm-start
+    base — still a valid CMS upper bound, noted here because it is the
+    one non-exact leg. The returned CMS always carries the SINGLE-chip
+    layout: :func:`shard_feature_state` expands it per-device at
+    placement time (shard-by-shard, so a production-size sketch is never
+    replicated n× in host RAM).
+    """
+    fcfg = cfg.features
+    if fcfg.key_mode != "direct":
+        raise ValueError("elastic re-shard requires key_mode='direct'")
+    for n in (n_old, n_new):
+        if n < 1:
+            raise ValueError(f"device counts must be >= 1, got {n}")
+        for name, cap in (("customer", fcfg.customer_capacity),
+                          ("terminal", fcfg.terminal_capacity)):
+            if cap % n:
+                raise ValueError(
+                    f"{name}_capacity {cap} must divide by {n}")
+            local = cap // n
+            if local & (local - 1):
+                raise ValueError(
+                    f"{name}_capacity / {n} must be a power of two, "
+                    f"got {local}")
+
+    def convert(ws, cap: int):
+        p_old = _layout_perm(cap, n_old)
+        p_new = _layout_perm(cap, n_new)
+
+        def re(leaf):
+            a = np.asarray(leaf)
+            if a.shape[0] != cap:
+                raise ValueError(
+                    f"state table has {a.shape[0]} rows, config says "
+                    f"{cap} — re-sharding a checkpoint taken under a "
+                    "different capacity would merge or drop keys")
+            out = np.empty_like(a)
+            out[p_new] = a[p_old]
+            return out
+
+        return jax.tree.map(re, ws)
+
+    cms = state.cms
+    if cms is not None:
+        leaves = [np.asarray(a) for a in cms]
+        if n_old > 1 and leaves[0].ndim > 1:
+            if leaves[0].shape[0] != n_old:
+                raise ValueError(
+                    f"cms device axis {leaves[0].shape[0]} != n_old "
+                    f"{n_old}")
+            # Disjoint key partitions make counts additive — but a quiet
+            # shard's day ring lags (slices only advance when that device
+            # sees traffic for the day). Exact-preserving merge: per
+            # slice, take the NEWEST stamp and sum only devices holding
+            # it (a stale slice would have been reset when that day
+            # arrived there, and its device provably saw no such-day
+            # traffic).
+            days = leaves[0]  # [n, ND]
+            max_day = days.max(axis=0)  # [ND]
+            fresh = (days == max_day[None]).astype(leaves[1].dtype)
+            single = type(cms)(
+                max_day,
+                *[(a * fresh[..., None, None]).sum(axis=0)
+                  for a in leaves[1:]],
+            )
+        else:
+            # already single-layout (n_old == 1, or a prior reshard's
+            # deferred-expansion output where only the windows carry the
+            # n_old layout)
+            single = type(cms)(*leaves)
+        # n_new > 1 keeps the SINGLE layout: shard_feature_state expands
+        # it per-device at placement time (shard-by-shard, never n_new
+        # host copies of a production-size sketch — the OOM its _expand
+        # branch exists to avoid).
+        cms = single
+
+    return FeatureState(
+        customer=convert(state.customer, fcfg.customer_capacity),
+        terminal=convert(state.terminal, fcfg.terminal_capacity),
+        cms=cms,
+    )

@@ -87,10 +87,61 @@ class ShardedScoringEngine(ScoringEngine):
         axis: "str | tuple" = "data",
         online_lr: float = 0.0,
         feature_cache=None,
+        feature_state=None,
+        feature_state_n_old: Optional[int] = None,
     ):
+        """``feature_state``: a pre-built state for elastic recovery of a
+        checkpoint taken at a different device count. Pass
+        ``feature_state_n_old`` (the checkpoint's device count; 1 for a
+        single-chip checkpoint) and the engine reshards it to THIS mesh
+        itself via :func:`~.parallel.mesh.reshard_feature_state` /
+        :func:`~.parallel.sequence_step.reshard_history_state` — the
+        safest path, since window layouts are shape-identical
+        permutations that nothing else can tell apart. Omit
+        ``feature_state_n_old`` only when the state is already in this
+        mesh's layout. Default: fresh state."""
         mesh = mesh if mesh is not None else make_mesh(n_devices)
+        n_mesh = int(mesh.devices.size)
+        if feature_state is not None and feature_state_n_old is not None:
+            if kind == "sequence":
+                from real_time_fraud_detection_system_tpu.parallel.sequence_step import (
+                    reshard_history_state,
+                )
+
+                feature_state = reshard_history_state(
+                    feature_state, cfg, n_mesh)
+                if n_mesh == 1:
+                    # reshard's n=1 output is the single-chip layout;
+                    # the sharded step wants the stacked [1, ...] form
+                    feature_state = jax.tree.map(
+                        lambda a: jnp.asarray(a)[None], feature_state)
+            else:
+                from real_time_fraud_detection_system_tpu.parallel.mesh import (
+                    reshard_feature_state,
+                )
+
+                feature_state = reshard_feature_state(
+                    feature_state, cfg, feature_state_n_old, n_mesh)
+        elif feature_state is not None and kind != "sequence":
+            # Claimed mesh layout: cross-check what little IS checkable
+            # (layout permutations are shape-identical, so only a
+            # device-axis-carrying CMS betrays a width mismatch).
+            cms = feature_state.cms
+            if cms is not None and np.asarray(cms.slice_day).ndim > 1 \
+                    and np.asarray(cms.slice_day).shape[0] != n_mesh:
+                raise ValueError(
+                    f"feature_state CMS is laid out for "
+                    f"{np.asarray(cms.slice_day).shape[0]} devices, mesh "
+                    f"has {n_mesh} — pass feature_state_n_old to let the "
+                    "engine reshard it")
         pre_state = None
-        if kind == "sequence":
+        if kind == "sequence" and feature_state is not None:
+            from real_time_fraud_detection_system_tpu.parallel.sequence_step import (
+                shard_history_state,
+            )
+
+            pre_state = shard_history_state(feature_state, mesh, axis=axis)
+        elif kind == "sequence":
             # build the owner-sharded state FIRST and hand it to the base
             # constructor — a throwaway full-size single-chip HistoryState
             # would transiently double the state's HBM footprint
@@ -99,6 +150,12 @@ class ShardedScoringEngine(ScoringEngine):
             )
 
             pre_state = init_sharded_history_state(cfg, mesh, axis=axis)
+        if kind != "sequence":
+            # hand any provided state straight to the base constructor —
+            # letting it build a throwaway full-size fresh state would
+            # transiently double the footprint (same reasoning as the
+            # sequence pre_state above)
+            pre_state = feature_state
         super().__init__(
             cfg, kind, params, scaler, feature_state=pre_state,
             online_lr=online_lr, feature_cache=feature_cache,
@@ -130,8 +187,10 @@ class ShardedScoringEngine(ScoringEngine):
             return
         if cfg.features.terminal_capacity % self.n_dev:
             raise ValueError("terminal_capacity must divide by n_devices")
+        # the base constructor holds either the provided state or a fresh
+        # one — place it over the mesh (no second allocation)
         self.state.feature_state = shard_feature_state(
-            init_feature_state(cfg.features), self.mesh, axis=self.axis
+            self.state.feature_state, self.mesh, axis=self.axis,
         )
         self._sharded_build = make_sharded_step(
             cfg,

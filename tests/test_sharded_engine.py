@@ -502,3 +502,179 @@ def test_sharded_alerts_only_same_probs_zero_features(small_dataset):
                                atol=1e-6)
     assert np.all(a["customer_id_nb_tx_7day_window"] == 0)
     assert np.any(f["customer_id_nb_tx_7day_window"] != 0)
+
+
+def test_reshard_feature_state_single_to_mesh_exact(small_dataset):
+    """Elastic recovery for the window state: stream on ONE chip, reshard
+    the state 1→8, continue on the mesh — the mesh's scores for the next
+    batches must equal a single-chip engine that never stopped."""
+    _, _, _, txs = small_dataset
+    warm = txs.slice(slice(0, 3072))
+    rest = txs.slice(slice(3072, 5120))
+    cfg = _cfg()
+    params, scaler = _model()
+
+    # single-chip engine streams the warm prefix
+    eng1 = ScoringEngine(cfg, kind="logreg", params=params, scaler=scaler)
+    eng1.run(ReplaySource(warm, EPOCH0, batch_rows=1024))
+
+    # ... keeps going single-chip (the oracle)
+    s_ref = MemorySink()
+    eng1.run(ReplaySource(rest, EPOCH0, batch_rows=1024), sink=s_ref)
+
+    # a second single-chip engine streams the same prefix, then its state
+    # is elastically resharded onto the 8-device mesh
+    eng2 = ScoringEngine(cfg, kind="logreg", params=params, scaler=scaler)
+    eng2.run(ReplaySource(warm, EPOCH0, batch_rows=1024))
+    # engine-internal reshard: the engine converts the single-chip state
+    # to its own mesh width (the layout count it trusts is its own)
+    eng8 = ShardedScoringEngine(cfg, kind="logreg", params=params,
+                                scaler=scaler, n_devices=N_DEV,
+                                feature_state=eng2.state.feature_state,
+                                feature_state_n_old=1)
+    s_mesh = MemorySink()
+    eng8.run(ReplaySource(rest, EPOCH0, batch_rows=1024), sink=s_mesh)
+
+    a, b = s_ref.concat(), s_mesh.concat()
+    oa, ob = np.argsort(a["tx_id"]), np.argsort(b["tx_id"])
+    np.testing.assert_array_equal(a["tx_id"][oa], b["tx_id"][ob])
+    np.testing.assert_allclose(a["prediction"][oa], b["prediction"][ob],
+                               atol=1e-6)
+
+
+def test_reshard_feature_state_roundtrip_identity(small_dataset):
+    """1→8→4→1 must return the exact original tables."""
+    import jax
+
+    from real_time_fraud_detection_system_tpu.parallel import (
+        reshard_feature_state,
+    )
+
+    _, _, _, txs = small_dataset
+    cfg = _cfg()
+    params, scaler = _model()
+    eng = ScoringEngine(cfg, kind="logreg", params=params, scaler=scaler)
+    eng.run(ReplaySource(txs.slice(slice(0, 2048)), EPOCH0,
+                         batch_rows=1024))
+    st = eng.state.feature_state
+    s8 = reshard_feature_state(st, cfg, 1, 8)
+    s4 = reshard_feature_state(s8, cfg, 8, 4)
+    s1 = reshard_feature_state(s4, cfg, 4, 1)
+    for orig, back in zip(jax.tree.leaves(st), jax.tree.leaves(s1)):
+        np.testing.assert_array_equal(np.asarray(orig), np.asarray(back))
+
+
+def test_reshard_feature_state_rejects_bad_shapes():
+    import pytest as _pytest
+
+    from real_time_fraud_detection_system_tpu.config import (
+        Config,
+        FeatureConfig,
+    )
+    from real_time_fraud_detection_system_tpu.features.online import (
+        init_feature_state,
+    )
+    from real_time_fraud_detection_system_tpu.parallel import (
+        reshard_feature_state,
+    )
+
+    cfg = Config(features=FeatureConfig(customer_capacity=256,
+                                        terminal_capacity=512))
+    st = init_feature_state(cfg.features)
+    bad = Config(features=FeatureConfig(customer_capacity=512,
+                                        terminal_capacity=512))
+    with _pytest.raises(ValueError, match="rows"):
+        reshard_feature_state(st, bad, 1, 2)
+    hash_cfg = Config(features=FeatureConfig(
+        customer_capacity=256, terminal_capacity=512, key_mode="hash"))
+    with _pytest.raises(ValueError, match="direct"):
+        reshard_feature_state(st, hash_cfg, 1, 2)
+
+
+def test_reshard_feature_state_cms_upper_bound(small_dataset):
+    """CMS reshard preserves the upper-bound guarantee: single→sharded
+    replicates (warm start), sharded→single sums — estimates never
+    shrink below the originals."""
+    import dataclasses
+
+    import jax
+
+    from real_time_fraud_detection_system_tpu.parallel import (
+        reshard_feature_state,
+    )
+
+    _, _, _, txs = small_dataset
+    cfg = _cfg()
+    cfg = cfg.replace(features=dataclasses.replace(
+        cfg.features, customer_source="cms"))
+    params, scaler = _model()
+    eng = ScoringEngine(cfg, kind="logreg", params=params, scaler=scaler)
+    eng.run(ReplaySource(txs.slice(slice(0, 2048)), EPOCH0,
+                         batch_rows=1024))
+    st = eng.state.feature_state
+    assert st.cms is not None
+    s4 = reshard_feature_state(st, cfg, 1, 4)
+    # deferred expansion: the CMS stays single-layout (warm-start base);
+    # shard_feature_state replicates per-device at placement — never
+    # n copies of a production-size sketch in host RAM
+    assert np.asarray(s4.cms.slice_day).ndim == 1
+    np.testing.assert_array_equal(np.asarray(s4.cms.count),
+                                  np.asarray(st.cms.count))
+    s1 = reshard_feature_state(s4, cfg, 4, 1)
+    # the merge never undercounts (upper-bound guarantee preserved)
+    assert np.all(np.asarray(s1.cms.count) >=
+                  np.asarray(st.cms.count) - 1e-6)
+    np.testing.assert_array_equal(np.asarray(s1.cms.slice_day),
+                                  np.asarray(st.cms.slice_day))
+    # window tables round-trip exactly regardless of the cms leg
+    for a, b in zip(jax.tree.leaves(st.terminal),
+                    jax.tree.leaves(s1.terminal)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_reshard_cms_merge_tolerates_lagging_shards():
+    """A quiet shard's day ring lags (slices only advance with traffic);
+    the merge takes the newest stamp per slice and zeroes stale devices'
+    contributions — exact-preserving, never a hard failure."""
+    import dataclasses
+
+    from real_time_fraud_detection_system_tpu.config import (
+        Config,
+        FeatureConfig,
+    )
+    from real_time_fraud_detection_system_tpu.features.online import (
+        FeatureState,
+        init_feature_state,
+    )
+    from real_time_fraud_detection_system_tpu.ops.cms import CountMinSketch
+    from real_time_fraud_detection_system_tpu.parallel import (
+        reshard_feature_state,
+    )
+
+    cfg = Config(features=FeatureConfig(
+        customer_capacity=256, terminal_capacity=256,
+        customer_source="cms", cms_depth=2, cms_width=16,
+        n_day_buckets=4))
+    base = init_feature_state(cfg.features)
+    nd, d, w = 4, 2, 16
+    # device 0 saw day 10 in slice 10%4=2; device 1 is quiet and still
+    # holds day 6 there (stale ring) with counts that must NOT merge in
+    days = np.tile(np.array([8, 9, 10, 7], np.int32), (2, 1))
+    days[1, 2] = 6
+    count = np.zeros((2, nd, d, w), np.float32)
+    count[0, 2] = 5.0  # fresh day-10 traffic on device 0
+    count[1, 2] = 99.0  # stale day-6 leftovers on device 1
+    count[:, 1] = 1.0  # day 9 agreed on both: additive
+    cms = CountMinSketch(
+        slice_day=np.asarray(days),
+        count=np.asarray(count),
+        amount=np.zeros_like(count),
+    )
+    st = FeatureState(customer=base.customer, terminal=base.terminal,
+                      cms=cms)
+    merged = reshard_feature_state(st, cfg, 2, 1).cms
+    np.testing.assert_array_equal(np.asarray(merged.slice_day),
+                                  [8, 9, 10, 7])
+    got = np.asarray(merged.count)
+    assert np.all(got[2] == 5.0)  # stale 99s zeroed, fresh 5s kept
+    assert np.all(got[1] == 2.0)  # agreed slices sum across devices
