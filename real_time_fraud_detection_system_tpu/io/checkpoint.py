@@ -42,6 +42,10 @@ def write_state_npz(fileobj, engine_state) -> None:
         "n_fs": len(leaves_fs),
         "n_p": len(leaves_p),
         "n_s": len(leaves_s),
+        # layouts are shape-identical permutations: the writer's device
+        # count must travel with the state for cross-width restores
+        "layout_devices": int(
+            getattr(engine_state, "layout_devices", 1) or 1),
     }
     np.savez(fileobj, __meta__=json.dumps(meta), **arrays)
 
@@ -82,6 +86,10 @@ def read_state_npz(fileobj, engine_state):
     engine_state.offsets = meta["offsets"]
     engine_state.batches_done = meta["batches_done"]
     engine_state.rows_done = meta["rows_done"]
+    if meta.get("layout_devices") is not None:
+        engine_state.layout_devices = int(meta["layout_devices"])
+    # pre-layout-aware checkpoints: leave the template's value (the old
+    # same-width-restore assumption)
     return engine_state
 
 

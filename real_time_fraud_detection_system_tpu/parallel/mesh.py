@@ -225,3 +225,27 @@ def reshard_feature_state(
         terminal=convert(state.terminal, fcfg.terminal_capacity),
         cms=cms,
     )
+
+
+def reshard_engine_state(kind: str, state, cfg, n_old: int, n_new: int,
+                         stacked: bool = False):
+    """Kind-dispatched elastic reshard: window feature state vs sequence
+    history state — the ONE conversion path every engine entry point
+    uses, so the semantics cannot diverge between call sites.
+
+    ``stacked``: return the ``[n, ...]`` stacked layout even at
+    ``n_new == 1`` (the sharded sequence step's form; the single-chip
+    engine wants the flat layout). Returns host-side arrays; callers
+    place them (``shard_feature_state`` / ``shard_history_state`` or a
+    plain ``jnp.asarray`` tree-map).
+    """
+    if kind == "sequence":
+        from real_time_fraud_detection_system_tpu.parallel.sequence_step import (
+            reshard_history_state,
+        )
+
+        st = reshard_history_state(state, cfg, n_new)
+        if stacked and n_new == 1:
+            st = jax.tree.map(lambda a: jax.numpy.asarray(a)[None], st)
+        return st
+    return reshard_feature_state(state, cfg, n_old, n_new)

@@ -106,6 +106,11 @@ class EngineState:
     offsets: List[int] = field(default_factory=list)
     batches_done: int = 0
     rows_done: int = 0
+    # Device count whose owner layout feature_state carries (window/
+    # history layouts are shape-identical permutations, so the width must
+    # travel WITH the state). Checkpoints record it; restore compares it
+    # to the serving engine's own width and auto-reshards on mismatch.
+    layout_devices: int = 1
 
 
 @dataclass
@@ -380,8 +385,28 @@ class ScoringEngine:
             batch_index=self.state.batches_done,
         )
 
+    def _ensure_layout(self) -> None:
+        """Adopt a restored checkpoint written at a different device
+        count: ``state.layout_devices`` records the writer's width, and
+        the slot layouts are shape-identical permutations — so convert
+        (exactly, via the elastic reshard) rather than serve silently
+        permuted state."""
+        n_old = int(getattr(self.state, "layout_devices", 1) or 1)
+        if n_old == 1:
+            return
+        from real_time_fraud_detection_system_tpu.parallel.mesh import (
+            reshard_engine_state,
+        )
+
+        self.state.feature_state = jax.tree.map(
+            jnp.asarray,
+            reshard_engine_state(self.kind, self.state.feature_state,
+                                 self.cfg, n_old, 1))
+        self.state.layout_devices = 1
+
     def process_batch(self, cols: dict) -> BatchResult:
         """One micro-batch: dedup → pad → device step → host result."""
+        self._ensure_layout()
         return self._finish_batch(self._start_batch(cols))
 
     @property
@@ -409,6 +434,9 @@ class ScoringEngine:
             apply_feedback as state_feedback,
         )
 
+        # labels scatter by slot math — a restored cross-width state must
+        # convert BEFORE any scatter, same as the scoring entry points
+        self._ensure_layout()
         labels = np.asarray(labels)
         mask = labels >= 0
         if not mask.any():
@@ -530,6 +558,7 @@ class ScoringEngine:
 
         Returns run stats (rows, batches, throughput, latency percentiles).
         """
+        self._ensure_layout()  # cross-width checkpoint restores convert
         trigger = (
             self.cfg.runtime.trigger_seconds
             if trigger_seconds is None

@@ -103,25 +103,13 @@ class ShardedScoringEngine(ScoringEngine):
         mesh = mesh if mesh is not None else make_mesh(n_devices)
         n_mesh = int(mesh.devices.size)
         if feature_state is not None and feature_state_n_old is not None:
-            if kind == "sequence":
-                from real_time_fraud_detection_system_tpu.parallel.sequence_step import (
-                    reshard_history_state,
-                )
+            from real_time_fraud_detection_system_tpu.parallel.mesh import (
+                reshard_engine_state,
+            )
 
-                feature_state = reshard_history_state(
-                    feature_state, cfg, n_mesh)
-                if n_mesh == 1:
-                    # reshard's n=1 output is the single-chip layout;
-                    # the sharded step wants the stacked [1, ...] form
-                    feature_state = jax.tree.map(
-                        lambda a: jnp.asarray(a)[None], feature_state)
-            else:
-                from real_time_fraud_detection_system_tpu.parallel.mesh import (
-                    reshard_feature_state,
-                )
-
-                feature_state = reshard_feature_state(
-                    feature_state, cfg, feature_state_n_old, n_mesh)
+            feature_state = reshard_engine_state(
+                kind, feature_state, cfg, feature_state_n_old, n_mesh,
+                stacked=True)
         elif feature_state is not None and kind != "sequence":
             # Claimed mesh layout: cross-check what little IS checkable
             # (layout permutations are shape-identical, so only a
@@ -163,6 +151,7 @@ class ShardedScoringEngine(ScoringEngine):
         self.mesh = mesh
         self.axis = axis
         self.n_dev = int(self.mesh.devices.size)
+        self.state.layout_devices = self.n_dev
         if cfg.features.customer_capacity % self.n_dev:
             raise ValueError("customer_capacity must divide by n_devices")
         # Default: 2× the balanced per-device load, so ordinary partition
@@ -216,6 +205,23 @@ class ShardedScoringEngine(ScoringEngine):
         self._sharded_sf = None
 
     # -- sharding upkeep ---------------------------------------------------
+
+    def _ensure_layout(self) -> None:
+        """Adopt a restored checkpoint written at a different width:
+        convert to THIS mesh's layout via the elastic reshards (exact
+        for the window/history tables)."""
+        n_old = int(getattr(self.state, "layout_devices", 1) or 1)
+        if n_old == self.n_dev:
+            return
+        from real_time_fraud_detection_system_tpu.parallel.mesh import (
+            reshard_engine_state,
+        )
+
+        self.state.feature_state = reshard_engine_state(
+            self.kind, self.state.feature_state, self.cfg, n_old,
+            self.n_dev, stacked=True)
+        self.state.layout_devices = self.n_dev
+        # placement over the mesh happens in _ensure_sharded
 
     def _ensure_sharded(self) -> None:
         """Re-place the feature state after an external restore.
@@ -348,6 +354,8 @@ class ShardedScoringEngine(ScoringEngine):
         (owner shard × local slot, mirroring ``parallel/step.py``). The
         scatter runs as a plain jitted global-array op — GSPMD inserts the
         (off-hot-path) collectives."""
+        # cross-width restored state must convert before any slot scatter
+        self._ensure_layout()
         if self.kind == "sequence":
             raise ValueError(
                 "the labeled-feedback loop is not wired for "

@@ -678,3 +678,94 @@ def test_reshard_cms_merge_tolerates_lagging_shards():
     got = np.asarray(merged.count)
     assert np.all(got[2] == 5.0)  # stale 99s zeroed, fresh 5s kept
     assert np.all(got[1] == 2.0)  # agreed slices sum across devices
+
+
+def test_checkpoint_cross_width_restore_auto_reshards(small_dataset,
+                                                      tmp_path):
+    """A checkpoint records its layout width; restoring it into an engine
+    of a DIFFERENT width converts the state automatically — single-chip
+    checkpoint → 8-way mesh and back, byte-identical continuations."""
+    _, _, _, txs = small_dataset
+    warm = txs.slice(slice(0, 3072))
+    rest = txs.slice(slice(3072, 5120))
+    cfg = _cfg()
+    params, scaler = _model()
+
+    # single-chip run writes a checkpoint
+    ck = Checkpointer(str(tmp_path / "ck"))
+    eng1 = ScoringEngine(cfg, kind="logreg", params=params, scaler=scaler)
+    eng1.run(ReplaySource(warm, EPOCH0, batch_rows=1024), checkpointer=ck)
+    ck.save(eng1.state)
+    s_ref = MemorySink()
+    eng1.run(ReplaySource(rest, EPOCH0, batch_rows=1024), sink=s_ref)
+
+    # restore into an 8-way mesh engine: auto-resharded continuation
+    eng8 = ShardedScoringEngine(cfg, kind="logreg", params=params,
+                                scaler=scaler, n_devices=N_DEV)
+    restored = ck.restore(eng8.state)
+    assert restored is not None and restored.layout_devices == 1
+    s_mesh = MemorySink()
+    eng8.run(ReplaySource(rest, EPOCH0, batch_rows=1024), sink=s_mesh)
+    assert eng8.state.layout_devices == N_DEV
+
+    a, b = s_ref.concat(), s_mesh.concat()
+    oa, ob = np.argsort(a["tx_id"]), np.argsort(b["tx_id"])
+    np.testing.assert_allclose(a["prediction"][oa], b["prediction"][ob],
+                               atol=1e-6)
+
+    # and the mesh's checkpoint restores back into a single-chip engine
+    ck8 = Checkpointer(str(tmp_path / "ck8"))
+    ck8.save(eng8.state)
+    eng1b = ScoringEngine(cfg, kind="logreg", params=params,
+                          scaler=scaler)
+    restored8 = ck8.restore(eng1b.state)
+    assert restored8 is not None and restored8.layout_devices == N_DEV
+    tail = txs.slice(slice(5120, 6144))
+    s_tail_mesh = MemorySink()
+    eng8.run(ReplaySource(tail, EPOCH0, batch_rows=1024),
+             sink=s_tail_mesh)
+    s_tail_one = MemorySink()
+    eng1b.run(ReplaySource(tail, EPOCH0, batch_rows=1024),
+              sink=s_tail_one)
+    x, y = s_tail_mesh.concat(), s_tail_one.concat()
+    ox, oy = np.argsort(x["tx_id"]), np.argsort(y["tx_id"])
+    np.testing.assert_allclose(x["prediction"][ox], y["prediction"][oy],
+                               atol=1e-6)
+
+
+def test_state_feedback_after_cross_width_restore(small_dataset, tmp_path):
+    """Delayed-label feedback right after a cross-width restore must land
+    in the CORRECT terminals' windows (the scatter converts the layout
+    first, like every scoring entry point)."""
+    _, _, _, txs = small_dataset
+    warm = txs.slice(slice(0, 2048))
+    cfg = _cfg()
+    params, scaler = _model()
+
+    # mesh engine streams, checkpoints
+    ck = Checkpointer(str(tmp_path / "ck"))
+    eng8 = ShardedScoringEngine(cfg, kind="logreg", params=params,
+                                scaler=scaler, n_devices=N_DEV)
+    eng8.run(ReplaySource(warm, EPOCH0, batch_rows=1024))
+    ck.save(eng8.state)
+
+    # restore into single-chip, apply feedback BEFORE any scoring call
+    term = np.asarray([5, 9, 5], dtype=np.int64)
+    days = np.full(3, 20200, dtype=np.int32)
+    labs = np.ones(3, dtype=np.int32)
+    eng1 = ScoringEngine(cfg, kind="logreg", params=params, scaler=scaler)
+    assert ck.restore(eng1.state) is not None
+    eng1.apply_state_feedback(term, days, labs)
+
+    # oracle: mesh engine applying the same feedback natively
+    eng8.apply_state_feedback(term, days, labs)
+    # compare terminal fraud tables key-by-key via the layout permutation
+    from real_time_fraud_detection_system_tpu.parallel.mesh import (
+        _layout_perm,
+    )
+
+    cap = cfg.features.terminal_capacity
+    p8 = _layout_perm(cap, N_DEV)
+    a = np.asarray(eng1.state.feature_state.terminal.fraud)
+    b = np.asarray(eng8.state.feature_state.terminal.fraud)
+    np.testing.assert_array_equal(a, b[p8])  # single[k] == mesh[perm[k]]
