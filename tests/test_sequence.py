@@ -74,3 +74,23 @@ def test_sp_forward_matches_single_device():
     ref = transformer_logits(params, x)
     sp = make_sp_logits_fn(mesh)(params, x)
     np.testing.assert_allclose(np.asarray(sp), np.asarray(ref), atol=2e-4)
+
+
+def test_transformer_trains_with_blockwise_attention(small_dataset):
+    """train_transformer(attn='blockwise') — the long-history training
+    path — must reduce loss like the naive form (backward through the
+    flash recurrence; gradient parity is pinned in
+    tests/test_ring_attention.py)."""
+    from real_time_fraud_detection_system_tpu.models.sequence import (
+        build_sequences,
+        sequence_scores,
+        train_transformer,
+    )
+
+    _, _, _, txs = small_dataset
+    seqs = build_sequences(txs.slice(slice(0, 4000)), max_len=32)
+    params = train_transformer(seqs, d_model=16, n_heads=2, n_layers=1,
+                               d_ff=32, epochs=2, batch_size=64,
+                               attn="blockwise", seed=3)
+    idx, probs = sequence_scores(params, seqs)
+    assert np.isfinite(probs).all() and probs.std() > 0
