@@ -63,10 +63,20 @@ bench:
 test:
 	$(PY) -m pytest tests/ -q
 
+# wire-level boundary tests against real services (skip cleanly when the
+# dependency/service is absent — see tests/integration/README.md)
+integration:
+	$(PY) -m pytest tests/integration/ -v
+
+# prove the analyzed Parquet output serves the dashboard queries as SQL
+# (DuckDB when installed, else pyarrow+sqlite), cross-checked vs io/query
+sqlcheck:
+	JAX_PLATFORMS=cpu $(PY) tools/parquet_sql_check.py
+
 install:
 	$(PY) -m pip install -e .
 
 clean:
 	rm -rf $(OUT)
 
-.PHONY: demo datagen train score run-all query dashboard connectors dryrun bench test install clean
+.PHONY: demo datagen train score run-all query dashboard connectors dryrun bench test integration sqlcheck install clean
