@@ -66,7 +66,7 @@ test:
 # wire-level boundary tests against real services (skip cleanly when the
 # dependency/service is absent — see tests/integration/README.md)
 integration:
-	$(PY) -m pytest tests/integration/ -v
+	$(PY) -m pytest tests/integration/ -v || [ $$? -eq 5 ]  # 5 = all skipped (deps absent)
 
 # prove the analyzed Parquet output serves the dashboard queries as SQL
 # (DuckDB when installed, else pyarrow+sqlite), cross-checked vs io/query
