@@ -289,6 +289,7 @@ def cmd_score(args) -> int:
         emit_features=not args.alerts_only,
         pipeline_depth=args.pipeline_depth,
         coalesce_rows=args.coalesce_rows,
+        use_pallas=args.use_pallas,
     ))
     cpu_model = None
     if args.scorer == "cpu":
@@ -967,6 +968,10 @@ def main(argv=None) -> int:
     p.add_argument("--coalesce-rows", type=int, default=0,
                    help="merge consecutive source polls into one device "
                         "batch up to this many rows (0 = off)")
+    p.add_argument("--use-pallas", action="store_true",
+                   help="serve with the fused Pallas kernels where "
+                        "available (tree/forest/gbt leaf-sum; logreg "
+                        "featurize+score) instead of the XLA composition")
     p.add_argument("--start-date", default="2025-04-01")
     p.add_argument("--checkpoint-dir", default="")
     p.add_argument("--resume", action="store_true")

@@ -19,3 +19,6 @@ from real_time_fraud_detection_system_tpu.ops.dedup import (  # noqa: F401
     latest_wins_mask,
     latest_wins_mask_np,
 )
+# ops.pallas_forest is deliberately NOT re-exported: like ops.pallas_kernels
+# it pulls in jax.experimental.pallas(+tpu), which stays a lazy, opt-in
+# import behind RuntimeConfig.use_pallas (see runtime/engine.py).

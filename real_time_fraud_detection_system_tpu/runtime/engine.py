@@ -204,6 +204,7 @@ class ScoringEngine:
             and kind == "logreg"
             and cfg.features.customer_source == "table"
         )
+        self._maybe_use_pallas_forest(kind, params)
 
         def step(fstate: FeatureState, params, scaler: Scaler, packed):
             # One packed H2D array per batch (see core.batch.pack_batch):
@@ -238,6 +239,55 @@ class ScoringEngine:
             return fstate, params, probs, feats
 
         self._step = jax.jit(step, donate_argnums=(0,))
+
+    def _maybe_use_pallas_forest(self, kind: str, params) -> None:
+        """Swap the tree-ensemble scorer for the fused Pallas kernel.
+
+        Gated on ``RuntimeConfig.use_pallas``, GEMM-form params, and the
+        padded tables fitting comfortably inside VMEM
+        (``ops/pallas_forest.py``). A pure predict swap: engine state (and
+        checkpoints) keep the ``GemmEnsemble``, and the padded kernel
+        tables are re-derived from the LIVE params inside the jitted step
+        (µs of pad writes) — so a checkpoint restore that overwrites
+        ``state.params`` in place is served, never a stale build-time copy.
+        """
+        if not self.cfg.runtime.use_pallas or self.scorer == "cpu":
+            return
+        if kind not in ("tree", "forest", "gbt"):
+            return  # keep the pallas import lazy for non-ensemble kinds
+        from real_time_fraud_detection_system_tpu.models.forest import (
+            GemmEnsemble,
+        )
+        from real_time_fraud_detection_system_tpu.models.gbt import GBTModel
+        from real_time_fraud_detection_system_tpu.ops.pallas_forest import (
+            pallas_block_bytes,
+            pallas_leaf_sum,
+            pallas_predict_proba,
+            to_pallas,
+        )
+
+        # One double-buffered tree block must sit well inside ~16MB VMEM
+        # next to the row tile and [Bt, 128·k] intermediates. Decided at
+        # TRACE time from the live params' (static) shapes, so a checkpoint
+        # restore that swaps in a deeper ensemble retraces into the XLA
+        # fallback instead of a VMEM-overflowing kernel.
+        budget = 4 * 2 ** 20
+        xla_predict = self._predict
+
+        if kind in ("tree", "forest") and isinstance(params, GemmEnsemble):
+            def _pred(p, x):
+                if pallas_block_bytes(p) <= budget:
+                    return pallas_predict_proba(to_pallas(p), x)
+                return xla_predict(p, x)
+            self._predict = _pred
+        elif (kind == "gbt" and isinstance(params, GBTModel)
+                and isinstance(params.trees, GemmEnsemble)):
+            def _pred(p, x):
+                if pallas_block_bytes(p.trees) <= budget:
+                    return jax.nn.sigmoid(
+                        p.base_score + pallas_leaf_sum(to_pallas(p.trees), x))
+                return xla_predict(p, x)
+            self._predict = _pred
 
     def _init_sequence(self, cfg, params, scaler, feature_state,
                        feature_cache):

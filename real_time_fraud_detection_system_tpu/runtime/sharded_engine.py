@@ -58,7 +58,6 @@ from real_time_fraud_detection_system_tpu.runtime.engine import (
     BatchResult,
     ScoringEngine,
     loss_fn_for,
-    predict_fn_for,
 )
 
 
@@ -181,9 +180,12 @@ class ShardedScoringEngine(ScoringEngine):
         self.state.feature_state = shard_feature_state(
             self.state.feature_state, self.mesh, axis=self.axis,
         )
+        # self._predict, not a fresh predict_fn_for(kind): the base
+        # constructor may have swapped in the fused Pallas tree scorer
+        # (use_pallas) — the mesh engine must serve the same kernel.
         self._sharded_build = make_sharded_step(
             cfg,
-            predict_fn_for(kind),
+            self._predict,
             loss_fn=loss_fn_for(kind),
             online_lr=online_lr,
             mesh=self.mesh,
@@ -193,7 +195,7 @@ class ShardedScoringEngine(ScoringEngine):
         # compiled lazily on the first hot-key overflow.
         self._sharded_build_routed = make_sharded_step(
             cfg,
-            predict_fn_for(kind),
+            self._predict,
             loss_fn=loss_fn_for(kind),
             online_lr=online_lr,
             mesh=self.mesh,

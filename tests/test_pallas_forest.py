@@ -1,0 +1,287 @@
+"""Fused forest kernel parity vs the XLA GEMM composition (interpret mode).
+
+``pallas_leaf_sum`` must agree with ``gemm_leaf_sum`` to f32 accumulation
+order (both are decision-exact vs sklearn); the fuzz cases hit the padding
+paths (non-×128 node counts, non-×TREE_BLOCK tree counts, non-×block_rows
+batches) and the threshold-equality decision edge.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from real_time_fraud_detection_system_tpu.models.forest import (
+    ensemble_from_sklearn,
+    gemm_leaf_sum,
+    gemm_predict_proba,
+    to_gemm,
+)
+from real_time_fraud_detection_system_tpu.ops.pallas_forest import (
+    TREE_BLOCK,
+    pallas_leaf_sum,
+    pallas_predict_proba,
+    pallas_table_bytes,
+    to_pallas,
+)
+
+N_FEAT = 15
+
+
+def _fit(rng, n_trees=7, max_depth=5, n=600):
+    from sklearn.ensemble import RandomForestClassifier
+
+    x = rng.normal(size=(n, N_FEAT)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 3] + rng.normal(scale=0.3, size=n) > 0.4)
+    clf = RandomForestClassifier(
+        n_estimators=n_trees, max_depth=max_depth, random_state=0, n_jobs=1
+    )
+    clf.fit(x, y.astype(np.int32))
+    ens = ensemble_from_sklearn(clf, N_FEAT)
+    return clf, ens, x
+
+
+@pytest.mark.parametrize("n_trees,max_depth", [(7, 5), (TREE_BLOCK, 3), (13, 6)])
+def test_pallas_matches_gemm(n_trees, max_depth):
+    rng = np.random.default_rng(3)
+    clf, ens, x = _fit(rng, n_trees=n_trees, max_depth=max_depth)
+    g = to_gemm(ens, N_FEAT)
+    pf = to_pallas(g)
+
+    xq = rng.normal(size=(300, N_FEAT)).astype(np.float32)  # non-×block rows
+    want = np.asarray(gemm_leaf_sum(g, jnp.asarray(xq), z_mode="f32"))
+    got = np.asarray(pallas_leaf_sum(pf, jnp.asarray(xq), block_rows=128))
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+    # and the bagged probability agrees with sklearn exactly in decisions
+    p_skl = clf.predict_proba(xq)[:, 1]
+    p_pal = np.asarray(pallas_predict_proba(pf, jnp.asarray(xq),
+                                            block_rows=128))
+    np.testing.assert_allclose(p_pal, p_skl, atol=1e-6)
+
+
+def test_threshold_edge_inputs():
+    """Inputs placed EXACTLY on thresholds: decisions must not flip."""
+    rng = np.random.default_rng(5)
+    clf, ens, _ = _fit(rng, n_trees=5, max_depth=4)
+    g = to_gemm(ens, N_FEAT)
+    pf = to_pallas(g)
+
+    th = np.asarray(ens.thresh).ravel()
+    th = th[np.isfinite(th) & (th != 0)]
+    k = min(len(th), 64)
+    xq = np.tile(th[:k, None], (1, N_FEAT)).astype(np.float32)
+    p_skl = clf.predict_proba(xq)[:, 1]
+    p_pal = np.asarray(pallas_predict_proba(pf, jnp.asarray(xq),
+                                            block_rows=64))
+    np.testing.assert_allclose(p_pal, p_skl, atol=1e-6)
+
+
+def test_gbt_leaf_sum_path():
+    """The kernel's leaf SUM also serves boosting (base logit added on top)."""
+    rng = np.random.default_rng(11)
+    _, ens, x = _fit(rng, n_trees=6, max_depth=4)
+    g = to_gemm(ens, N_FEAT)
+    pf = to_pallas(g)
+    want = np.asarray(gemm_leaf_sum(g, jnp.asarray(x[:200]), z_mode="f32"))
+    got = np.asarray(pallas_leaf_sum(pf, jnp.asarray(x[:200])))
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_padding_is_inert():
+    """Padded trees/nodes/rows contribute exactly zero."""
+    rng = np.random.default_rng(7)
+    _, ens, _ = _fit(rng, n_trees=3, max_depth=3)  # tiny: heavy padding
+    g = to_gemm(ens, N_FEAT)
+    pf = to_pallas(g)
+    assert pf.sel.shape[0] == TREE_BLOCK  # 3 → padded to one tree block
+    assert int(pf.n_trees) == 3
+    xq = rng.normal(size=(9, N_FEAT)).astype(np.float32)  # 9 → padded rows
+    want = np.asarray(gemm_predict_proba(g, jnp.asarray(xq), z_mode="f32"))
+    got = np.asarray(pallas_predict_proba(pf, jnp.asarray(xq)))
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_engine_forest_pallas_path_matches(small_dataset):
+    """ScoringEngine with use_pallas=True swaps the forest predict for the
+    fused kernel; served probabilities must match the XLA GEMM engine."""
+    import dataclasses
+
+    from real_time_fraud_detection_system_tpu.config import small_config
+    from real_time_fraud_detection_system_tpu.models.forest import fit_forest
+    from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+    from real_time_fraud_detection_system_tpu.runtime import (
+        ReplaySource,
+        ScoringEngine,
+    )
+
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(500, N_FEAT)).astype(np.float32)
+    y = (x[:, 0] > 0.3).astype(np.int32)
+    ens = fit_forest(x, y, n_trees=5, max_depth=4)
+    scaler = Scaler(mean=jnp.zeros(N_FEAT), scale=jnp.ones(N_FEAT))
+
+    _, _, _, txs = small_dataset
+    cfg = small_config()
+    cfg_p = dataclasses.replace(
+        cfg, runtime=dataclasses.replace(cfg.runtime, use_pallas=True)
+    )
+    outs = []
+    for c in (cfg, cfg_p):
+        eng = ScoringEngine(c, kind="forest", params=ens, scaler=scaler)
+        src = ReplaySource(txs.slice(slice(0, 300)), 1_743_465_600,
+                           batch_rows=128)
+        probs = []
+        while True:
+            cols = src.poll_batch()
+            if cols is None:
+                break
+            probs.append(eng.process_batch(cols).probs)
+        outs.append(np.concatenate(probs))
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-5, atol=1e-6)
+
+
+def test_engine_gbt_pallas_path_matches(small_dataset):
+    """kind='gbt' with use_pallas=True: sigmoid(base + fused leaf sum) must
+    match the XLA gbt engine (pins the base_score handling and the
+    GBTModel gate actually matching)."""
+    import dataclasses
+
+    from real_time_fraud_detection_system_tpu.config import small_config
+    from real_time_fraud_detection_system_tpu.models.gbt import train_gbt
+    from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+    from real_time_fraud_detection_system_tpu.runtime import (
+        ReplaySource,
+        ScoringEngine,
+    )
+
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(500, N_FEAT)).astype(np.float32)
+    y = (x[:, 2] > 0.1).astype(np.int32)
+    model = train_gbt(x, y, n_trees=6, max_depth=3)
+    scaler = Scaler(mean=jnp.zeros(N_FEAT), scale=jnp.ones(N_FEAT))
+
+    _, _, _, txs = small_dataset
+    cfg = small_config()
+    cfg_p = dataclasses.replace(
+        cfg, runtime=dataclasses.replace(cfg.runtime, use_pallas=True))
+    outs = []
+    for c in (cfg, cfg_p):
+        eng = ScoringEngine(c, kind="gbt", params=model, scaler=scaler)
+        src = ReplaySource(txs.slice(slice(0, 300)), 1_743_465_600,
+                           batch_rows=128)
+        probs = []
+        while True:
+            cols = src.poll_batch()
+            if cols is None:
+                break
+            probs.append(eng.process_batch(cols).probs)
+        outs.append(np.concatenate(probs))
+    assert outs[0].std() > 0  # non-degenerate scores
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-5, atol=1e-6)
+
+
+def test_pallas_path_serves_restored_params(small_dataset):
+    """The kernel tables are derived from LIVE params inside the step: after
+    a checkpoint restore overwrites ``state.params`` in place (the
+    ``io/checkpoint.py`` contract), served scores must come from the
+    restored trees, not a stale build-time copy."""
+    import dataclasses
+
+    from real_time_fraud_detection_system_tpu.config import small_config
+    from real_time_fraud_detection_system_tpu.models.forest import (
+        fit_forest, for_device,
+    )
+    from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+    from real_time_fraud_detection_system_tpu.runtime import (
+        ReplaySource,
+        ScoringEngine,
+    )
+
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(400, N_FEAT)).astype(np.float32)
+    y = (x[:, 1] > 0.0).astype(np.int32)
+    ens = fit_forest(x, y, n_trees=4, max_depth=3)
+    g1 = for_device(ens, N_FEAT)
+    # same structure, different leaf values — a shape-compatible "refit"
+    g2 = g1._replace(leaf_val=jnp.asarray(
+        np.asarray(g1.leaf_val)[:, ::-1].copy()))
+    scaler = Scaler(mean=jnp.zeros(N_FEAT), scale=jnp.ones(N_FEAT))
+
+    _, _, _, txs = small_dataset
+    cfg = small_config()
+    cfg = dataclasses.replace(
+        cfg, runtime=dataclasses.replace(cfg.runtime, use_pallas=True))
+
+    def run(engine):
+        src = ReplaySource(txs.slice(slice(0, 200)), 1_743_465_600,
+                           batch_rows=128)
+        out = []
+        while True:
+            cols = src.poll_batch()
+            if cols is None:
+                break
+            out.append(engine.process_batch(cols).probs)
+        return np.concatenate(out)
+
+    fresh_g2 = run(ScoringEngine(cfg, "forest", params=g2, scaler=scaler))
+    eng = ScoringEngine(cfg, "forest", params=g1, scaler=scaler)
+    eng.state.params = g2  # what Checkpointer.restore does, in place
+    np.testing.assert_allclose(run(eng), fresh_g2, rtol=1e-5, atol=1e-6)
+
+
+def test_sharded_engine_serves_pallas_kernel(small_dataset):
+    """use_pallas=True must reach the mesh engine's per-shard step (the
+    sharded build consumes the base class's swapped predict), matching the
+    single-chip pallas engine exactly."""
+    import dataclasses
+
+    from real_time_fraud_detection_system_tpu.config import small_config
+    from real_time_fraud_detection_system_tpu.models.forest import fit_forest
+    from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+    from real_time_fraud_detection_system_tpu.runtime import (
+        ReplaySource,
+        ScoringEngine,
+    )
+    from real_time_fraud_detection_system_tpu.runtime.sharded_engine import (
+        ShardedScoringEngine,
+    )
+
+    rng = np.random.default_rng(8)
+    x = rng.normal(size=(400, N_FEAT)).astype(np.float32)
+    y = (x[:, 0] > 0.2).astype(np.int32)
+    ens = fit_forest(x, y, n_trees=4, max_depth=3)
+    scaler = Scaler(mean=jnp.zeros(N_FEAT), scale=jnp.ones(N_FEAT))
+
+    _, _, _, txs = small_dataset
+    cfg = small_config()
+    cfg = dataclasses.replace(
+        cfg, runtime=dataclasses.replace(cfg.runtime, use_pallas=True))
+
+    def run(engine):
+        src = ReplaySource(txs.slice(slice(0, 256)), 1_743_465_600,
+                           batch_rows=128)
+        out = []
+        while True:
+            cols = src.poll_batch()
+            if cols is None:
+                break
+            out.append(engine.process_batch(cols).probs)
+        return np.concatenate(out)
+
+    single = run(ScoringEngine(cfg, "forest", params=ens, scaler=scaler))
+    sharded = run(ShardedScoringEngine(cfg, kind="forest", params=ens,
+                                       scaler=scaler, n_devices=2))
+    np.testing.assert_allclose(sharded, single, rtol=1e-5, atol=1e-6)
+
+
+def test_table_bytes_gate():
+    rng = np.random.default_rng(9)
+    _, ens, _ = _fit(rng, n_trees=4, max_depth=4)
+    g = to_gemm(ens, N_FEAT)
+    nbytes = pallas_table_bytes(g)
+    assert nbytes > 0
+    # one padded tree block of depth-4 trees: sel + path dominate
+    got = sum(int(np.asarray(a).nbytes) for a in
+              (to_pallas(g).sel, to_pallas(g).path, to_pallas(g).thresh,
+               to_pallas(g).target, to_pallas(g).leaf_val))
+    assert nbytes == got
