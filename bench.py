@@ -560,6 +560,19 @@ def _child_main(args) -> None:
                 ),
             }
 
+    def _timed_rows_per_s(run_once, rows: int, seconds: float) -> float:
+        """Chunked-dispatch timing shared by the kernel-comparison blocks:
+        ``run_once()`` returns the value to sync on; the caller has already
+        made one warmed call (compile excluded from the clock)."""
+        t0 = time.perf_counter()
+        iters = 0
+        while time.perf_counter() - t0 < seconds:
+            for _ in range(4):
+                out = run_once()
+            jax.block_until_ready(out)
+            iters += 4
+        return round(iters * rows / (time.perf_counter() - t0), 1)
+
     # ---- fused Pallas featurize+score vs plain-jnp composition ---------
     # The linear-scorer kernel (ops/pallas_kernels.py). On CPU it only
     # interprets (slow, exact) — measured on TPU only. Answers VERDICT r3
@@ -600,20 +613,84 @@ def _child_main(args) -> None:
                 fs, pr = jfn(fs, pbatch)
                 jax.block_until_ready(pr)
                 outs[name] = np.asarray(pr)
-                t0 = time.perf_counter()
-                iters = 0
-                while time.perf_counter() - t0 < min(args.seconds, 3.0):
-                    for _ in range(4):
-                        fs, pr = jfn(fs, pbatch)
-                    jax.block_until_ready(pr)
-                    iters += 4
-                wall = time.perf_counter() - t0
-                pallas_stats[f"{name}_rows_per_s"] = round(
-                    iters * pl_rows / wall, 1)
+
+                def once(jfn=jfn):
+                    nonlocal fs
+                    fs, pr = jfn(fs, pbatch)
+                    return pr
+
+                pallas_stats[f"{name}_rows_per_s"] = _timed_rows_per_s(
+                    once, pl_rows, min(args.seconds, 3.0))
             pallas_stats["max_abs_delta"] = float(
                 np.abs(outs["fused"] - outs["unfused"]).max())
         except Exception as e:
             pallas_stats = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+
+    # ---- fused Pallas forest kernel vs XLA's GEMM fusion ---------------
+    # The flagship classify chain (ops/pallas_forest.py): does a hand-tiled
+    # VMEM-resident kernel beat XLA's automatic fusion of the three-GEMM
+    # composition? Measured classify-only so the halves are isolated from
+    # the featurize cost. (Round-4 measurement: XLA wins — its fusion of
+    # this chain is already intermediate-free; the kernel stays an opt-in
+    # proof of hand-fusibility, not the default.)
+    pallas_forest_stats = None
+    if args.model == "forest" and full and not on_cpu:
+        _progress("pallas forest kernel vs xla gemm")
+        try:
+            from real_time_fraud_detection_system_tpu.models.forest import (
+                gemm_predict_proba,
+            )
+            from real_time_fraud_detection_system_tpu.ops.pallas_forest import (
+                pallas_predict_proba,
+                to_pallas,
+            )
+
+            pfr = 262_144
+            xq = jnp.asarray(
+                rng.normal(0, 1, (pfr, 15)).astype(np.float32))
+            pf = to_pallas(params)
+            fns = {
+                "xla_gemm": jax.jit(lambda x: gemm_predict_proba(params, x)),
+                "pallas_kernel": jax.jit(
+                    lambda x: pallas_predict_proba(pf, x, block_rows=2048,
+                                                   interpret=False)),
+            }
+            pallas_forest_stats = {"rows": pfr}
+            pouts = {}
+            for name, fn in fns.items():
+                pr = fn(xq)
+                jax.block_until_ready(pr)
+                pouts[name] = np.asarray(pr)
+                pallas_forest_stats[f"{name}_rows_per_s"] = \
+                    _timed_rows_per_s(lambda fn=fn: fn(xq), pfr,
+                                      min(args.seconds, 3.0))
+            pallas_forest_stats["max_abs_delta"] = float(
+                np.abs(pouts["xla_gemm"] - pouts["pallas_kernel"]).max())
+
+            # hot-path split: featurize-only throughput at the same size,
+            # so headline = harmonic composition of the two halves is on
+            # record (classify-only is the xla_gemm row above)
+            def _feat_only(fstate, batch):
+                fstate, feats = update_and_featurize(fstate, batch, fcfg)
+                return fstate, feats.sum()
+
+            jfeat = jax.jit(_feat_only, donate_argnums=(0,))
+            fbatch = jax.tree.map(
+                jnp.asarray, make_batch(**_make_batch_cols(rng, pfr)))
+            fs = init_feature_state(fcfg)
+            fs, s = jfeat(fs, fbatch)
+            jax.block_until_ready(s)
+
+            def _feat_once():
+                nonlocal fs
+                fs, s = jfeat(fs, fbatch)
+                return s
+
+            pallas_forest_stats["featurize_only_rows_per_s"] = \
+                _timed_rows_per_s(_feat_once, pfr, min(args.seconds, 3.0))
+        except Exception as e:
+            pallas_forest_stats = {
+                "error": f"{type(e).__name__}: {str(e)[:160]}"}
 
     # ---- training throughput on the device -----------------------------
     # The reference records per-classifier training_execution_time hooks
@@ -815,6 +892,8 @@ def _child_main(args) -> None:
         detail["train"] = train_stats
     if pallas_stats is not None:
         detail["pallas_fused"] = pallas_stats
+    if pallas_forest_stats is not None:
+        detail["pallas_forest"] = pallas_forest_stats
     if seq_stats is not None:
         detail["sequence_scorer"] = seq_stats
     if cpu_tps is not None:

@@ -90,12 +90,16 @@ def _update_state(
     if cfg.customer_source == "cms":
         customer = state.customer  # unused in cms mode: skip the scatter
     else:
+        # track_fraud=False: no feature reads customer fraud sums (spec is
+        # count+avg for customers) — one fewer 1M-update scatter (~7 ms).
         customer = update_windows(
             state.customer, cust_slot, batch.day, batch.amount, fraud,
-            batch.valid,
+            batch.valid, track_fraud=False,
         )
+    # track_amount=False symmetrically: terminal features are count+risk.
     terminal = update_windows(
-        state.terminal, term_slot, batch.day, batch.amount, fraud, batch.valid
+        state.terminal, term_slot, batch.day, batch.amount, fraud,
+        batch.valid, track_amount=False,
     )
     cms = state.cms
     if cms is not None:

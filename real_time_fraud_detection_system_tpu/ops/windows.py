@@ -65,6 +65,8 @@ def update_windows(
     amount: jnp.ndarray,  # float32 [B]
     fraud: jnp.ndarray,  # float32 [B] — 0/1, or 0 when label unknown
     valid: jnp.ndarray,  # bool [B]
+    track_amount: bool = True,
+    track_fraud: bool = True,
 ) -> WindowState:
     """Scatter one micro-batch into the ring buffers.
 
@@ -73,6 +75,17 @@ def update_windows(
     (bounded-lateness policy — the ring holds n_buckets days of history).
     Duplicate (slot, day) rows within the batch accumulate correctly
     (jnp scatter-add applies all duplicates).
+
+    ``track_amount`` / ``track_fraud``: scatters are the hot path's most
+    expensive op on TPU (~7 ms per 1M updates, serialized emitter;
+    reformulations — segment_sum, sorted/unique hints, one wide scatter —
+    all measured equal or worse). A table whose consumer never reads a
+    column may skip its scatter: the 15-feature spec reads customer
+    (count, amount) and terminal (count, fraud) only, so the engine drops
+    one scatter per keyspace (§``features/online._update_state``). A
+    skipped column still gets the (cheap, full-table) stale-bucket reset,
+    so its buckets never mix days: it simply misses this batch's
+    contributions — safe even if a later update re-enables tracking.
     """
     nb = state.n_buckets
     cap = state.capacity
@@ -88,21 +101,26 @@ def update_windows(
     # Buckets whose stamp advanced hold a stale (older) day: reset aggregates.
     advanced = new_bd > bd
     count = jnp.where(advanced, 0.0, state.count.reshape(-1))
-    amt = jnp.where(advanced, 0.0, state.amount.reshape(-1))
-    frd = jnp.where(advanced, 0.0, state.fraud.reshape(-1))
 
     # A row contributes only if its day is the bucket's (possibly new) stamp.
     fresh = valid & (day_in == new_bd[flat])
     w = fresh.astype(jnp.float32)
     count = count.at[flat].add(w)
-    amt = amt.at[flat].add(amount * w)
-    frd = frd.at[flat].add(fraud * w)
+
+    amt = jnp.where(advanced, 0.0, state.amount.reshape(-1))
+    if track_amount:
+        amt = amt.at[flat].add(amount * w)
+    frd = jnp.where(advanced, 0.0, state.fraud.reshape(-1))
+    if track_fraud:
+        frd = frd.at[flat].add(fraud * w)
+    amt = amt.reshape(cap, nb)
+    frd = frd.reshape(cap, nb)
 
     return WindowState(
         bucket_day=new_bd.reshape(cap, nb),
         count=count.reshape(cap, nb),
-        amount=amt.reshape(cap, nb),
-        fraud=frd.reshape(cap, nb),
+        amount=amt,
+        fraud=frd,
     )
 
 

@@ -285,7 +285,8 @@ def make_sharded_step(
             c_slot = ((c_key // jnp.uint32(n_dev))
                       & jnp.uint32(c_cap_local - 1)).astype(jnp.int32)
             customer = update_windows(
-                fstate.customer, c_slot, c_day, c_amt, c_fraud, c_valid
+                fstate.customer, c_slot, c_day, c_amt, c_fraud, c_valid,
+                track_fraud=False,  # customer features are count+avg only
             )
             cc, ca, _ = query_windows(customer, c_slot, c_day, windows)
         if route_customers:
@@ -301,7 +302,8 @@ def make_sharded_step(
         t_slot = ((r_key // jnp.uint32(n_dev))
                   & jnp.uint32(t_cap_local - 1)).astype(jnp.int32)
         terminal = update_windows(
-            fstate.terminal, t_slot, r_day, r_amount, r_fraud, r_valid
+            fstate.terminal, t_slot, r_day, r_amount, r_fraud, r_valid,
+            track_amount=False,  # terminal features are count+risk only
         )
         t_count, _, t_fraud = query_windows(
             terminal, t_slot, r_day, windows, delay=fcfg.delay_days

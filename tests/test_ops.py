@@ -99,6 +99,33 @@ def test_windows_ring_eviction():
     assert int(c[0, 0]) == 0
 
 
+def test_windows_untracked_column_never_mixes_days():
+    """track_amount=False still applies the stale-bucket reset: a later
+    tracked update onto the advanced bucket must see a clean base, not the
+    previous day's sum (mixed-flag safety)."""
+    nb = 8
+    state = init_window_state(16, nb)
+    one = jnp.ones(1, jnp.float32)
+    v = jnp.ones(1, bool)
+    s0 = jnp.zeros(1, jnp.int32)
+    d = lambda x: jnp.asarray([x], jnp.int32)
+    # day 100 tracked: amount sum 5.0
+    state = update_windows(state, s0, d(100), one * 5, one * 0, v)
+    # day 108 (same ring bucket) with tracking OFF: stamp advances, amount
+    # column must reset to 0 even though its scatter is skipped
+    state = update_windows(state, s0, d(108), one * 7, one * 0, v,
+                           track_amount=False)
+    _, a, _ = query_windows(state, s0, d(108), (1,))
+    assert float(a[0, 0]) == 0.0  # missing contribution, NOT stale 5.0
+    # tracking back ON same day: clean base, only the new value lands
+    state = update_windows(state, s0, d(108), one * 3, one * 0, v)
+    _, a, _ = query_windows(state, s0, d(108), (1,))
+    assert abs(float(a[0, 0]) - 3.0) < 1e-6
+    # count was tracked throughout: all three day-108 rows present
+    c, _, _ = query_windows(state, s0, d(108), (1,))
+    assert int(c[0, 0]) == 2
+
+
 def test_windows_invalid_rows_ignored():
     state = init_window_state(16, 8)
     s0 = jnp.zeros(4, jnp.int32)
