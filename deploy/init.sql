@@ -1,0 +1,34 @@
+-- OLTP source-of-truth schema for the containerized stack.
+-- Role of the reference's postgres/init.sql: the `payment` schema with
+-- customers / terminals / transactions and REPLICA IDENTITY FULL so
+-- Debezium emits full before/after row images (core/schema.py mirrors
+-- these shapes in-memory; money is DECIMAL(10,2) on the wire, int64
+-- cents in the engine).
+
+CREATE SCHEMA IF NOT EXISTS payment;
+
+CREATE TABLE IF NOT EXISTS payment.customers (
+    customer_id BIGINT PRIMARY KEY,
+    x_location  DOUBLE PRECISION,
+    y_location  DOUBLE PRECISION
+);
+
+CREATE TABLE IF NOT EXISTS payment.terminals (
+    terminal_id BIGINT PRIMARY KEY,
+    x_location  DOUBLE PRECISION,
+    y_location  DOUBLE PRECISION
+);
+
+CREATE TABLE IF NOT EXISTS payment.transactions (
+    tx_id       BIGINT PRIMARY KEY,
+    tx_datetime TIMESTAMP NOT NULL,
+    customer_id BIGINT REFERENCES payment.customers (customer_id),
+    terminal_id BIGINT REFERENCES payment.terminals (terminal_id),
+    tx_amount   DECIMAL(10, 2) NOT NULL
+);
+
+-- Full row images in the WAL: Debezium envelopes carry complete
+-- before/after states, which the engine's latest-wins dedup relies on.
+ALTER TABLE payment.customers    REPLICA IDENTITY FULL;
+ALTER TABLE payment.terminals    REPLICA IDENTITY FULL;
+ALTER TABLE payment.transactions REPLICA IDENTITY FULL;

@@ -252,7 +252,7 @@ def cmd_train(args) -> int:
 
 def cmd_score(args) -> int:
     from real_time_fraud_detection_system_tpu.config import Config
-    from real_time_fraud_detection_system_tpu.io import ParquetSink
+    from real_time_fraud_detection_system_tpu.io import make_parquet_sink
     from real_time_fraud_detection_system_tpu.io.artifacts import (
         load_model,
         load_transactions,
@@ -408,7 +408,7 @@ def cmd_score(args) -> int:
             with_labels=args.online_lr > 0,
         )
     ckpt = make_checkpointer(args.checkpoint_dir) if args.checkpoint_dir else None
-    sink = ParquetSink(args.out) if args.out else None
+    sink = make_parquet_sink(args.out) if args.out else None
     raw_table = None
     if args.raw_table:
         from real_time_fraud_detection_system_tpu.io import (
@@ -503,6 +503,13 @@ def cmd_demo(args) -> int:
     from real_time_fraud_detection_system_tpu.utils.logging import get_logger
 
     log = get_logger("demo")
+    if args.out.startswith("s3://"):
+        # run_demo also lands a local raw table + dashboard beside the
+        # analyzed parts; object-store output is the serving path's job.
+        log.error("rtfds demo writes a local output directory (analyzed "
+                  "parts + raw table + dashboard); for s3:// output use "
+                  "rtfds score --out s3://...")
+        return 2
     cfg = Config(
         data=DataConfig(
             n_customers=args.customers,
@@ -952,7 +959,10 @@ def main(argv=None) -> int:
                         "cluster's feedback topic between micro-batches "
                         "(online learning, BASELINE config 4)")
     p.add_argument("--feedback-topic", default="payment.feedback")
-    p.add_argument("--out", default="")
+    p.add_argument("--out", default="",
+                   help="analyzed output: local directory (ParquetSink) "
+                        "or s3://bucket/prefix (StoreParquetSink; "
+                        "RTFDS_S3_ENDPOINT targets MinIO)")
     p.add_argument("--raw-table", default="",
                    help="also land raw transactions in a day-partitioned "
                         "parquet table at this directory (the reference's "

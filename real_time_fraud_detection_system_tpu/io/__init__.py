@@ -3,7 +3,9 @@ from real_time_fraud_detection_system_tpu.io.sink import (  # noqa: F401
     IcebergSink,
     MemorySink,
     ParquetSink,
+    StoreParquetSink,
     make_iceberg_sink,
+    make_parquet_sink,
 )
 from real_time_fraud_detection_system_tpu.io.checkpoint import (  # noqa: F401
     Checkpointer,

@@ -178,9 +178,18 @@ class S3Store:
 
 
 def make_store(url: str, **kwargs):
-    """``s3://bucket[/prefix]`` → :class:`S3Store`; else :class:`LocalStore`."""
+    """``s3://bucket[/prefix]`` → :class:`S3Store`; else :class:`LocalStore`.
+
+    ``RTFDS_S3_ENDPOINT`` (when set and no explicit ``endpoint_url`` /
+    ``client`` is given) points the S3 client at MinIO — the reference's
+    object store (``docker-compose.yml`` minio service) — uniformly for
+    sinks, checkpoints, and artifacts.
+    """
     if url.startswith("s3://"):
         rest = url[len("s3://"):]
         bucket, _, prefix = rest.partition("/")
+        if ("endpoint_url" not in kwargs and "client" not in kwargs
+                and os.environ.get("RTFDS_S3_ENDPOINT")):
+            kwargs["endpoint_url"] = os.environ["RTFDS_S3_ENDPOINT"]
         return S3Store(bucket, prefix=prefix, **kwargs)
     return LocalStore(url)
