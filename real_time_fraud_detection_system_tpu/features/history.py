@@ -47,7 +47,7 @@ from real_time_fraud_detection_system_tpu.core.batch import TxBatch
 from real_time_fraud_detection_system_tpu.features.online import _slot
 from real_time_fraud_detection_system_tpu.models.sequence import (
     N_EVENT_FEATURES,
-    transformer_logits,
+    transformer_last_logit,
 )
 
 
@@ -233,10 +233,13 @@ def update_and_score(
     # Δt channel of position 0 at gather time.
     hist = hist.at[:, 0, 2].set(0.0)
 
-    logits = transformer_logits(
-        params, hist, attn_fn=_attn_fn_for(cfg, k))  # [B, K]
-    own = jnp.take_along_axis(
-        logits, (length - 1)[:, None], axis=1)[:, 0]
+    # Serving consumes only each row's own-event logit, so the last
+    # transformer block + head run single-query (models/sequence.py::
+    # transformer_last_logit) — exact vs the full [B, K] form, with the
+    # last block's score tensor [B, H, K] instead of [B, H, K, K]
+    # (measured ~time-neutral on v5e; the win is serving memory at long K).
+    own = transformer_last_logit(
+        params, hist, length - 1, attn_fn=_attn_fn_for(cfg, k))
     probs = jnp.where(s_valid, jax.nn.sigmoid(own), 0.0)
 
     # --- back to the batch's original row order

@@ -238,6 +238,19 @@ def naive_attn(q, k, v, causal=True):
 AttnFn = Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]
 
 
+def _block_forward(h, blk, attn, red, ent):
+    """One transformer block at full width — shared by the per-position
+    form (training/TP) and the prefix layers of the serving form."""
+    hn = ent(_ln(h, blk.ln1_g, blk.ln1_b))
+    q = jnp.einsum("btd,dhe->bthe", hn, blk.wq)
+    k = jnp.einsum("btd,dhe->bthe", hn, blk.wk)
+    v = jnp.einsum("btd,dhe->bthe", hn, blk.wv)
+    o = attn(q, k, v)
+    h = h + red(jnp.einsum("bthe,hed->btd", o, blk.wo))
+    hn = ent(_ln(h, blk.ln2_g, blk.ln2_b))
+    return h + red(jax.nn.gelu(hn @ blk.w1 + blk.b1) @ blk.w2) + blk.b2
+
+
 def transformer_logits(
     params: TransformerParams,
     x: jnp.ndarray,  # [B, T, N_EVENT_FEATURES]
@@ -263,16 +276,62 @@ def transformer_logits(
     # channels (translation-invariant histories), not absolute embeddings.
     h = x @ params.embed_w + params.embed_b
     for blk in params.blocks:
-        hn = ent(_ln(h, blk.ln1_g, blk.ln1_b))
-        q = jnp.einsum("btd,dhe->bthe", hn, blk.wq)
-        k = jnp.einsum("btd,dhe->bthe", hn, blk.wk)
-        v = jnp.einsum("btd,dhe->bthe", hn, blk.wv)
-        o = attn(q, k, v)
-        h = h + red(jnp.einsum("bthe,hed->btd", o, blk.wo))
-        hn = ent(_ln(h, blk.ln2_g, blk.ln2_b))
-        h = h + red(jax.nn.gelu(hn @ blk.w1 + blk.b1) @ blk.w2) + blk.b2
+        h = _block_forward(h, blk, attn, red, ent)
     h = _ln(h, params.lnf_g, params.lnf_b)
     return (h @ params.head_w + params.head_b)[..., 0]
+
+
+def transformer_last_logit(
+    params: TransformerParams,
+    x: jnp.ndarray,  # [B, T, N_EVENT_FEATURES]
+    qpos: jnp.ndarray,  # int32 [B] — the one position each row is scored at
+    attn_fn: Optional[AttnFn] = None,
+) -> jnp.ndarray:
+    """Serving form: the fraud logit at ONE position per row ([B]).
+
+    Exactly ``transformer_logits(params, x, attn_fn)[b, qpos[b]]`` — but
+    the LAST block, final layernorm, and head run on the single query
+    position only; layers before the last still run at every position
+    (their outputs are the last block's keys/values). The last block's
+    score tensor shrinks from [B, H, K, K] to [B, H, K] — the serving
+    memory win at long K (the engine consumes only each row's own-event
+    logit, ``features/history.py::update_and_score``). Wall-clock it
+    measured ~neutral on v5e (0.97–1.05×): the defaults' d_model=32
+    leaves the serving transformer bound by its full-width small-lane
+    elementwise/projection chain, not by attention scores — the next
+    real levers are a per-customer KV cache (O(K·d·L) per event) and a
+    lane-friendly d_model. The single-query attention masks keys to
+    ``j <= qpos`` — the same causal row the full form computes.
+    """
+    attn = attn_fn or (lambda q, k, v: naive_attn(q, k, v, causal=True))
+    ident = lambda t: t  # noqa: E731
+    h = x @ params.embed_w + params.embed_b
+    for blk in params.blocks[:-1]:
+        h = _block_forward(h, blk, attn, ident, ident)
+
+    blk = params.blocks[-1]
+    t = h.shape[1]
+    dh = blk.wq.shape[-1]
+    hn = _ln(h, blk.ln1_g, blk.ln1_b)
+    sel = qpos[:, None, None]  # [B,1,1] take_along_axis index
+    hq = jnp.take_along_axis(h, sel, axis=1)  # [B,1,D]
+    hnq = jnp.take_along_axis(hn, sel, axis=1)
+    q = jnp.einsum("bod,dhe->bohe", hnq, blk.wq)  # [B,1,H,dh]
+    k = jnp.einsum("btd,dhe->bthe", hn, blk.wk)
+    v = jnp.einsum("btd,dhe->bthe", hn, blk.wv)
+    s = jnp.einsum("bohe,bkhe->bhok", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / math.sqrt(dh)  # [B,H,1,K]
+    mask = (jnp.arange(t, dtype=jnp.int32)[None, :]
+            <= qpos[:, None])[:, None, None, :]
+    s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhok,bkhe->bohe", p,
+                   v.astype(jnp.float32)).astype(h.dtype)
+    hq = hq + jnp.einsum("bohe,hed->bod", o, blk.wo)
+    hn2 = _ln(hq, blk.ln2_g, blk.ln2_b)
+    hq = hq + jax.nn.gelu(hn2 @ blk.w1 + blk.b1) @ blk.w2 + blk.b2
+    hf = _ln(hq, params.lnf_g, params.lnf_b)
+    return (hf @ params.head_w + params.head_b)[:, 0, 0]
 
 
 def transformer_loss(

@@ -94,3 +94,34 @@ def test_transformer_trains_with_blockwise_attention(small_dataset):
                                attn="blockwise", seed=3)
     idx, probs = sequence_scores(params, seqs)
     assert np.isfinite(probs).all() and probs.std() > 0
+
+
+def test_last_logit_matches_full_form():
+    """transformer_last_logit(qpos) ≡ transformer_logits[b, qpos[b]] —
+    the serving form must be exact (naive AND blockwise last-layer keys),
+    including ragged qpos and single-layer models."""
+    from real_time_fraud_detection_system_tpu.models.sequence import (
+        N_EVENT_FEATURES,
+        transformer_last_logit,
+        transformer_logits,
+    )
+    from real_time_fraud_detection_system_tpu.parallel.ring_attention import (
+        blockwise_attention,
+    )
+
+    rng = np.random.default_rng(9)
+    b, k = 24, 32
+    x = jnp.asarray(rng.normal(size=(b, k, N_EVENT_FEATURES))
+                    .astype(np.float32))
+    qpos = jnp.asarray(rng.integers(0, k, b).astype(np.int32))
+    for n_layers in (1, 2):
+        params = init_transformer(16, 2, n_layers, 32, seed=3)
+        for attn in (None,
+                     lambda q, kk, v: blockwise_attention(
+                         q, kk, v, block_size=16, causal=True)):
+            full = transformer_logits(params, x, attn_fn=attn)
+            want = np.asarray(jnp.take_along_axis(
+                full, qpos[:, None], axis=1)[:, 0])
+            got = np.asarray(transformer_last_logit(
+                params, x, qpos, attn_fn=attn))
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
