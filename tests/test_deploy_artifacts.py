@@ -1,0 +1,97 @@
+"""deploy/ artifacts stay consistent with the CLI and schema they invoke.
+
+No Docker here — these tests pin the *contracts*: the compose file's
+service commands parse against the real argparse tree, the quickstart
+comments reference real subcommands, and init.sql stays aligned with the
+live-seeding DDL (``io/pg.py``) so a stack booted from deploy/ accepts
+``rtfds datagen --pg-dsn``.
+"""
+
+import os
+import re
+import shlex
+
+import pytest
+
+yaml = pytest.importorskip("yaml")
+
+DEPLOY = os.path.join(os.path.dirname(__file__), "..", "deploy")
+
+
+def _compose():
+    with open(os.path.join(DEPLOY, "docker-compose.yml")) as f:
+        return yaml.safe_load(f)
+
+
+def test_compose_parses_and_has_reference_topology():
+    d = _compose()
+    assert {"postgres", "zookeeper", "kafka", "connect", "minio",
+            "createbuckets", "scorer"} <= set(d["services"])
+    # Debezium needs logical WAL on the source database
+    assert "wal_level=logical" in " ".join(d["services"]["postgres"]["command"])
+
+
+def test_scorer_command_flags_exist_in_cli():
+    """Every flag in the scorer service command must be a real rtfds
+    score option — catches CLI renames silently breaking the stack."""
+    import real_time_fraud_detection_system_tpu.cli as cli
+
+    d = _compose()
+    cmd = shlex.split(" ".join(str(d["services"]["scorer"]["command"]).split()))
+    assert cmd[0] == "rtfds" and cmd[1] == "score"
+    flags = [t for t in cmd[2:] if t.startswith("--")]
+
+    import argparse
+    import io
+    import contextlib
+
+    # Build the parser and pull score's registered option strings.
+    parser_help = io.StringIO()
+    with contextlib.suppress(SystemExit), \
+            contextlib.redirect_stdout(parser_help):
+        cli.main(["score", "--help"])
+    known = set(re.findall(r"--[\w-]+", parser_help.getvalue()))
+    for flag in flags:
+        assert flag in known, f"compose uses unknown score flag {flag}"
+
+
+def test_quickstart_comments_use_real_subcommands():
+    with open(os.path.join(DEPLOY, "docker-compose.yml")) as f:
+        text = f.read()
+    used = set(re.findall(r"rtfds (\w+)", text))
+    assert used <= {"datagen", "train", "score", "connectors"}, used
+
+
+def test_init_sql_matches_pg_live_ddl():
+    """deploy/init.sql and io/pg.py's ``ddl_statements`` must describe the
+    same tables AND columns (both are idempotent CREATE IF NOT EXISTS; a
+    stack may run either first, and the survivor must accept the other
+    path's inserts). Types may differ by Postgres alias (FLOAT ≡ DOUBLE
+    PRECISION); column sets may not."""
+    from real_time_fraud_detection_system_tpu.io.pg import ddl_statements
+
+    with open(os.path.join(DEPLOY, "init.sql")) as f:
+        sql = f.read().lower()
+    pg_sql = "\n".join(ddl_statements()).lower()
+
+    def columns_of(text, table):
+        m = re.search(
+            r"create table if not exists (?:payment\.)?"
+            + table + r"\s*\((.*?)\)\s*;?\s*(?:--|$|\n\s*(?:create|alter))",
+            text, re.S)
+        assert m, f"{table} DDL not found"
+        cols = []
+        for line in m.group(1).splitlines():
+            line = line.split("--")[0].strip().rstrip(",")
+            w = line.split()
+            if w and not w[0] in ("foreign", "primary", "constraint"):
+                cols.append(w[0])
+        return cols
+
+    for table in ("customers", "terminals", "transactions"):
+        assert f"create table if not exists payment.{table}" in sql
+        assert columns_of(sql, table) == columns_of(pg_sql, table), table
+    alters = re.findall(r"alter table\s+(\S+)\s+replica identity full", sql)
+    assert sorted(alters) == ["payment.customers", "payment.terminals",
+                              "payment.transactions"]
+    assert "decimal(10, 2)" in sql or "decimal(10,2)" in sql
