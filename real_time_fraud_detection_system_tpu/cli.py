@@ -632,6 +632,106 @@ def cmd_sql(args) -> int:
     return 0
 
 
+def cmd_import_model(args) -> int:
+    """Convert the reference's pickled artifacts into the npz model.
+
+    The reference ships ``trained_model.pkl`` (a fitted sklearn
+    classifier, uploaded to S3 by ``load_initial_data.py:269-287``) and
+    ``scaler.pkl`` (joblib StandardScaler, ``model_training.ipynb ·
+    cell 31``). This imports both into the framework's pickle-free npz
+    (``io/artifacts.py``) so existing reference artifacts serve on TPU
+    unchanged: RandomForest/DecisionTree → flat node tables, XGBClassifier
+    → GBT leaf-sum form (xgboost import-gated), LogisticRegression →
+    logreg weights. Unpickling EXECUTES code — import only artifacts you
+    trust (your own training output)."""
+    import pickle
+
+    import jax.numpy as jnp
+
+    from real_time_fraud_detection_system_tpu.features.spec import (
+        FEATURE_NAMES,
+    )
+    from real_time_fraud_detection_system_tpu.io.artifacts import save_model
+    from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+    from real_time_fraud_detection_system_tpu.models.train import TrainedModel
+    from real_time_fraud_detection_system_tpu.utils import get_logger
+
+    log = get_logger("import-model")
+    n_features = len(FEATURE_NAMES)
+    with open(args.model_pkl, "rb") as f:
+        clf = pickle.load(f)
+
+    # Fail loudly on shape/class mismatches: a 20-feature or multiclass
+    # model would otherwise import cleanly and serve silently-wrong
+    # probabilities (tree feature gathers clamp out-of-range indices).
+    n_in = getattr(clf, "n_features_in_", None)
+    if n_in is not None and int(n_in) != n_features:
+        log.error("model was fitted on %d features; the serving feature "
+                  "vector has %d (features/spec.py)", int(n_in), n_features)
+        return 2
+    classes = getattr(clf, "classes_", None)
+    if classes is not None and len(classes) != 2:
+        log.error("binary classifiers only: model has %d classes",
+                  len(classes))
+        return 2
+
+    if args.scaler_pkl:
+        import joblib  # ships with sklearn
+
+        sk_scaler = joblib.load(args.scaler_pkl)
+        if len(np.asarray(sk_scaler.mean_)) != n_features:
+            log.error("scaler was fitted on %d features; expected %d",
+                      len(np.asarray(sk_scaler.mean_)), n_features)
+            return 2
+        scaler = Scaler(
+            mean=jnp.asarray(sk_scaler.mean_, jnp.float32),
+            scale=jnp.asarray(sk_scaler.scale_, jnp.float32),
+        )
+    else:
+        # identity scaling (model trained on raw features)
+        scaler = Scaler(mean=jnp.zeros(n_features, jnp.float32),
+                        scale=jnp.ones(n_features, jnp.float32))
+
+    name = type(clf).__name__
+    if name in ("RandomForestClassifier", "ExtraTreesClassifier",
+                "DecisionTreeClassifier"):
+        from real_time_fraud_detection_system_tpu.models.forest import (
+            ensemble_from_sklearn,
+        )
+
+        kind = "tree" if name == "DecisionTreeClassifier" else "forest"
+        params = ensemble_from_sklearn(clf, n_features)
+    elif name == "XGBClassifier":
+        from real_time_fraud_detection_system_tpu.models.gbt import (
+            gbt_from_xgboost,
+        )
+
+        kind = "gbt"
+        params = gbt_from_xgboost(clf, n_features)
+    elif name == "LogisticRegression":
+        from real_time_fraud_detection_system_tpu.models.logreg import (
+            LogRegParams,
+        )
+
+        kind = "logreg"
+        params = LogRegParams(
+            w=jnp.asarray(clf.coef_[0], jnp.float32),
+            b=jnp.asarray(clf.intercept_[0], jnp.float32),
+        )
+    else:
+        log.error("unsupported classifier type %s (supported: "
+                  "RandomForest/ExtraTrees/DecisionTree/XGB/"
+                  "LogisticRegression)", name)
+        return 2
+
+    model = TrainedModel(kind=kind, scaler=scaler, params=params)
+    save_model(args.out_model, model)
+    log.info("imported %s (%s) -> %s", args.model_pkl, kind, args.out_model)
+    print(_json_line({"kind": kind, "out_model": args.out_model,
+                      "n_features": n_features}))
+    return 0
+
+
 def cmd_connectors(args) -> int:
     """Register the Debezium Postgres source connector with Kafka Connect.
 
@@ -1049,6 +1149,22 @@ def main(argv=None) -> int:
     p.add_argument("--limit", type=int, default=1000,
                    help="max rows printed (default 1000; 0 = unlimited)")
     p.set_defaults(fn=cmd_sql, needs_backend=False)
+
+    p = sub.add_parser(
+        "import-model",
+        help="convert the reference's pickled artifacts "
+             "(trained_model.pkl [+ scaler.pkl]) into the npz model "
+             "format — existing reference models serve on TPU unchanged",
+    )
+    p.add_argument("--model-pkl", required=True,
+                   help="pickled sklearn/xgboost classifier "
+                        "(the reference's trained_model.pkl; unpickling "
+                        "executes code — trusted artifacts only)")
+    p.add_argument("--scaler-pkl", default="",
+                   help="joblib StandardScaler (the reference's "
+                        "scaler.pkl); omit for identity scaling")
+    p.add_argument("--out-model", required=True)
+    p.set_defaults(fn=cmd_import_model, needs_backend=False)
 
     p = sub.add_parser(
         "connectors",

@@ -232,3 +232,101 @@ def _grow_tree(
 
     value_of_node = np.asarray([nd.value for nd in nodes])
     return nodes, value_of_node[node_of], depth_used
+
+
+def _trees_from_xgb_dump(dumps, n_features: int) -> TreeEnsemble:
+    """Compile xgboost JSON tree dumps into flat node tables.
+
+    Pure parser (no xgboost import), so it is unit-testable on images
+    without the dependency. xgboost routes LEFT ("yes") iff
+    ``x < split_condition`` (strict); the descent kernel tests
+    ``x <= thresh``, so each threshold becomes the largest f32 strictly
+    below the stored f32 condition — decisions stay bit-identical for
+    f32 inputs. Leaf values are the raw logit contributions (learning
+    rate pre-applied by xgboost). The ``missing`` branch is ignored:
+    engine features are never NaN.
+    """
+    import json as _json
+
+    parsed = [_json.loads(d) if isinstance(d, str) else d for d in dumps]
+
+    def walk(node, acc, d):
+        # derive depth structurally — the "depth" field is not present in
+        # every dump variant (leaves omit it)
+        acc.append((node, d))
+        for ch in node.get("children", ()):
+            walk(ch, acc, d + 1)
+        return acc
+
+    t = len(parsed)
+    all_nodes = [walk(p, [], 0) for p in parsed]
+    n = max(max(nd["nodeid"] for nd, _ in nodes) + 1 for nodes in all_nodes)
+    feat = np.zeros((t, n), dtype=np.int32)
+    thresh = np.zeros((t, n), dtype=np.float32)
+    left = np.zeros((t, n), dtype=np.int32)
+    right = np.zeros((t, n), dtype=np.int32)
+    prob = np.zeros((t, n), dtype=np.float32)
+    depth = 1
+    # default: every slot self-loops as a zero-valued leaf (unreferenced
+    # ids in a sparse dump stay inert)
+    idx = np.arange(n, dtype=np.int32)
+    left[:] = idx[None, :]
+    right[:] = idx[None, :]
+    for ti, nodes in enumerate(all_nodes):
+        for nd, d in nodes:
+            i = int(nd["nodeid"])
+            depth = max(depth, d)
+            if "leaf" in nd:
+                prob[ti, i] = np.float32(nd["leaf"])
+                continue
+            split = nd["split"]
+            if not (isinstance(split, str) and split.startswith("f")
+                    and split[1:].isdigit()):
+                raise ValueError(
+                    f"unsupported split name {split!r}: train on plain "
+                    "arrays so xgboost emits f<index> feature names")
+            fi = int(split[1:])
+            if fi >= n_features:
+                raise ValueError(
+                    f"split on feature {fi} >= n_features {n_features}")
+            feat[ti, i] = fi
+            # strict-< emulation under the kernel's <= test
+            thresh[ti, i] = np.nextafter(
+                np.float32(nd["split_condition"]), np.float32(-np.inf),
+                dtype=np.float32)
+            left[ti, i] = int(nd["yes"])
+            right[ti, i] = int(nd["no"])
+    return TreeEnsemble(
+        feat=jnp.asarray(feat),
+        thresh=jnp.asarray(thresh),
+        left=jnp.asarray(left),
+        right=jnp.asarray(right),
+        prob=jnp.asarray(prob),
+        max_depth=max(depth, 1),  # deepest leaf level = descent trip count
+    )
+
+
+def gbt_from_xgboost(model, n_features: int) -> GBTModel:
+    """Serve a fitted ``xgboost.XGBClassifier`` through the TPU GBT path.
+
+    The reference trains XGBoost as one of its 5 classifiers
+    (``model_training.ipynb · cell 50``); this imports the fitted model
+    into the same flat-table inference the first-party booster uses
+    (``gbt_predict_proba`` — leaf-sum + base logit + sigmoid), so a
+    reference user's existing model artifact serves unchanged. Binary
+    logistic objectives only.
+    """
+    booster = model.get_booster()
+    import json as _json
+
+    cfg = _json.loads(booster.save_config())
+    objective = (cfg.get("learner", {}).get("objective", {})
+                 .get("name", "binary:logistic"))
+    if not str(objective).startswith("binary:logistic"):
+        raise ValueError(
+            f"only binary:logistic models import cleanly, got {objective}")
+    p0 = float(cfg["learner"]["learner_model_param"]["base_score"])
+    base = float(np.log(p0 / (1.0 - p0))) if 0.0 < p0 < 1.0 else 0.0
+    trees = _trees_from_xgb_dump(
+        booster.get_dump(dump_format="json"), n_features)
+    return GBTModel(trees=trees, base_score=jnp.float32(base))

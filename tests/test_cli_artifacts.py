@@ -320,3 +320,122 @@ def test_score_alerts_only_flag(tmp_path):
             "--model-file", str(tmp_path / "m.npz"),
             "--alerts-only", "--scorer", "cpu")
     assert p.returncode == 2
+
+
+def test_import_model_from_reference_pickles(tmp_path):
+    """rtfds import-model: the reference's pickled trained_model.pkl +
+    scaler.pkl (sklearn RF + joblib StandardScaler,
+    load_initial_data.py:269-287 / model_training.ipynb · cell 31)
+    convert to the npz format and serve with identical probabilities."""
+    import pickle
+    import subprocess
+    import sys
+
+    import joblib
+    import numpy as np
+    from sklearn.ensemble import RandomForestClassifier
+    from sklearn.preprocessing import StandardScaler
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(400, 15))
+    y = (x[:, 0] + 0.3 * x[:, 4] > 0.5).astype(np.int32)
+    sc = StandardScaler().fit(x)
+    clf = RandomForestClassifier(n_estimators=8, max_depth=4,
+                                 random_state=0).fit(sc.transform(x), y)
+    pkl = tmp_path / "trained_model.pkl"
+    spkl = tmp_path / "scaler.pkl"
+    with open(pkl, "wb") as f:
+        pickle.dump(clf, f)
+    joblib.dump(sc, spkl)
+
+    out = tmp_path / "model.npz"
+    r = subprocess.run(
+        [sys.executable, "-m", "real_time_fraud_detection_system_tpu.cli",
+         "import-model", "--model-pkl", str(pkl),
+         "--scaler-pkl", str(spkl), "--out-model", str(out)],
+        capture_output=True, text=True, cwd="/root/repo",
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stderr
+    import json
+    assert json.loads(r.stdout.strip().splitlines()[-1])["kind"] == "forest"
+
+    from real_time_fraud_detection_system_tpu.io.artifacts import load_model
+
+    model = load_model(str(out))
+    xq = rng.normal(size=(128, 15)).astype(np.float32)
+    ours = model.predict_proba(xq.astype(np.float64))
+    want = clf.predict_proba(sc.transform(xq.astype(np.float64)))[:, 1]
+    np.testing.assert_allclose(ours, want, atol=1e-5)
+
+
+def test_import_model_logreg(tmp_path):
+    import pickle
+    import subprocess
+    import sys
+
+    import numpy as np
+    from sklearn.linear_model import LogisticRegression
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(300, 15))
+    y = (x[:, 1] > 0).astype(np.int32)
+    clf = LogisticRegression().fit(x, y)
+    pkl = tmp_path / "m.pkl"
+    with open(pkl, "wb") as f:
+        pickle.dump(clf, f)
+    out = tmp_path / "model.npz"
+    r = subprocess.run(
+        [sys.executable, "-m", "real_time_fraud_detection_system_tpu.cli",
+         "import-model", "--model-pkl", str(pkl), "--out-model", str(out)],
+        capture_output=True, text=True, cwd="/root/repo",
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stderr
+
+    from real_time_fraud_detection_system_tpu.io.artifacts import load_model
+
+    model = load_model(str(out))
+    xq = rng.normal(size=(64, 15))
+    np.testing.assert_allclose(
+        model.predict_proba(xq), clf.predict_proba(xq)[:, 1], atol=1e-5)
+
+
+def test_import_model_rejects_mismatched_artifacts(tmp_path):
+    """Feature-count and multiclass mismatches must fail loudly (rc 2):
+    tree gathers clamp out-of-range feature indices, so a silent import
+    would serve wrong probabilities."""
+    import pickle
+    import subprocess
+    import sys
+
+    import numpy as np
+    from sklearn.ensemble import RandomForestClassifier
+    from sklearn.linear_model import LogisticRegression
+
+    rng = np.random.default_rng(7)
+    env = {"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu"}
+
+    def run_import(clf):
+        pkl = tmp_path / "m.pkl"
+        with open(pkl, "wb") as f:
+            pickle.dump(clf, f)
+        return subprocess.run(
+            [sys.executable, "-m",
+             "real_time_fraud_detection_system_tpu.cli", "import-model",
+             "--model-pkl", str(pkl),
+             "--out-model", str(tmp_path / "out.npz")],
+            capture_output=True, text=True, cwd="/root/repo", env=env)
+
+    # 20-feature forest vs the 15-feature serving vector
+    x20 = rng.normal(size=(200, 20))
+    y = (x20[:, 0] > 0).astype(np.int32)
+    r = run_import(RandomForestClassifier(n_estimators=3, max_depth=3,
+                                          random_state=0).fit(x20, y))
+    assert r.returncode == 2 and "15" in r.stderr
+
+    # 3-class logreg
+    x = rng.normal(size=(300, 15))
+    y3 = rng.integers(0, 3, 300)
+    r = run_import(LogisticRegression(max_iter=200).fit(x, y3))
+    assert r.returncode == 2 and "classes" in r.stderr

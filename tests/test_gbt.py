@@ -96,3 +96,70 @@ def test_gbt_matches_xgboost_parity(xy):
 
     # Same algorithm family, same capacity: AUCs agree within noise.
     assert abs(ours - xgb_auc) < 0.02
+
+
+def test_trees_from_xgb_dump_synthetic():
+    """The dump parser on a hand-built xgboost-format JSON: strict-<
+    routing (a value EXACTLY on the threshold goes right), nested
+    children, leaf logits, and the descent trip count."""
+    import json
+
+    from real_time_fraud_detection_system_tpu.models.gbt import (
+        GBTModel,
+        _trees_from_xgb_dump,
+        gbt_predict_proba,
+    )
+
+    tree0 = {
+        "nodeid": 0, "split": "f1", "split_condition": 2.0,
+        "yes": 1, "no": 2, "missing": 1,
+        "children": [
+            {"nodeid": 1, "leaf": -0.4},
+            {"nodeid": 2, "split": "f0", "split_condition": -1.0,
+             "yes": 3, "no": 4, "missing": 3,
+             "children": [
+                 {"nodeid": 3, "leaf": 0.1},
+                 {"nodeid": 4, "leaf": 0.7},
+             ]},
+        ],
+    }
+    tree1 = {"nodeid": 0, "leaf": 0.25}  # stump
+    ens = _trees_from_xgb_dump([json.dumps(tree0), json.dumps(tree1)], 3)
+    assert ens.n_trees == 2 and ens.max_depth == 2
+
+    model = GBTModel(trees=ens, base_score=jnp.float32(0.0))
+    x = jnp.asarray(np.array([
+        [0.0, 1.9, 0.0],   # f1<2  -> leaf -0.4;  +0.25
+        [0.0, 2.0, 0.0],   # f1==2 -> RIGHT (strict <), f0==0 >= -1 -> 0.7
+        [-5.0, 3.0, 0.0],  # right, f0<-1 -> 0.1
+    ], dtype=np.float32))
+    got = np.asarray(gbt_predict_proba(model, x))
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    want = np.array([sig(-0.4 + 0.25), sig(0.7 + 0.25), sig(0.1 + 0.25)])
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_xgboost_model_import_parity(xy):
+    """A fitted XGBClassifier served through the TPU GBT path must match
+    xgboost's own predict_proba (skipped without xgboost, like the AUC
+    parity test above)."""
+    xgboost = pytest.importorskip("xgboost")
+
+    from real_time_fraud_detection_system_tpu.models.gbt import (
+        gbt_from_xgboost,
+        gbt_predict_proba,
+    )
+
+    xtr, ytr, xte, yte = xy
+    xgb = xgboost.XGBClassifier(
+        n_estimators=30, max_depth=4, learning_rate=0.2,
+        tree_method="hist", eval_metric="logloss",
+    ).fit(xtr, ytr)
+    model = gbt_from_xgboost(xgb, xtr.shape[1])
+    ours = np.asarray(gbt_predict_proba(
+        model, jnp.asarray(xte, jnp.float32)))
+    theirs = xgb.predict_proba(np.asarray(xte, np.float32))[:, 1]
+    np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-5)
