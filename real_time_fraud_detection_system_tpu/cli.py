@@ -658,8 +658,25 @@ def cmd_import_model(args) -> int:
 
     log = get_logger("import-model")
     n_features = len(FEATURE_NAMES)
-    with open(args.model_pkl, "rb") as f:
-        clf = pickle.load(f)
+    if args.model_pkl.startswith("s3://"):
+        # the reference keeps trained_model.pkl in the object store
+        # (s3://commerce/trained_model.pkl, load_initial_data.py:269-287)
+        import io as _io
+
+        from real_time_fraud_detection_system_tpu.io.artifacts import (
+            _split_s3_url,
+        )
+        from real_time_fraud_detection_system_tpu.io.store import make_store
+
+        try:
+            url, key = _split_s3_url(args.model_pkl)
+        except ValueError as e:
+            log.error("%s", e)
+            return 2
+        clf = pickle.load(_io.BytesIO(make_store(url).get(key)))
+    else:
+        with open(args.model_pkl, "rb") as f:
+            clf = pickle.load(f)
 
     # Fail loudly on shape/class mismatches: a 20-feature or multiclass
     # model would otherwise import cleanly and serve silently-wrong

@@ -439,3 +439,43 @@ def test_import_model_rejects_mismatched_artifacts(tmp_path):
     y3 = rng.integers(0, 3, 300)
     r = run_import(LogisticRegression(max_iter=200).fit(x, y3))
     assert r.returncode == 2 and "classes" in r.stderr
+
+
+def test_import_model_from_s3_url(tmp_path, monkeypatch):
+    """--model-pkl s3://... — the reference's actual artifact location
+    (s3://commerce/trained_model.pkl) — via the make_store client
+    injection (the test_store.py pattern)."""
+    import pickle
+
+    import numpy as np
+    from sklearn.linear_model import LogisticRegression
+    from test_store import FakeS3Client
+
+    import real_time_fraud_detection_system_tpu.io.store as store_mod
+
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(200, 15))
+    y = (x[:, 2] > 0).astype(np.int32)
+    clf = LogisticRegression(max_iter=200).fit(x, y)
+    fake = FakeS3Client()
+    fake.objects[("commerce", "trained_model.pkl")] = pickle.dumps(clf)
+
+    real_make = store_mod.make_store
+    monkeypatch.setattr(
+        store_mod, "make_store",
+        lambda url, **kw: real_make(url, client=fake, **kw))
+
+    out = tmp_path / "model.npz"
+    import real_time_fraud_detection_system_tpu.cli as cli
+
+    rc = cli.main(["import-model",
+                   "--model-pkl", "s3://commerce/trained_model.pkl",
+                   "--out-model", str(out)])
+    assert rc == 0
+
+    from real_time_fraud_detection_system_tpu.io.artifacts import load_model
+
+    model = load_model(str(out))
+    xq = rng.normal(size=(32, 15))
+    np.testing.assert_allclose(
+        model.predict_proba(xq), clf.predict_proba(xq)[:, 1], atol=1e-5)
