@@ -284,9 +284,15 @@ def cmd_score(args) -> int:
     if args.alerts_only and args.out:
         log.warning("--alerts-only: the analyzed output at %s will carry "
                     "zero feature columns (predictions only)", args.out)
+    if args.emit_bf16 and (args.scorer == "cpu" or args.feedback_bootstrap):
+        log.error("--emit-bf16 rounds the emitted feature columns; "
+                  "--scorer cpu and the feedback loop re-consume them "
+                  "and would drift — keep float32 emission")
+        return 2
     cfg = cfg.replace(runtime=_dc.replace(
         cfg.runtime,
         emit_features=not args.alerts_only,
+        emit_dtype="bfloat16" if args.emit_bf16 else "float32",
         pipeline_depth=args.pipeline_depth,
         coalesce_rows=args.coalesce_rows,
         use_pallas=args.use_pallas,
@@ -1099,6 +1105,11 @@ def main(argv=None) -> int:
                    help="serve with the fused Pallas kernels where "
                         "available (tree/forest/gbt leaf-sum; logreg "
                         "featurize+score) instead of the XLA composition")
+    p.add_argument("--emit-bf16", action="store_true",
+                   help="emit the analyzed feature columns in bfloat16 "
+                        "(half the device->host bytes; predictions stay "
+                        "f32-exact, features lose ~3 decimal digits; "
+                        "incompatible with --scorer cpu / feedback)")
     p.add_argument("--start-date", default="2025-04-01")
     p.add_argument("--checkpoint-dir", default="")
     p.add_argument("--resume", action="store_true")

@@ -185,6 +185,13 @@ class RuntimeConfig:
     # sinks that persist feature columns (the analyzed table) should
     # keep the default.
     emit_features: bool = True
+    # "bfloat16" halves the feature D2H bytes (the measured full-featured
+    # serving bottleneck on constrained links: ~20 MB/s over the dev
+    # tunnel; PCIe at very high rates). Lossy (~3 decimal digits on the
+    # 15 feature columns; predictions are NOT affected — the classifier
+    # consumes the f32 features in-device), so it is opt-in and refused
+    # when the host re-consumes features (scorer=cpu, feature cache).
+    emit_dtype: str = "float32"  # "float32" | "bfloat16"
     # Pad/bucket micro-batches to these row counts to keep the jit cache warm.
     batch_buckets: Sequence[int] = (256, 1024, 4096, 16384, 65536)
     max_batch_rows: int = 65536

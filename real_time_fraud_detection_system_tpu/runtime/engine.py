@@ -154,6 +154,10 @@ class ScoringEngine:
         self.scorer = scorer or cfg.runtime.scorer
         self.cpu_model = cpu_model
         self.online_lr = online_lr
+        if cfg.runtime.emit_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"emit_dtype must be float32|bfloat16, "
+                f"got {cfg.runtime.emit_dtype!r}")
         if kind == "sequence":
             # Long-context serving: per-customer event histories in HBM
             # scored by the causal transformer — a different state and
@@ -165,6 +169,15 @@ class ScoringEngine:
             if online_lr > 0.0:
                 raise ValueError(
                     "online SGD is not wired for kind='sequence'")
+            if cfg.runtime.emit_dtype != "float32":
+                # the sequence scorer never transfers a feature matrix
+                # (zeros, built host-side) — a bf16 request would change
+                # nothing; reject rather than let the operator believe
+                # D2H bytes were halved
+                raise ValueError(
+                    "emit_dtype='bfloat16' has no effect for "
+                    "kind='sequence' (no feature matrix leaves the "
+                    "device); keep float32")
             self._init_sequence(cfg, params, scaler, feature_state,
                                 feature_cache)
             return
@@ -178,6 +191,13 @@ class ScoringEngine:
                 "emit_features=False (alerts-only serving) cannot be "
                 "combined with --scorer cpu or a feature cache: both "
                 "consume host-side feature rows")
+        if cfg.runtime.emit_dtype != "float32" and (
+            self.scorer == "cpu" or feature_cache is not None
+        ):
+            raise ValueError(
+                "emit_dtype='bfloat16' is lossy on the emitted feature "
+                "columns; --scorer cpu and the feedback feature cache "
+                "re-consume those rows and would drift — keep float32")
         self._feedback_step = None
         self._state_feedback_step = None
         # Depth-bounded tree ensembles score ~100× faster on TPU in the GEMM
@@ -236,6 +256,10 @@ class ScoringEngine:
                 params = jax.tree.map(
                     lambda p, gi: p - self.online_lr * has * gi, params, g
                 )
+            if cfg.runtime.emit_dtype == "bfloat16":
+                # halve the emitted matrix's D2H bytes; the classifier
+                # above consumed the f32 features (predictions unaffected)
+                feats = feats.astype(jnp.bfloat16)
             return fstate, params, probs, feats
 
         self._step = jax.jit(step, donate_argnums=(0,))
@@ -386,7 +410,10 @@ class ScoringEngine:
             # channels replace engineered features) — never worth a D2H.
             feats_np = np.zeros((n, N_FEATURES), np.float32)
         else:
-            feats_np = np.asarray(handle["feats"])[:n]
+            # astype: under emit_dtype="bfloat16" the transfer was bf16
+            # (half the bytes); widen back for sinks/consumers
+            feats_np = np.asarray(handle["feats"])[:n].astype(
+                np.float32, copy=False)
         if self.scorer == "cpu":
             # parity/baseline oracle: host-side pipeline on the same features
             # (sklearn pipeline, or a TrainedModel's pure-NumPy path)

@@ -386,3 +386,45 @@ def test_coalesce_carry_checkpoint_resume_identity(cfg, trained, tmp_path):
     eng_c.run(src_c, sink=sink_c, checkpointer=chk)
 
     _assert_resumed_equals_clean(sink_a, sink_b, sink_c)
+
+
+def test_emit_bf16_halves_transfer_keeps_predictions(cfg, trained):
+    """emit_dtype='bfloat16': predictions identical to the f32 engine
+    (the classifier consumes f32 features in-device), emitted feature
+    columns within bf16 rounding, invalid combos refused."""
+    import dataclasses
+
+    model, _, txs = trained
+    outs = {}
+    for dtype in ("float32", "bfloat16"):
+        c = dataclasses.replace(
+            cfg, runtime=dataclasses.replace(cfg.runtime, emit_dtype=dtype))
+        eng = ScoringEngine(c, "logreg", params=model.params,
+                            scaler=model.scaler)
+        src = ReplaySource(txs.slice(slice(0, 300)), 1_743_465_600,
+                           batch_rows=128)
+        probs, feats = [], []
+        while True:
+            cols = src.poll_batch()
+            if cols is None:
+                break
+            r = eng.process_batch(cols)
+            probs.append(r.probs)
+            feats.append(r.features)
+        outs[dtype] = (np.concatenate(probs), np.concatenate(feats))
+    np.testing.assert_array_equal(outs["float32"][0], outs["bfloat16"][0])
+    f32, bf = outs["float32"][1], outs["bfloat16"][1]
+    assert bf.dtype == np.float32  # widened back for sinks
+    np.testing.assert_allclose(bf, f32, rtol=1e-2, atol=1e-2)
+    assert np.abs(bf - f32).max() > 0  # actually rounded, not a no-op
+
+    bad = dataclasses.replace(
+        cfg, runtime=dataclasses.replace(cfg.runtime, emit_dtype="bfloat16"))
+    with pytest.raises(ValueError, match="bfloat16"):
+        ScoringEngine(bad, "logreg", params=model.params,
+                      scaler=model.scaler, scorer="cpu", cpu_model=model)
+    with pytest.raises(ValueError, match="emit_dtype"):
+        ScoringEngine(
+            dataclasses.replace(cfg, runtime=dataclasses.replace(
+                cfg.runtime, emit_dtype="float16")),
+            "logreg", params=model.params, scaler=model.scaler)

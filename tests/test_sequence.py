@@ -125,3 +125,22 @@ def test_last_logit_matches_full_form():
             got = np.asarray(transformer_last_logit(
                 params, x, qpos, attn_fn=attn))
             np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_sequence_engine_rejects_bf16_emission(small_dataset):
+    """kind='sequence' never transfers a feature matrix, so a bf16
+    emission request must be refused (it would silently change nothing)."""
+    import dataclasses
+
+    import pytest
+
+    from real_time_fraud_detection_system_tpu.config import small_config
+    from real_time_fraud_detection_system_tpu.runtime import ScoringEngine
+
+    params = init_transformer()
+    cfg = small_config()
+    cfg = dataclasses.replace(
+        cfg, runtime=dataclasses.replace(cfg.runtime,
+                                         emit_dtype="bfloat16"))
+    with pytest.raises(ValueError, match="no effect"):
+        ScoringEngine(cfg, kind="sequence", params=params, scaler=None)

@@ -769,3 +769,32 @@ def test_state_feedback_after_cross_width_restore(small_dataset, tmp_path):
     a = np.asarray(eng1.state.feature_state.terminal.fraud)
     b = np.asarray(eng8.state.feature_state.terminal.fraud)
     np.testing.assert_array_equal(a, b[p8])  # single[k] == mesh[perm[k]]
+
+
+def test_sharded_emit_bf16_predictions_exact(small_dataset):
+    """emit_dtype='bfloat16' over the mesh: predictions identical to the
+    f32 sharded engine; emitted features within bf16 rounding."""
+    import dataclasses
+
+    _, _, _, txs = small_dataset
+    part = txs.slice(slice(0, 2048))
+    cfg = _cfg()
+    params, scaler = _model()
+    outs = {}
+    for dtype in ("float32", "bfloat16"):
+        c = dataclasses.replace(
+            cfg, runtime=dataclasses.replace(cfg.runtime, emit_dtype=dtype))
+        sink = MemorySink()
+        ShardedScoringEngine(c, kind="logreg", params=params, scaler=scaler,
+                             n_devices=N_DEV).run(
+            ReplaySource(part, EPOCH0, batch_rows=1024), sink=sink)
+        o = sink.concat()
+        order = np.argsort(o["tx_id"])
+        outs[dtype] = o, order
+    f32, a = outs["float32"]
+    bf, b = outs["bfloat16"]
+    np.testing.assert_array_equal(f32["prediction"][a], bf["prediction"][b])
+    fcols = [c for c in f32 if "window" in c]
+    assert fcols
+    for c in fcols:
+        np.testing.assert_allclose(bf[c][b], f32[c][a], rtol=1e-2, atol=1e-2)
