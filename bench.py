@@ -514,6 +514,15 @@ def _child_main(args) -> None:
                 ScoringEngine(_alerts_cfg(bcfg), kind="forest",
                               params=params, scaler=scaler),
                 rows=big, n=12))
+            # bf16 feature emission: halves the feature D2H (the
+            # full-featured loop's bottleneck on a constrained link);
+            # predictions stay f32-exact.
+            _guarded("big_batch_bf16", lambda: _engine_stats(
+                ScoringEngine(
+                    bcfg.replace(runtime=_dc.replace(
+                        bcfg.runtime, emit_dtype="bfloat16")),
+                    kind="forest", params=params, scaler=scaler),
+                rows=big, n=12))
         if not (on_cpu or args.quick):
             # Sharded serving loop on a 1-chip mesh: the shard_map step +
             # partition/spill machinery running on real hardware (the
