@@ -250,6 +250,72 @@ def cmd_train(args) -> int:
     return 0
 
 
+def _make_model_reloader(path: str, kind: str, every_batches: int, log):
+    """Hot model reload for serving: every N batches, re-read the model
+    artifact and swap weights into the live engine between device steps
+    (the reference picks up a retrained pickle only by restarting the
+    Spark job, ``fraud_detection.py:59-82``). Local paths gate on mtime,
+    ``s3://`` artifacts on a content digest, so unchanged artifacts cost
+    one stat/GET per interval and swap nothing. The FIRST due interval
+    always reloads: a fresh reloader is built per supervisor incarnation
+    (crash recovery restores pre-swap weights from the checkpoint, so the
+    new incarnation must re-apply the latest artifact rather than trust a
+    stale signature). The serving kind is pinned — an artifact of a
+    different kind is refused (the jitted step's shape family would
+    change under the engine)."""
+    import hashlib
+    import os as _os
+
+    from real_time_fraud_detection_system_tpu.io.artifacts import (
+        load_model,
+        load_model_bytes,
+    )
+    from real_time_fraud_detection_system_tpu.runtime.engine import (
+        device_params_for,
+    )
+
+    state = {"n": 0, "sig": None}
+    is_local = not path.startswith("s3://")
+
+    def poll():
+        state["n"] += 1
+        if state["n"] % every_batches:
+            return None
+        try:
+            if is_local:
+                sig = _os.stat(path).st_mtime_ns
+                if state["sig"] is not None and sig == state["sig"]:
+                    return None
+                m = load_model(path)
+            else:
+                from real_time_fraud_detection_system_tpu.io.artifacts import (
+                    _split_s3_url,
+                )
+                from real_time_fraud_detection_system_tpu.io.store import (
+                    make_store,
+                )
+
+                url, key = _split_s3_url(path)
+                data = make_store(url).get(key)
+                sig = hashlib.sha256(data).hexdigest()
+                if state["sig"] is not None and sig == state["sig"]:
+                    return None
+                m = load_model_bytes(data)
+        except Exception as e:
+            log.warning("model reload from %s failed (%s); serving "
+                        "continues on the current weights", path, e)
+            return None
+        if m.kind != kind:
+            log.warning("model reload skipped: artifact kind %r != "
+                        "serving kind %r", m.kind, kind)
+            return None
+        state["sig"] = sig
+        log.info("hot-swapped model weights from %s", path)
+        return device_params_for(kind, m.params), m.scaler
+
+    return poll
+
+
 def cmd_score(args) -> int:
     from real_time_fraud_detection_system_tpu.config import Config
     from real_time_fraud_detection_system_tpu.io import make_parquet_sink
@@ -272,6 +338,17 @@ def cmd_score(args) -> int:
     txs = (load_transactions(args.data)
            if args.data and args.source == "replay" else None)
     model = load_model(args.model_file)
+    if args.reload_model_every > 0 and args.scorer == "cpu":
+        # the cpu oracle classifies host-side via the startup-captured
+        # model object; a swap would re-scale features with the new
+        # scaler while the OLD sklearn model predicts — actively wrong
+        log.error("--reload-model-every does not compose with "
+                  "--scorer cpu (the oracle model is fixed at startup)")
+        return 2
+    make_reloader = (
+        (lambda: _make_model_reloader(args.model_file, model.kind,
+                                      args.reload_model_every, log))
+        if args.reload_model_every > 0 else None)
     import dataclasses as _dc
 
     cfg = Config()
@@ -461,6 +538,7 @@ def cmd_score(args) -> int:
                     max_restarts=args.max_restarts, max_batches=args.max_batches,
                     resume=args.resume, stall_timeout_s=args.stall_timeout,
                     make_source=source_factory, make_feedback=make_feedback,
+                    make_model_reload=make_reloader,
                 )
             else:
                 engine = make_engine()
@@ -477,6 +555,7 @@ def cmd_score(args) -> int:
                 stats = engine.run(
                     source, sink=sink, checkpointer=ckpt,
                     max_batches=args.max_batches, feedback=fb,
+                    model_reload=make_reloader() if make_reloader else None,
                 )
     finally:
         close = getattr(source, "close", None)
@@ -1110,6 +1189,12 @@ def main(argv=None) -> int:
                         "(half the device->host bytes; predictions stay "
                         "f32-exact, features lose ~3 decimal digits; "
                         "incompatible with --scorer cpu / feedback)")
+    p.add_argument("--reload-model-every", type=int, default=0,
+                   help="hot model reload: every N batches re-read "
+                        "--model-file (mtime-gated for local paths) and "
+                        "swap weights into the live loop — retrain + "
+                        "overwrite the artifact, no serving restart "
+                        "(0 = off)")
     p.add_argument("--start-date", default="2025-04-01")
     p.add_argument("--checkpoint-dir", default="")
     p.add_argument("--resume", action="store_true")

@@ -60,6 +60,21 @@ from real_time_fraud_detection_system_tpu.ops.dedup import (
 )
 
 
+def device_params_for(kind: str, params):
+    """Engine-ready params: tree-ensemble kinds convert to the fast GEMM
+    form once (the step then serves them unchanged). Used at engine build
+    AND by hot model reloads, which swap ``state.params`` in place."""
+    if kind in ("tree", "forest") and isinstance(params, TreeEnsemble):
+        return for_device(params, N_FEATURES)
+    if kind == "gbt":
+        from real_time_fraud_detection_system_tpu.models.gbt import (
+            gbt_for_device,
+        )
+
+        return gbt_for_device(params, N_FEATURES)
+    return params
+
+
 def predict_fn_for(kind: str) -> Callable:
     if kind == "logreg":
         return logreg_predict_proba
@@ -202,14 +217,7 @@ class ScoringEngine:
         self._state_feedback_step = None
         # Depth-bounded tree ensembles score ~100× faster on TPU in the GEMM
         # form (see models/forest.py::predict_proba); convert once at build.
-        if kind in ("tree", "forest") and isinstance(params, TreeEnsemble):
-            params = for_device(params, N_FEATURES)
-        elif kind == "gbt":
-            from real_time_fraud_detection_system_tpu.models.gbt import (
-                gbt_for_device,
-            )
-
-            params = gbt_for_device(params, N_FEATURES)
+        params = device_params_for(kind, params)
         self.state = EngineState(
             feature_state=feature_state or init_feature_state(cfg.features),
             params=params,
@@ -606,6 +614,7 @@ class ScoringEngine:
         trigger_seconds: Optional[float] = None,
         heartbeat=None,
         feedback=None,
+        model_reload=None,
     ) -> dict:
         """Stream until the source is exhausted (or max_batches).
 
@@ -677,6 +686,21 @@ class ScoringEngine:
                 # Between-batch label application (before the checkpoint,
                 # so saved state includes the landed labels).
                 feedback.poll_and_apply()
+            if model_reload is not None:
+                # Hot model swap (the reference restarts the Spark job to
+                # pick up a retrained pickle; here the loop swaps weights
+                # between device steps — same single-threaded contract as
+                # feedback). The callable returns None (no change) or
+                # (params, scaler) ready for the engine's kind; a shape
+                # change simply retraces the jitted step. Eventual-swap
+                # semantics: up to pipeline_depth batches already in
+                # flight complete on the old weights.
+                swap = model_reload()
+                if swap is not None:
+                    new_params, new_scaler = swap
+                    self.state.params = new_params
+                    if new_scaler is not None:
+                        self.state.scaler = new_scaler
             if checkpointer is not None and self.state.batches_done % every == 0:
                 checkpointer.save(self.state)
                 # Broker-side offsets (sources that have them, e.g. Kafka)

@@ -310,7 +310,7 @@ class _GuardedSource(_FenceGuard):
 
 
 def _run_watched(engine, source, sink, checkpointer, max_batches,
-                 heartbeat: Heartbeat, feedback=None):
+                 heartbeat: Heartbeat, feedback=None, model_reload=None):
     """Run one engine incarnation under a stall watchdog.
 
     The engine loop runs in a worker thread beating the heartbeat each
@@ -346,7 +346,7 @@ def _run_watched(engine, source, sink, checkpointer, max_batches,
             box["stats"] = engine.run(
                 g_source, sink=g_sink, checkpointer=g_ckpt,
                 max_batches=max_batches, heartbeat=g_heartbeat,
-                feedback=g_feedback,
+                feedback=g_feedback, model_reload=model_reload,
             )
         except BaseException as e:  # report into the supervisor thread
             box["err"] = e
@@ -382,6 +382,7 @@ def run_with_recovery(
     resume: bool = True,
     make_source: Optional[Callable[[], object]] = None,
     make_feedback: Optional[Callable[[object], object]] = None,
+    make_model_reload: Optional[Callable[[], object]] = None,
     recover_on: Tuple[Type[BaseException], ...] = (
         TransientError, OSError, ConnectionError,
     ),
@@ -478,16 +479,23 @@ def run_with_recovery(
         # Feedback loop binds THIS incarnation's engine (and, in
         # production, its own consumer session).
         feedback = make_feedback(engine) if make_feedback else None
+        # A FRESH reloader per incarnation: the restored checkpoint holds
+        # pre-swap weights, so the new incarnation must re-apply the
+        # latest artifact on its first interval instead of trusting a
+        # previous incarnation's signature — and an abandoned (zombie)
+        # worker keeps only ITS closure, never mutating the live one's.
+        model_reload = make_model_reload() if make_model_reload else None
         try:
             if heartbeat is not None:
                 stats = _run_watched(
                     engine, source, sink, checkpointer, max_batches,
-                    heartbeat, feedback=feedback,
+                    heartbeat, feedback=feedback, model_reload=model_reload,
                 )
             else:
                 stats = engine.run(
                     source, sink=sink, checkpointer=checkpointer,
                     max_batches=max_batches, feedback=feedback,
+                    model_reload=model_reload,
                 )
             # Final checkpoint so a clean exit never replays.
             checkpointer.save(engine.state)

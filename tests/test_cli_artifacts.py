@@ -479,3 +479,57 @@ def test_import_model_from_s3_url(tmp_path, monkeypatch):
     xq = rng.normal(size=(32, 15))
     np.testing.assert_allclose(
         model.predict_proba(xq), clf.predict_proba(xq)[:, 1], atol=1e-5)
+
+
+def test_model_reloader_semantics(tmp_path, monkeypatch):
+    """_make_model_reloader: first due interval always loads (a fresh
+    per-incarnation reloader must re-apply the artifact after a
+    checkpoint restore reverted weights), unchanged signatures gate
+    subsequent polls, changed artifacts swap, kind mismatches refuse."""
+    import logging
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from real_time_fraud_detection_system_tpu.cli import _make_model_reloader
+    from real_time_fraud_detection_system_tpu.io.artifacts import save_model
+    from real_time_fraud_detection_system_tpu.models.logreg import (
+        LogRegParams,
+    )
+    from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+    from real_time_fraud_detection_system_tpu.models.train import TrainedModel
+
+    log = logging.getLogger("t")
+    path = str(tmp_path / "m.npz")
+
+    def write(w0):
+        save_model(path, TrainedModel(
+            kind="logreg",
+            scaler=Scaler(mean=jnp.zeros(15), scale=jnp.ones(15)),
+            params=LogRegParams(w=jnp.full(15, w0), b=jnp.zeros(()))))
+
+    write(1.0)
+    r = _make_model_reloader(path, "logreg", every_batches=2, log=log)
+    assert r() is None           # off-interval
+    got = r()                    # first due interval: ALWAYS loads
+    assert got is not None
+    np.testing.assert_allclose(np.asarray(got[0].w), 1.0)
+    assert r() is None and r() is None  # unchanged mtime → gated
+
+    import os
+    import time
+
+    write(2.0)
+    os.utime(path, ns=(time.time_ns(), time.time_ns() + 10**9))
+    assert r() is None
+    got = r()
+    np.testing.assert_allclose(np.asarray(got[0].w), 2.0)
+
+    # a FRESH incarnation re-applies the unchanged artifact once
+    r2 = _make_model_reloader(path, "logreg", every_batches=1, log=log)
+    assert r2() is not None
+    assert r2() is None
+
+    # kind mismatch refused
+    r3 = _make_model_reloader(path, "forest", every_batches=1, log=log)
+    assert r3() is None

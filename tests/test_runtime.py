@@ -428,3 +428,53 @@ def test_emit_bf16_halves_transfer_keeps_predictions(cfg, trained):
             dataclasses.replace(cfg, runtime=dataclasses.replace(
                 cfg.runtime, emit_dtype="float16")),
             "logreg", params=model.params, scaler=model.scaler)
+
+
+def test_hot_model_reload_between_batches(cfg, trained):
+    """engine.run(model_reload=...): weights swapped between device steps
+    take effect for subsequent batches; feature state is unaffected
+    (window updates are classifier-independent), so post-swap predictions
+    equal a from-scratch engine serving the new model."""
+    import jax.numpy as jnp
+
+    from real_time_fraud_detection_system_tpu.models.logreg import (
+        LogRegParams,
+    )
+
+    model, _, txs = trained
+    part = txs.slice(slice(0, 512))
+    zeros = LogRegParams(w=jnp.zeros(15), b=jnp.zeros(()))
+
+    # reference: trained model from the start
+    sink_ref = MemorySink()
+    ScoringEngine(cfg, "logreg", params=model.params,
+                  scaler=model.scaler).run(
+        ReplaySource(part, START_EPOCH_S, batch_rows=128), sink=sink_ref)
+    ref = sink_ref.concat()
+
+    # hot-swap: start from zero weights, swap to trained after batch 2
+    calls = {"n": 0}
+
+    def reload():
+        calls["n"] += 1
+        if calls["n"] == 2:
+            return model.params, model.scaler
+        return None
+
+    sink_hot = MemorySink()
+    ScoringEngine(cfg, "logreg", params=zeros,
+                  scaler=model.scaler).run(
+        ReplaySource(part, START_EPOCH_S, batch_rows=128), sink=sink_hot,
+        model_reload=reload)
+    hot = sink_hot.concat()
+
+    assert len(hot["prediction"]) == len(ref["prediction"]) == 512
+    # Swap lands at finish-of-batch-2, but batch 3 is ALREADY in flight
+    # (depth-2 pipeline) with the old weights — eventual-swap semantics:
+    # batches 1-3 (rows 0..383) score with zero weights → exactly 0.5.
+    np.testing.assert_allclose(hot["prediction"][:384], 0.5, atol=1e-6)
+    # batch 4: the swapped-in trained model, identical to the
+    # from-the-start reference (feature state is param-independent)
+    np.testing.assert_allclose(hot["prediction"][384:],
+                               ref["prediction"][384:], atol=1e-6)
+    assert np.abs(hot["prediction"][384:] - 0.5).max() > 0.01
