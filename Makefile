@@ -6,7 +6,7 @@
 #   make train       — offline training                     (≈ notebooks)
 #   make score       — stream-score through the engine      (≈ make fraud_detection)
 #   make run-all     — datagen + train + score              (≈ make run-all)
-#   make bench       — benchmark harness (one JSON line)
+#   make bench       — benchmark harness (full JSON line + compact headline)
 #   make test        — pytest on a virtual 8-device CPU mesh
 #   make install     — editable install incl. the `rtfds` console script
 

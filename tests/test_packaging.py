@@ -26,3 +26,36 @@ def test_makefile_mirrors_reference_targets():
     for target in ("demo:", "datagen:", "train:", "score:", "run-all:",
                    "bench:", "test:", "install:"):
         assert target in mk, target
+
+
+def test_bench_emit_final_compact_line_last(capsys):
+    """The driver records only a tail window of bench stdout, so the LAST
+    line must be a complete, parseable result JSON on its own (round-4
+    `BENCH_r04.json` had ``parsed: null`` because the full detail line
+    outgrew the window)."""
+    import json
+    import sys
+
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO)
+
+    result = {
+        "metric": "score_txns_per_sec", "value": 123.4, "unit": "txns/s",
+        "vs_baseline": 2.0,
+        "detail": {"backend": "tpu", "device_kind": "TPU v5 lite",
+                   "tpu_attempts": 1, "huge": "x" * 20000},
+    }
+    bench._emit_final(result)
+    lines = [ln for ln in capsys.readouterr().out.splitlines() if ln]
+    assert len(lines) == 2
+    full = json.loads(lines[0])
+    assert full["detail"]["huge"]  # full detail preserved first
+    compact = json.loads(lines[-1])
+    assert compact["metric"] == "score_txns_per_sec"
+    assert compact["value"] == 123.4
+    assert compact["vs_baseline"] == 2.0
+    assert compact["detail"]["backend"] == "tpu"
+    assert len(lines[-1]) < 400  # fits any sane tail window

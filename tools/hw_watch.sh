@@ -25,10 +25,15 @@ while :; do
         && { echo "hw_watch: profile -> PROFILE_r03.json"; cat PROFILE_r03.json; } \
         || echo "hw_watch: profile attempt failed (rc=$?)"
       echo "hw_watch: fresh bench while the window is open (bench.py)"
-      timeout 2400 python bench.py > "BENCH_SESSION_${BENCH_TAG:-r03b}_tpu.json" \
-        2>> "$OUT.log" \
-        && { echo "hw_watch: bench -> BENCH_SESSION_${BENCH_TAG:-r03b}_tpu.json"; \
-             cat "BENCH_SESSION_${BENCH_TAG:-r03b}_tpu.json"; } \
+      # bench.py prints the full-detail JSON line first, then a compact
+      # headline line LAST (driver tail-window contract); the session
+      # artifact keeps only the full line so it stays one json.load()-able
+      # document like every prior BENCH_SESSION_*.json.
+      BENCH_OUT="BENCH_SESSION_${BENCH_TAG:-r03b}_tpu.json"
+      timeout 2400 python bench.py > "$BENCH_OUT.raw" 2>> "$OUT.log" \
+        && { grep '^{' "$BENCH_OUT.raw" | head -1 > "$BENCH_OUT"; \
+             rm -f "$BENCH_OUT.raw"; \
+             echo "hw_watch: bench -> $BENCH_OUT"; cat "$BENCH_OUT"; } \
         || echo "hw_watch: bench attempt failed (rc=$?)"
       exit 0
     fi
