@@ -249,12 +249,12 @@ def _child_main(args) -> None:
     params, predict, skl = _build_model(args.model, rng)
     scaler = Scaler(mean=jnp.zeros(15), scale=jnp.ones(15))
 
-    def step(fstate, params, batch):
+    def _step_body(fstate, params, batch):
         fstate, feats = update_and_featurize(fstate, batch, fcfg)
         probs = predict(params, transform(scaler, feats))
         return fstate, jnp.where(batch.valid, probs, 0.0)
 
-    step = jax.jit(step, donate_argnums=(0,))
+    step = jax.jit(_step_body, donate_argnums=(0,))
 
     from real_time_fraud_detection_system_tpu.core.batch import make_batch
 
@@ -412,6 +412,64 @@ def _child_main(args) -> None:
         rtts.append(time.perf_counter() - t0)
     rtt_p50_ms = float(np.percentile(np.asarray(rtts), 50) * 1e3)
 
+    # ---- device-side step latency: chained dependent steps -------------
+    # The per-call timings above are RTT-floored over a remote tunnel
+    # (p50 flat ~66 ms from 1k→64k rows); naive dispatch loops lie under
+    # async dispatch. Protocol: run the FULL hot-path step n times
+    # back-to-back inside ONE jitted ``fori_loop`` — the feature state
+    # carries through, so iterations are data-dependent and cannot
+    # overlap — with n a TRACED trip count (one compile serves every n).
+    # The two-point form (t(n2)-t(n1))/(n2-n1) cancels RTT, dispatch and
+    # fetch cost exactly, leaving pure device step time.
+    device_latency_by_batch = {}
+    if full or os.environ.get("BENCH_FULL_SECTIONS") == "1":
+        _progress("chained device latency")
+
+        def _chained(fstate, params, batch, n):
+            def body(i, carry):
+                fs, acc = carry
+                fs, p = _step_body(fs, params, batch)
+                return (fs, acc + p.sum())
+
+            _, acc = jax.lax.fori_loop(
+                0, n, body, (fstate, jnp.float32(0)))
+            return acc
+
+        chained = jax.jit(_chained)
+        n_lo, n_hi = 8, 72
+        trials = 3 if (on_cpu or args.quick) else 5
+        for n_rows in lat_sizes:
+            try:
+                c = _make_batch_cols(rng, n_rows)
+                dbatch = jax.tree.map(jnp.asarray, make_batch(**c))
+                dstate = init_feature_state(fcfg)
+                np.asarray(chained(dstate, params, dbatch,
+                                   jnp.int32(n_lo)))  # compile
+                per_step = []
+                for _ in range(trials):
+                    t0 = time.perf_counter()
+                    np.asarray(chained(dstate, params, dbatch,
+                                       jnp.int32(n_lo)))
+                    t_lo = time.perf_counter() - t0
+                    t0 = time.perf_counter()
+                    np.asarray(chained(dstate, params, dbatch,
+                                       jnp.int32(n_hi)))
+                    t_hi = time.perf_counter() - t0
+                    per_step.append((t_hi - t_lo) / (n_hi - n_lo))
+                ps = np.asarray(per_step) * 1e3
+                device_latency_by_batch[str(n_rows)] = {
+                    "step_ms_p50": round(float(np.percentile(ps, 50)), 4),
+                    "step_ms_max": round(float(ps.max()), 4),
+                    "chained_n": [n_lo, n_hi],
+                    "trials": trials,
+                }
+                _progress(
+                    f"device step size={n_rows} "
+                    f"p50={float(np.percentile(ps, 50)):.3f}ms")
+            except Exception as e:
+                device_latency_by_batch[str(n_rows)] = {
+                    "error": f"{type(e).__name__}: {str(e)[:160]}"}
+
     # ---- engine-loop latency (host decode + device step per micro-batch)
     _progress("engine loop")
     engine_stats = None
@@ -527,6 +585,48 @@ def _child_main(args) -> None:
                         bcfg.runtime, emit_dtype="bfloat16")),
                     kind="forest", params=params, scaler=scaler),
                 rows=big, n=12))
+
+            # Selective emission: probs for EVERY row, feature columns
+            # only for rows clearing the alert threshold — the full
+            # analyzed schema lands for flagged traffic while clean rows
+            # skip the dominant D2H (one packed transfer per batch, same
+            # round-trip count as alerts-only). Threshold = this random
+            # stream's own q99, i.e. ~1% flagged — the reference's alert
+            # regime (0.88% test-set fraud rate).
+            def _selective():
+                # Calibrate on the EVOLVED feature state: the probability
+                # tail drifts as the window state accumulates, so a
+                # fresh-state probe under-sets the threshold and every
+                # batch overflows the compaction cap. Run a full-emission
+                # probe engine over the exact stream the measurement will
+                # see (same seeds, same batching) and take q99 of the
+                # probabilities it actually serves.
+                cal = []
+
+                class _Cap:
+                    def append(self, res):
+                        cal.append(res.probs)
+
+                probe = ScoringEngine(bcfg, kind="forest", params=params,
+                                      scaler=scaler)
+                probe.run(_RandSource(1, big, seed=3), trigger_seconds=0.0)
+                probe.run(_RandSource(12, big), sink=_Cap(),
+                          trigger_seconds=0.0)
+                allp = np.concatenate(cal)
+                thr = min(max(float(np.quantile(allp, 0.99)), 1e-6), 1.0)
+                e = ScoringEngine(
+                    bcfg.replace(runtime=_dc.replace(
+                        bcfg.runtime, emit_threshold=thr)),
+                    kind="forest", params=params, scaler=scaler)
+                st = _engine_stats(e, rows=big, n=12)
+                st["emit_threshold_q99"] = round(thr, 6)
+                st["flagged_fraction"] = round(
+                    float((allp >= thr).mean()), 5)
+                st["overflow_batches"] = e.selective_overflows
+                return st
+
+            _progress("engine loop 262k selective emission")
+            _guarded("big_batch_selective", _selective)
         if not (on_cpu or args.quick):
             # Sharded serving loop on a 1-chip mesh: the shard_map step +
             # partition/spill machinery running on real hardware (the
@@ -887,6 +987,7 @@ def _child_main(args) -> None:
         "p50_classify_ms": round(step_p50_ms, 3),
         "p99_classify_ms": round(step_p99_ms, 3),
         "latency_by_batch": latency_by_batch,
+        "device_latency_by_batch": device_latency_by_batch,
         "rtt_per_call_ms": round(rtt_p50_ms, 3),
         "engine_loop": engine_stats,
         "mfu": round(mfu, 4),

@@ -192,6 +192,23 @@ class RuntimeConfig:
     # consumes the f32 features in-device), so it is opt-in and refused
     # when the host re-consumes features (scorer=cpu, feature cache).
     emit_dtype: str = "float32"  # "float32" | "bfloat16"
+    # Selective emission (> 0 enables): probabilities are emitted for
+    # EVERY row, but the 15 feature columns are transferred only for rows
+    # whose fraud probability clears this threshold — the reference's
+    # analyzed_transactions schema lands complete for every flagged row
+    # (`fraud_detection.py:136-163`), while clean traffic (~99% at the
+    # 0.88% fraud rate) skips the dominant D2H cost. The step compacts
+    # flagged rows on-device and packs probs+count+indices+features into
+    # ONE flat array, so a batch costs a single transfer (same round-trip
+    # count as alerts-only serving). Rows below the threshold carry zero
+    # feature columns in BatchResult/sinks. Requires the device scorer
+    # and no feature cache (both consume every row's features host-side).
+    emit_threshold: float = 0.0
+    # On-device compaction capacity as a fraction of the batch rows. If a
+    # batch flags more rows than this, the engine falls back to fetching
+    # that batch's full feature matrix (kept on device for exactly this) —
+    # correctness never depends on the cap, only the D2H savings do.
+    emit_cap_fraction: float = 1 / 16
     # Pad/bucket micro-batches to these row counts to keep the jit cache warm.
     batch_buckets: Sequence[int] = (256, 1024, 4096, 16384, 65536)
     max_batch_rows: int = 65536

@@ -379,6 +379,34 @@ def make_sharded_step(
             P(axis, None),
         )
         fn = _shard_map(local_step, in_specs, out_specs)
+        thresh = float(cfg.runtime.emit_threshold)
+        if cfg.runtime.emit_features and thresh > 0.0:
+            # Selective emission over the mesh: the same packed-transfer
+            # contract as the single-chip engine (engine.py step tail) —
+            # probs for every row, feature vectors compacted to flagged
+            # rows, one flat f32 array per chunk. The compaction runs on
+            # the GLOBAL arrays outside shard_map (XLA inserts the gather
+            # collectives); indices are global chunk slots, exact in f32
+            # for any chunk ≤ 2^24 slots.
+            cap_frac = cfg.runtime.emit_cap_fraction
+
+            def wrapped(fstate, params, scaler, batch):
+                fstate, params, probs, feats = fn(
+                    fstate, params, scaler, batch)
+                pad = batch.valid.shape[0]
+                cap = max(8, int(pad * cap_frac))
+                flagged = batch.valid & (probs >= thresh)
+                idx = jnp.nonzero(flagged, size=cap, fill_value=0)[0]
+                count = jnp.sum(flagged).astype(jnp.float32)
+                packed = jnp.concatenate([
+                    probs, count[None], idx.astype(jnp.float32),
+                    feats[idx].reshape(-1),
+                ])
+                return fstate, params, probs, {
+                    "packed": packed, "full": feats,
+                }
+
+            return jax.jit(wrapped, donate_argnums=(0,))
         return jax.jit(fn, donate_argnums=(0,))
 
     return build

@@ -193,6 +193,13 @@ class ScoringEngine:
                     "emit_dtype='bfloat16' has no effect for "
                     "kind='sequence' (no feature matrix leaves the "
                     "device); keep float32")
+            if cfg.runtime.emit_threshold > 0.0:
+                # the sequence scorer's feature matrix is definitionally
+                # zeros — a threshold would change nothing; reject rather
+                # than let the operator believe D2H bytes were cut
+                raise ValueError(
+                    "emit_threshold has no effect for kind='sequence' "
+                    "(no feature matrix leaves the device); keep 0")
             self._init_sequence(cfg, params, scaler, feature_state,
                                 feature_cache)
             return
@@ -213,6 +220,37 @@ class ScoringEngine:
                 "emit_dtype='bfloat16' is lossy on the emitted feature "
                 "columns; --scorer cpu and the feedback feature cache "
                 "re-consume those rows and would drift — keep float32")
+        thresh = float(cfg.runtime.emit_threshold)
+        if not 0.0 <= thresh <= 1.0:
+            raise ValueError(
+                f"emit_threshold must be in [0, 1], got {thresh}")
+        if thresh > 0.0 and not cfg.runtime.emit_features:
+            # same principle as the sequence-kind rejection above: never
+            # let the operator believe flagged rows' features will land
+            # when alerts-only mode keeps the matrix in HBM entirely
+            raise ValueError(
+                "emit_threshold > 0 (selective emission) contradicts "
+                "emit_features=False (alerts-only): pick one")
+        self._selective = thresh > 0.0
+        if self._selective:
+            if self.scorer == "cpu" or feature_cache is not None:
+                raise ValueError(
+                    "selective emission (emit_threshold > 0) cannot be "
+                    "combined with --scorer cpu or a feature cache: both "
+                    "consume every row's features host-side")
+            if cfg.runtime.emit_dtype != "float32":
+                raise ValueError(
+                    "selective emission already cuts feature D2H by "
+                    "~1/emit_cap_fraction; emit_dtype='bfloat16' is not "
+                    "supported on the packed selective transfer — keep "
+                    "float32")
+            if not 0.0 < cfg.runtime.emit_cap_fraction <= 1.0:
+                raise ValueError(
+                    "emit_cap_fraction must be in (0, 1], got "
+                    f"{cfg.runtime.emit_cap_fraction}")
+        # Batches whose flagged-row count overflowed the compaction cap
+        # (each fell back to a full feature fetch — correct, just slower).
+        self.selective_overflows = 0
         self._feedback_step = None
         self._state_feedback_step = None
         # Depth-bounded tree ensembles score ~100× faster on TPU in the GEMM
@@ -268,6 +306,30 @@ class ScoringEngine:
                 # halve the emitted matrix's D2H bytes; the classifier
                 # above consumed the f32 features (predictions unaffected)
                 feats = feats.astype(jnp.bfloat16)
+            if self._selective:
+                # On-device compaction: gather the flagged rows' feature
+                # vectors into a fixed-capacity buffer, then pack
+                # probs + count + indices + features into ONE flat f32
+                # array — a batch costs a single D2H transfer (the same
+                # round-trip count as alerts-only serving) instead of a
+                # full [B, 15] matrix. Indices ride as f32, exact for any
+                # batch ≤ 2^24 rows (max_batch_rows is 2^20). The full
+                # matrix is ALSO returned (it already exists; untouched
+                # HBM until fetched) as the overflow fallback.
+                pad = batch.valid.shape[0]
+                cap = max(8, int(pad * cfg.runtime.emit_cap_fraction))
+                flagged = batch.valid & (probs >= thresh)
+                idx = jnp.nonzero(flagged, size=cap, fill_value=0)[0]
+                count = jnp.sum(flagged).astype(jnp.float32)
+                packed_out = jnp.concatenate([
+                    probs,
+                    count[None],
+                    idx.astype(jnp.float32),
+                    feats[idx].reshape(-1),
+                ])
+                return fstate, params, probs, {
+                    "packed": packed_out, "full": feats,
+                }
             return fstate, params, probs, feats
 
         self._step = jax.jit(step, donate_argnums=(0,))
@@ -342,6 +404,8 @@ class ScoringEngine:
         self.feature_cache = None
         self._feedback_step = None
         self._state_feedback_step = None
+        self._selective = False
+        self.selective_overflows = 0
         self.state = EngineState(
             feature_state=feature_state or init_history_state(cfg.features),
             params=params,
@@ -412,6 +476,9 @@ class ScoringEngine:
     def _finish_batch(self, handle: dict) -> BatchResult:
         """Block on the handle's device futures; build the BatchResult."""
         n = handle["n"]
+        if self._selective:
+            probs_np, feats_np = self._unpack_selective(handle)
+            return self._emit_result(handle, probs_np, feats_np)
         if not self.cfg.runtime.emit_features or self.kind == "sequence":
             # alerts-only mode: the feature matrix stays in HBM. The
             # sequence scorer's matrix is definitionally zeros (raw event
@@ -432,6 +499,34 @@ class ScoringEngine:
         else:
             probs_np = np.asarray(handle["probs"])[:n]
         return self._emit_result(handle, probs_np, feats_np)
+
+    def _unpack_selective(self, handle: dict) -> tuple:
+        """Decode the packed selective-emission transfer.
+
+        One flat f32 fetch carries [probs(pad) | count(1) | idx(cap) |
+        feats(cap·15)]. Flagged rows' feature vectors land bit-identical
+        to full emission (they ride the packed array as raw f32); rows
+        below the threshold carry zeros. A count above the compaction cap
+        falls back to fetching that batch's full matrix — still on device
+        precisely for this — so correctness never depends on the cap.
+        """
+        n = handle["n"]
+        em = handle["feats"]
+        pad = em["full"].shape[0]
+        cap = (em["packed"].shape[0] - pad - 1) // (1 + N_FEATURES)
+        flat = np.asarray(em["packed"])
+        probs_np = flat[:n]
+        count = int(flat[pad])
+        feats_np = np.zeros((n, N_FEATURES), np.float32)
+        if count > cap:
+            self.selective_overflows += 1
+            feats_np = np.asarray(em["full"])[:n].astype(
+                np.float32, copy=False)
+        elif count:
+            idx = flat[pad + 1:pad + 1 + count].astype(np.int64)
+            sel = flat[pad + 1 + cap:pad + 1 + cap + count * N_FEATURES]
+            feats_np[idx] = sel.reshape(count, N_FEATURES)
+        return probs_np, feats_np
 
     def _emit_result(self, handle: dict, probs_np: np.ndarray,
                      feats_np: np.ndarray) -> BatchResult:

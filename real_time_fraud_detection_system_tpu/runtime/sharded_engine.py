@@ -334,6 +334,32 @@ class ShardedScoringEngine(ScoringEngine):
         probs_np = np.zeros(n, dtype=np.float32)
         feats_np = np.zeros((n, N_FEATURES), dtype=np.float32)
         for rows, pos, probs, feats in handle["parts"]:
+            if isinstance(feats, dict):
+                # selective emission: one packed fetch per chunk carries
+                # [probs(pad) | count | idx(cap) | feats(cap·15)] — the
+                # same layout the single-chip engine unpacks; indices are
+                # chunk SLOTS, mapped back to original batch rows via the
+                # chunk's (pos → rows) placement.
+                pad = feats["full"].shape[0]
+                cap = ((feats["packed"].shape[0] - pad - 1)
+                       // (1 + N_FEATURES))
+                flat = np.asarray(feats["packed"])
+                probs_np[rows] = flat[:pad][pos]
+                count = int(flat[pad])
+                if count > cap:
+                    self.selective_overflows += 1
+                    feats_np[rows] = np.asarray(feats["full"])[pos]
+                elif count:
+                    idx = flat[pad + 1:pad + 1 + count].astype(np.int64)
+                    sel = flat[pad + 1 + cap:
+                               pad + 1 + cap + count * N_FEATURES]
+                    slot_to_row = np.full(pad, -1, np.int64)
+                    slot_to_row[pos] = rows
+                    # flagged slots are valid by construction, so every
+                    # target is a real batch row
+                    feats_np[slot_to_row[idx]] = sel.reshape(
+                        count, N_FEATURES)
+                continue
             probs_np[rows] = np.asarray(probs)[pos]
             if feats is not None and emit:
                 # alerts-only mode skips the per-shard feature D2H, same

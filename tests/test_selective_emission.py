@@ -1,0 +1,211 @@
+"""Selective feature emission (``RuntimeConfig.emit_threshold``).
+
+The reference's scorer persists every row's 15 feature columns into
+``analyzed_transactions`` (``fraud_detection.py:136-163``); the engine's
+selective mode transfers those columns only for rows whose probability
+clears the alert threshold. These tests pin the contract the mode is
+allowed to claim: probabilities identical to full emission for EVERY
+row, flagged rows' feature vectors BIT-identical, clean rows zero, and
+correctness independent of the compaction cap (overflow falls back to a
+full fetch).
+"""
+
+import dataclasses as dc
+
+import numpy as np
+import pytest
+
+from real_time_fraud_detection_system_tpu.config import (
+    Config,
+    DataConfig,
+    FeatureConfig,
+    RuntimeConfig,
+    TrainConfig,
+)
+from real_time_fraud_detection_system_tpu.runtime import (
+    ReplaySource,
+    ScoringEngine,
+)
+
+START_EPOCH_S = 1_743_465_600  # 2025-04-01
+
+
+class ListSink:
+    """Raw BatchResult capture — bit-level feature comparisons need the
+    f32 matrix before any sink column casting."""
+
+    def __init__(self):
+        self.results = []
+
+    def append(self, res) -> None:
+        self.results.append(res)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return Config(
+        data=DataConfig(n_customers=120, n_terminals=240, n_days=45, seed=7,
+                        start_date="2025-04-01"),
+        features=FeatureConfig(customer_capacity=256, terminal_capacity=512),
+        train=TrainConfig(delta_train_days=25, delta_delay_days=5,
+                          delta_test_days=10, epochs=2),
+        runtime=RuntimeConfig(batch_buckets=(256, 1024, 4096)),
+    )
+
+
+@pytest.fixture(scope="module")
+def trained(cfg, small_dataset):
+    from real_time_fraud_detection_system_tpu.models import train_model
+
+    _, _, _, txs = small_dataset
+    model, _ = train_model(txs, cfg, kind="forest")
+    return model, txs
+
+
+def _run(cfg, model, txs, rows=3000, batch_rows=512):
+    eng = ScoringEngine(cfg, kind="forest", params=model.params,
+                        scaler=model.scaler)
+    sink = ListSink()
+    eng.run(ReplaySource(txs.slice(slice(0, rows)), START_EPOCH_S,
+                         batch_rows=batch_rows), sink=sink)
+    probs = np.concatenate([r.probs for r in sink.results])
+    feats = np.concatenate([r.features for r in sink.results])
+    return probs, feats, eng
+
+
+def _with_threshold(cfg, thresh, cap=1 / 16):
+    return cfg.replace(runtime=dc.replace(
+        cfg.runtime, emit_threshold=thresh, emit_cap_fraction=cap))
+
+
+def test_selective_parity_with_full_emission(cfg, trained):
+    model, txs = trained
+    full_p, full_f, _ = _run(cfg, model, txs)
+    thresh = 0.3  # flags a few % of this stream — exercises both sides
+    sel_p, sel_f, eng = _run(_with_threshold(cfg, thresh), model, txs)
+
+    np.testing.assert_array_equal(sel_p, full_p)
+    flagged = full_p >= thresh
+    assert 0 < flagged.sum() < len(full_p)  # both populations present
+    # flagged rows: BIT-identical feature vectors (they ride the packed
+    # transfer as raw f32 — no rounding anywhere)
+    np.testing.assert_array_equal(sel_f[flagged], full_f[flagged])
+    # clean rows: zeros (the matrix never left the device for them)
+    assert not sel_f[~flagged].any()
+    assert eng.selective_overflows == 0
+
+
+def test_selective_overflow_falls_back_to_full_fetch(cfg, trained):
+    model, txs = trained
+    # threshold 1e-6 flags essentially every row; a tiny cap guarantees
+    # overflow on every batch — the engine must fall back to the full
+    # matrix, so the output is exactly full emission
+    full_p, full_f, _ = _run(cfg, model, txs)
+    sel_p, sel_f, eng = _run(_with_threshold(cfg, 1e-6, cap=0.001),
+                             model, txs)
+    assert eng.selective_overflows > 0
+    np.testing.assert_array_equal(sel_p, full_p)
+    np.testing.assert_array_equal(sel_f, full_f)
+
+
+def test_selective_threshold_above_all_probs_emits_zero_features(
+        cfg, trained):
+    model, txs = trained
+    sel_p, sel_f, eng = _run(_with_threshold(cfg, 0.999999), model, txs,
+                             rows=1200)
+    assert sel_p.any()  # probs still land for every row
+    assert not sel_f.any()
+    assert eng.selective_overflows == 0
+
+
+def test_selective_guards(cfg, trained):
+    model, txs = trained
+
+    class _Oracle:
+        def predict_proba(self, x):  # pragma: no cover - never reached
+            return np.zeros(len(x))
+
+    with pytest.raises(ValueError, match="scorer cpu"):
+        ScoringEngine(_with_threshold(cfg, 0.5), kind="forest",
+                      params=model.params, scaler=model.scaler,
+                      scorer="cpu", cpu_model=_Oracle())
+    with pytest.raises(ValueError, match="bfloat16"):
+        bad = cfg.replace(runtime=dc.replace(
+            cfg.runtime, emit_threshold=0.5, emit_dtype="bfloat16"))
+        ScoringEngine(bad, kind="forest", params=model.params,
+                      scaler=model.scaler)
+    with pytest.raises(ValueError, match="emit_threshold"):
+        ScoringEngine(_with_threshold(cfg, 1.5), kind="forest",
+                      params=model.params, scaler=model.scaler)
+    with pytest.raises(ValueError, match="emit_cap_fraction"):
+        ScoringEngine(_with_threshold(cfg, 0.5, cap=0.0), kind="forest",
+                      params=model.params, scaler=model.scaler)
+
+
+def test_sharded_selective_matches_single_chip(cfg, trained):
+    """Selective emission over the 8-device mesh: identical probs AND
+    identical selective feature output as the single-chip selective
+    engine on the same stream — the 'same engine, sharded' contract
+    extends to the emission mode (packed per-chunk transfers decode to
+    the same flagged rows)."""
+    from real_time_fraud_detection_system_tpu.runtime import (
+        ShardedScoringEngine,
+    )
+
+    model, txs = trained
+    scfg = _with_threshold(cfg, 0.3)
+    p1, f1, _ = _run(scfg, model, txs, rows=2000)
+
+    eng = ShardedScoringEngine(scfg, kind="forest", params=model.params,
+                               scaler=model.scaler, n_devices=8)
+    sink = ListSink()
+    eng.run(ReplaySource(txs.slice(slice(0, 2000)), START_EPOCH_S,
+                         batch_rows=512), sink=sink)
+    p8 = np.concatenate([r.probs for r in sink.results])
+    f8 = np.concatenate([r.features for r in sink.results])
+    np.testing.assert_allclose(p8, p1, atol=1e-6)
+    flagged = p1 >= 0.3
+    assert flagged.any()
+    np.testing.assert_allclose(f8[flagged], f1[flagged], rtol=1e-6,
+                               atol=1e-6)
+    assert not f8[~flagged].any()
+    assert eng.selective_overflows == 0
+
+
+def test_selective_composes_with_checkpoint_resume(cfg, trained, tmp_path):
+    """A selective engine's feature state is the same state — crash +
+    resume must reproduce the uninterrupted run exactly (the engine's
+    exactly-once story, unchanged by the emission mode)."""
+    from real_time_fraud_detection_system_tpu.io import Checkpointer
+
+    model, txs = trained
+    scfg = _with_threshold(cfg, 0.3).replace(runtime=dc.replace(
+        _with_threshold(cfg, 0.3).runtime, checkpoint_every_batches=2))
+
+    # uninterrupted
+    ref_p, ref_f, _ = _run(scfg, model, txs, rows=2000)
+
+    # interrupted at batch 2, then resumed
+    eng = ScoringEngine(scfg, kind="forest", params=model.params,
+                        scaler=model.scaler)
+    ck = Checkpointer(str(tmp_path / "ck"))
+    src = ReplaySource(txs.slice(slice(0, 2000)), START_EPOCH_S,
+                       batch_rows=512)
+    sink = ListSink()
+    eng.run(src, sink=sink, max_batches=2, checkpointer=ck)
+    eng2 = ScoringEngine(scfg, kind="forest", params=model.params,
+                         scaler=model.scaler)
+    assert ck.restore(eng2.state) is not None
+    src2 = ReplaySource(txs.slice(slice(0, 2000)), START_EPOCH_S,
+                        batch_rows=512)
+    src2.seek(eng2.state.offsets)
+    eng2.run(src2, sink=sink)
+    by_idx = {}
+    for r in sink.results:  # replayed indices overwrite (idempotent sink)
+        by_idx[r.batch_index] = r
+    got_p = np.concatenate(
+        [by_idx[i].probs for i in sorted(by_idx)])
+    got_f = np.concatenate(
+        [by_idx[i].features for i in sorted(by_idx)])
+    np.testing.assert_array_equal(got_p, ref_p)
+    np.testing.assert_array_equal(got_f, ref_f)
