@@ -613,10 +613,21 @@ def _child_main(args) -> None:
                 probe.run(_RandSource(12, big), sink=_Cap(),
                           trigger_seconds=0.0)
                 allp = np.concatenate(cal)
-                thr = min(max(float(np.quantile(allp, 0.99)), 1e-6), 1.0)
+                # The forest's probability mass is discrete (tree-vote
+                # averages): the q99 VALUE can carry a fat atom, and the
+                # engine flags with >=, so thresholding AT q99 can flag
+                # far more than 1% (measured: 29% — every batch
+                # overflowed). Step just above the atom instead.
+                thr = float(np.nextafter(
+                    np.float32(np.quantile(allp, 0.99)), np.float32(2.0)))
+                thr = min(max(thr, 1e-6), 1.0)
                 e = ScoringEngine(
                     bcfg.replace(runtime=_dc.replace(
-                        bcfg.runtime, emit_threshold=thr)),
+                        bcfg.runtime, emit_threshold=thr,
+                        # true flagged rate ~1% ⇒ 1/32 still 3× headroom,
+                        # and the packed transfer shrinks toward the
+                        # alerts-only floor (probs dominate it)
+                        emit_cap_fraction=1 / 32)),
                     kind="forest", params=params, scaler=scaler)
                 st = _engine_stats(e, rows=big, n=12)
                 st["emit_threshold_q99"] = round(thr, 6)
@@ -650,6 +661,42 @@ def _child_main(args) -> None:
                 engine_stats["sharded_1dev"] = {
                     "error": f"{type(e).__name__}: {str(e)[:160]}"
                 }
+        if full:
+            # Virtual-mesh scaling curve (subprocess: needs the 8-device
+            # CPU mesh env, which this TPU-attached process cannot adopt).
+            # On the sandbox's shared host cores the claim is FLAT rows/s
+            # across widths (shard_map + partition/re-assemble overhead
+            # amortizes, VERDICT r4 item 4), not wall-clock speedup.
+            _progress("sharded scaling curve (virtual CPU mesh)")
+
+            def _scaling():
+                env = dict(os.environ)
+                env["JAX_PLATFORMS"] = "cpu"
+                env.pop("BENCH_ROLE", None)
+                tool = os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "tools", "sharded_scaling_bench.py")
+                p = subprocess.Popen(
+                    [sys.executable, tool, "--quick"], env=env,
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                    text=True)
+                t0 = time.monotonic()
+                while p.poll() is None:
+                    if time.monotonic() - t0 > 1200.0:
+                        p.kill()
+                        p.wait()
+                        raise TimeoutError("scaling subprocess > 1200 s")
+                    _progress("sharded scaling running")
+                    time.sleep(20.0)
+                out, err = p.communicate()
+                lines = [ln for ln in out.splitlines()
+                         if ln.startswith("{")]
+                if p.returncode != 0 or not lines:
+                    raise RuntimeError(
+                        f"rc={p.returncode}: {err.strip()[-200:]}")
+                return json.loads(lines[-1])
+
+            _guarded("sharded_scaling", _scaling)
         if on_cpu and skl is not None:
             # The CPU serving path users actually get (--scorer cpu):
             # framework feature engine + host-side sklearn classify. This

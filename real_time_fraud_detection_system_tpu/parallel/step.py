@@ -68,14 +68,22 @@ def partition_batch_spill(
     """
     cust = cols["customer_id"]
     n = len(cust)
-    part = (cust % n_dev).astype(np.int64)
-    order = np.argsort(part, kind="stable")
-    part_sorted = part[order]
-    rank_sorted = (
-        np.arange(n) - np.searchsorted(part_sorted, part_sorted, "left")
-    )
-    rank = np.empty(n, dtype=np.int64)
-    rank[order] = rank_sorted
+    if n_dev == 1:
+        # Degenerate mesh: every row lands on the one shard in input
+        # order — skip the argsort/searchsorted rank machinery (host
+        # cost that buys nothing at width 1).
+        part = np.zeros(n, dtype=np.int64)
+        rank = np.arange(n, dtype=np.int64)
+    else:
+        part = (cust % n_dev).astype(np.int64)
+        order = np.argsort(part, kind="stable")
+        part_sorted = part[order]
+        rank_sorted = (
+            np.arange(n) - np.searchsorted(part_sorted, part_sorted,
+                                           "left")
+        )
+        rank = np.empty(n, dtype=np.int64)
+        rank[order] = rank_sorted
     total = n_dev * rows_per_shard
 
     def _mk_chunk(rows, pos, routed):
@@ -188,11 +196,20 @@ def make_sharded_step(
     mesh: Optional[Mesh] = None,
     axis: "str | Tuple[str, ...]" = "data",
     route_customers: bool = False,
+    packed: bool = False,
 ):
     """Build the jitted multi-chip step.
 
     step(feature_state, params, scaler, batch) -> (feature_state, params,
     probs, features); batch leaves are [n_dev*B_local] sharded on axis 0.
+
+    ``packed=True`` makes the built step take ONE ``[7, n_dev*B_local]``
+    int32 array (:func:`~..core.batch.pack_batch` layout) instead of a
+    TxBatch pytree — a batch then crosses host→device as a single copy
+    (one RPC over a remote tunnel instead of seven), and the bitcast
+    unpack runs inside the jit before ``shard_map``. The serving engine
+    uses this; direct callers that already hold device-side TxBatch
+    leaves keep the default.
 
     ``axis`` may be a single mesh axis name or a tuple of names (e.g.
     ``("dcn", "ici")`` from :func:`.distributed.make_hybrid_mesh`): rows
@@ -236,6 +253,15 @@ def make_sharded_step(
             """Route (key, day, amount, fraud, valid) to the key's owner
             device; returns received fields + a ``back`` that routes
             per-row [*, NW] aggregates to the sending rows."""
+            if n_dev == 1:
+                # Width-1 mesh: every key is owner-local already. The
+                # generic path's argsort + scatter/gather permutation is
+                # pure overhead here (measured as most of the sharded
+                # engine's 29% single-device tax, round-4 bench
+                # `sharded_1dev`); window updates are permutation-
+                # invariant, so the identity exchange is exact.
+                return (key, batch.day, batch.amount, fraud, batch.valid,
+                        lambda mat: mat)
             dest = (key % jnp.uint32(n_dev)).astype(jnp.int32)
             send_pos, xchg, scatter = owner_route(
                 dest, batch.valid, n_dev, axis, bl)
@@ -359,6 +385,13 @@ def make_sharded_step(
         return jax.tree.map(lambda _: spec, tree)
 
     def build(fstate_template, params_template, scaler_template, batch_template):
+        from real_time_fraud_detection_system_tpu.core.batch import (
+            unpack_batch,
+        )
+
+        # specs need only the pytree STRUCTURE; in packed mode the
+        # caller's template is the [7, B] array, so synthesize a TxBatch
+        batch_t = TxBatch(*([0] * 7)) if packed else batch_template
         in_specs = (
             FeatureState(
                 customer=spec_like(fstate_template.customer, P(axis, None)),
@@ -370,7 +403,7 @@ def make_sharded_step(
             ),
             spec_like(params_template, P()),
             spec_like(scaler_template, P()),
-            spec_like(batch_template, P(axis)),
+            spec_like(batch_t, P(axis)),
         )
         out_specs = (
             in_specs[0],
@@ -380,7 +413,15 @@ def make_sharded_step(
         )
         fn = _shard_map(local_step, in_specs, out_specs)
         thresh = float(cfg.runtime.emit_threshold)
-        if cfg.runtime.emit_features and thresh > 0.0:
+        selective = cfg.runtime.emit_features and thresh > 0.0
+        cap_frac = cfg.runtime.emit_cap_fraction
+
+        def outer(fstate, params, scaler, batch_in):
+            batch = unpack_batch(batch_in) if packed else batch_in
+            fstate, params, probs, feats = fn(fstate, params, scaler,
+                                              batch)
+            if not selective:
+                return fstate, params, probs, feats
             # Selective emission over the mesh: the same packed-transfer
             # contract as the single-chip engine (engine.py step tail) —
             # probs for every row, feature vectors compacted to flagged
@@ -388,25 +429,19 @@ def make_sharded_step(
             # the GLOBAL arrays outside shard_map (XLA inserts the gather
             # collectives); indices are global chunk slots, exact in f32
             # for any chunk ≤ 2^24 slots.
-            cap_frac = cfg.runtime.emit_cap_fraction
+            pad = batch.valid.shape[0]
+            cap = max(8, int(pad * cap_frac))
+            flagged = batch.valid & (probs >= thresh)
+            idx = jnp.nonzero(flagged, size=cap, fill_value=0)[0]
+            count = jnp.sum(flagged).astype(jnp.float32)
+            packed_out = jnp.concatenate([
+                probs, count[None], idx.astype(jnp.float32),
+                feats[idx].reshape(-1),
+            ])
+            return fstate, params, probs, {
+                "packed": packed_out, "full": feats,
+            }
 
-            def wrapped(fstate, params, scaler, batch):
-                fstate, params, probs, feats = fn(
-                    fstate, params, scaler, batch)
-                pad = batch.valid.shape[0]
-                cap = max(8, int(pad * cap_frac))
-                flagged = batch.valid & (probs >= thresh)
-                idx = jnp.nonzero(flagged, size=cap, fill_value=0)[0]
-                count = jnp.sum(flagged).astype(jnp.float32)
-                packed = jnp.concatenate([
-                    probs, count[None], idx.astype(jnp.float32),
-                    feats[idx].reshape(-1),
-                ])
-                return fstate, params, probs, {
-                    "packed": packed, "full": feats,
-                }
-
-            return jax.jit(wrapped, donate_argnums=(0,))
-        return jax.jit(fn, donate_argnums=(0,))
+        return jax.jit(outer, donate_argnums=(0,))
 
     return build
