@@ -255,8 +255,11 @@ def _make_model_reloader(path: str, kind: str, every_batches: int, log):
     artifact and swap weights into the live engine between device steps
     (the reference picks up a retrained pickle only by restarting the
     Spark job, ``fraud_detection.py:59-82``). Local paths gate on mtime,
-    ``s3://`` artifacts on a content digest, so unchanged artifacts cost
-    one stat/GET per interval and swap nothing. The FIRST due interval
+    ``s3://`` artifacts on HEAD metadata (ETag + size), so an unchanged
+    artifact costs one stat/HEAD per interval — the body is downloaded
+    only when the metadata changed (stores without ``head()``, or with
+    degenerate metadata, fall back to a GET + content digest gate). The
+    FIRST due interval
     always reloads: a fresh reloader is built per supervisor incarnation
     (crash recovery restores pre-swap weights from the checkpoint, so the
     new incarnation must re-apply the latest artifact rather than trust a
@@ -296,10 +299,25 @@ def _make_model_reloader(path: str, kind: str, every_batches: int, log):
                 )
 
                 url, key = _split_s3_url(path)
-                data = make_store(url).get(key)
-                sig = hashlib.sha256(data).hexdigest()
-                if state["sig"] is not None and sig == state["sig"]:
-                    return None
+                store = make_store(url)
+                # Change-gate on HEAD metadata (ETag/size) so an
+                # unchanged artifact costs one HEAD per interval, not a
+                # full GET; digest only when metadata says it changed.
+                # Stores without head() (older fakes) fall back to the
+                # GET+digest gate.
+                head = getattr(store, "head", None)
+                meta = head(key) if head is not None else {}
+                if meta.get("etag") or meta.get("size") is not None:
+                    sig = f"{meta.get('etag')}:{meta.get('size')}"
+                    if state["sig"] is not None and sig == state["sig"]:
+                        return None
+                    data = store.get(key)
+                else:
+                    # no head() or degenerate metadata: digest-gate
+                    data = store.get(key)
+                    sig = hashlib.sha256(data).hexdigest()
+                    if state["sig"] is not None and sig == state["sig"]:
+                        return None
                 m = load_model_bytes(data)
         except Exception as e:
             log.warning("model reload from %s failed (%s); serving "
@@ -804,6 +822,23 @@ def cmd_import_model(args) -> int:
         log.error("binary classifiers only: model has %d classes",
                   len(classes))
         return 2
+    # Same count in a different COLUMN ORDER would also serve
+    # silently-wrong probabilities; when the pickle recorded its fitted
+    # feature names (sklearn ≥1.0 with a DataFrame fit), require them to
+    # match the serving order exactly.
+    names = getattr(clf, "feature_names_in_", None)
+    if names is not None:
+        from real_time_fraud_detection_system_tpu.features.spec import (
+            FEATURE_NAMES,
+        )
+
+        got = [str(x) for x in names]
+        if got != list(FEATURE_NAMES):
+            log.error(
+                "model was fitted on feature names/order %s; the serving "
+                "vector is %s (features/spec.py) — re-export the model "
+                "with the serving column order", got, list(FEATURE_NAMES))
+            return 2
 
     if args.scaler_pkl:
         import joblib  # ships with sklearn

@@ -105,6 +105,26 @@ class ConsoleSink:
             )
 
 
+def _part_order(name: str):
+    """Deterministic part ordering for mixed naming schemes.
+
+    Indexed parts (``part-<batch_index>``, checkpointed runs) sort
+    NUMERICALLY first — lexicographic order breaks once an 8-digit index
+    and a 13-digit ms-timestamp stem share a leading digit. Timestamp
+    parts (``part-<ms>-<seq>``, un-checkpointed runs) follow, by name
+    (their stems are zero-padded, so name order is write order). Mixing
+    the two schemes under one directory/prefix means the run switched
+    checkpointing mid-lineage; ``truncate_after`` fences only the indexed
+    lineage (timestamp parts carry no replay semantics to fence).
+    """
+    base = name.rsplit("/", 1)[-1]
+    stem = base[len("part-"):-len(".parquet")] \
+        if base.startswith("part-") and base.endswith(".parquet") else ""
+    if stem.isdigit():
+        return (0, int(stem), "")
+    return (1, 0, name)
+
+
 class ParquetSink:
     """One part file per batch: ``<dir>/part-<batch_index>.parquet``.
 
@@ -159,9 +179,10 @@ class ParquetSink:
         import pyarrow as pa
 
         files = sorted(
-            os.path.join(self.directory, f)
-            for f in os.listdir(self.directory)
-            if f.endswith(".parquet")
+            (os.path.join(self.directory, f)
+             for f in os.listdir(self.directory)
+             if f.endswith(".parquet")),
+            key=_part_order,
         )
         if not files:
             return {}
@@ -217,8 +238,8 @@ class StoreParquetSink:
         import pyarrow as pa
         import pyarrow.parquet as pq
 
-        keys = sorted(k for k in self.store.list("")
-                      if k.endswith(".parquet"))
+        keys = sorted((k for k in self.store.list("")
+                       if k.endswith(".parquet")), key=_part_order)
         if not keys:
             return {}
         table = pa.concat_tables(

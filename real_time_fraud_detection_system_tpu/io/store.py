@@ -56,6 +56,16 @@ class LocalStore:
     def exists(self, key: str) -> bool:
         return os.path.isfile(self._path(key))
 
+    def head(self, key: str) -> dict:
+        """Change-detection metadata without reading the body:
+        ``{"etag", "size"}`` (etag = mtime_ns here). Raises KeyError on a
+        missing key, like :meth:`get`."""
+        try:
+            st = os.stat(self._path(key))
+        except FileNotFoundError:
+            raise KeyError(key) from None
+        return {"etag": str(st.st_mtime_ns), "size": st.st_size}
+
     def delete(self, key: str) -> None:
         try:
             os.remove(self._path(key))
@@ -144,6 +154,24 @@ class S3Store:
             if _is_missing(e):
                 return False
             raise
+
+    def head(self, key: str) -> dict:
+        """Change-detection metadata without the body (one HEAD request):
+        ``{"etag", "size"}``. Raises KeyError on a missing key. Lets
+        pollers (the serving-loop model reloader) detect no-change
+        without re-downloading the artifact every interval."""
+        try:
+            resp = self.client.head_object(Bucket=self.bucket,
+                                           Key=self._key(key))
+        except Exception as e:
+            if _is_missing(e):
+                raise KeyError(key) from None
+            raise
+        return {
+            "etag": str(resp.get("ETag", "")) or str(
+                resp.get("LastModified", "")),
+            "size": resp.get("ContentLength"),
+        }
 
     def delete(self, key: str) -> None:
         self.client.delete_object(Bucket=self.bucket, Key=self._key(key))

@@ -44,6 +44,25 @@ class TreeEnsemble(NamedTuple):
         return int(self.feat.shape[0])
 
 
+def ftz_safe_thresholds(t32: np.ndarray) -> np.ndarray:
+    """Replace denormal thresholds with their flush-to-zero-safe stand-in.
+
+    XLA (TPU and CPU) flushes f32 denormals to zero in comparisons, so a
+    threshold like ``-1e-45`` — which ``nextafter``-below-0.0 produces —
+    behaves as ``-0.0`` and flips ``x <= thresh`` for ``x == 0.0``
+    exactly. Under FTZ the representable inputs are normals and zero, so
+    the exact stand-ins are: positive denormal → ``0.0`` (x <= denorm ⟺
+    x <= 0), negative denormal → ``-FLT_MIN`` (x <= -denorm ⟺ x < 0 ⟺
+    x <= -smallest-normal). Found by the randomized xgboost-dump parity
+    test (a split_condition of exactly 0.0 routed wrong)."""
+    t32 = np.asarray(t32, dtype=np.float32).copy()
+    tiny = np.float32(np.finfo(np.float32).tiny)
+    denorm = (t32 != 0.0) & (np.abs(t32) < tiny)
+    t32[denorm & (t32 > 0)] = np.float32(0.0)
+    t32[denorm & (t32 < 0)] = -tiny
+    return t32
+
+
 def _f32_round_down(t64: np.ndarray) -> np.ndarray:
     """Round float64 thresholds DOWN to float32 so that for any f32 input x:
     (x <= t32) == (x <= t64) — decisions stay bit-identical to sklearn on
@@ -51,7 +70,7 @@ def _f32_round_down(t64: np.ndarray) -> np.ndarray:
     t32 = t64.astype(np.float32)
     over = t32.astype(np.float64) > t64
     t32[over] = np.nextafter(t32[over], np.float32(-np.inf), dtype=np.float32)
-    return t32
+    return ftz_safe_thresholds(t32)
 
 
 def ensemble_from_sklearn(model, n_features: int) -> TreeEnsemble:

@@ -296,6 +296,14 @@ def _trees_from_xgb_dump(dumps, n_features: int) -> TreeEnsemble:
                 dtype=np.float32)
             left[ti, i] = int(nd["yes"])
             right[ti, i] = int(nd["no"])
+    from real_time_fraud_detection_system_tpu.models.forest import (
+        ftz_safe_thresholds,
+    )
+
+    # nextafter below a condition of exactly 0.0 yields a DENORMAL,
+    # which XLA flushes to zero in comparisons — routing x == 0.0 to the
+    # wrong side. Map denormal thresholds to their FTZ-exact stand-ins.
+    thresh = ftz_safe_thresholds(thresh)
     return TreeEnsemble(
         feat=jnp.asarray(feat),
         thresh=jnp.asarray(thresh),

@@ -740,6 +740,19 @@ class ScoringEngine:
         Returns run stats (rows, batches, throughput, latency percentiles).
         """
         self._ensure_layout()  # cross-width checkpoint restores convert
+        if model_reload is not None and self.online_lr > 0.0:
+            from real_time_fraud_detection_system_tpu.utils import (
+                get_logger,
+            )
+
+            # params are swapped wholesale on reload: any online-SGD
+            # deltas accumulated since the artifact was written are
+            # silently dropped at each swap — the operator must know
+            get_logger("engine").warning(
+                "hot model reload + online SGD (--online-lr > 0): each "
+                "reload overwrites the on-device weights, discarding "
+                "online-learned updates accumulated since the artifact "
+                "was written")
         trigger = (
             self.cfg.runtime.trigger_seconds
             if trigger_seconds is None

@@ -533,3 +533,100 @@ def test_model_reloader_semantics(tmp_path, monkeypatch):
     # kind mismatch refused
     r3 = _make_model_reloader(path, "forest", every_batches=1, log=log)
     assert r3() is None
+
+
+def test_model_reloader_s3_head_gates_get(tmp_path, monkeypatch):
+    """s3:// reload polling: an unchanged artifact costs one HEAD per
+    interval, never a GET — the full download happens only when the
+    ETag/size metadata changed (ADVICE r4: a large model polled at small
+    intervals was re-downloaded every poll)."""
+    import logging
+
+    import jax.numpy as jnp
+    import numpy as np
+    from test_store import FakeS3Client
+
+    import real_time_fraud_detection_system_tpu.io.store as store_mod
+    from real_time_fraud_detection_system_tpu.cli import _make_model_reloader
+    from real_time_fraud_detection_system_tpu.io.artifacts import save_model
+    from real_time_fraud_detection_system_tpu.models.logreg import (
+        LogRegParams,
+    )
+    from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+    from real_time_fraud_detection_system_tpu.models.train import TrainedModel
+
+    def blob(w0) -> bytes:
+        p = tmp_path / "m.npz"
+        save_model(str(p), TrainedModel(
+            kind="logreg",
+            scaler=Scaler(mean=jnp.zeros(15), scale=jnp.ones(15)),
+            params=LogRegParams(w=jnp.full(15, w0), b=jnp.zeros(()))))
+        return p.read_bytes()
+
+    fake = FakeS3Client()
+    fake.objects[("commerce", "model.npz")] = blob(1.0)
+    gets = []
+    orig_get = fake.get_object
+
+    def counting_get(Bucket, Key):
+        gets.append(Key)
+        return orig_get(Bucket=Bucket, Key=Key)
+
+    fake.get_object = counting_get
+
+    real_make = store_mod.make_store
+    monkeypatch.setattr(
+        store_mod, "make_store",
+        lambda url, **kw: real_make(url, client=fake, **kw))
+
+    r = _make_model_reloader("s3://commerce/model.npz", "logreg",
+                             every_batches=1, log=logging.getLogger("t"))
+    got = r()  # first due interval downloads + swaps
+    assert got is not None
+    np.testing.assert_allclose(np.asarray(got[0].w), 1.0)
+    assert len(gets) == 1
+    assert r() is None and r() is None  # unchanged: HEAD-gated, no GET
+    assert len(gets) == 1
+
+    fake.objects[("commerce", "model.npz")] = blob(2.0)
+    got = r()  # metadata changed → one GET + swap
+    assert got is not None
+    np.testing.assert_allclose(np.asarray(got[0].w), 2.0)
+    assert len(gets) == 2
+
+
+def test_import_model_rejects_wrong_feature_order(tmp_path):
+    """A pickle fitted on the same 15 features in a DIFFERENT column
+    order must be refused (it would import cleanly and serve
+    silently-wrong probabilities otherwise; ADVICE r4)."""
+    import pickle
+
+    import numpy as np
+    import pandas as pd
+    from sklearn.linear_model import LogisticRegression
+
+    import real_time_fraud_detection_system_tpu.cli as cli
+    from real_time_fraud_detection_system_tpu.features.spec import (
+        FEATURE_NAMES,
+    )
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(200, 15))
+    y = (x[:, 0] > 0).astype(np.int32)
+
+    shuffled = list(FEATURE_NAMES)[::-1]
+    clf_bad = LogisticRegression(max_iter=200).fit(
+        pd.DataFrame(x, columns=shuffled), y)
+    pkl = tmp_path / "bad.pkl"
+    pkl.write_bytes(pickle.dumps(clf_bad))
+    rc = cli.main(["import-model", "--model-pkl", str(pkl),
+                   "--out-model", str(tmp_path / "m.npz")])
+    assert rc == 2
+
+    clf_ok = LogisticRegression(max_iter=200).fit(
+        pd.DataFrame(x, columns=list(FEATURE_NAMES)), y)
+    pkl2 = tmp_path / "ok.pkl"
+    pkl2.write_bytes(pickle.dumps(clf_ok))
+    rc = cli.main(["import-model", "--model-pkl", str(pkl2),
+                   "--out-model", str(tmp_path / "m2.npz")])
+    assert rc == 0

@@ -72,14 +72,29 @@ def test_gbt_constant_labels():
     assert p.max() < 0.01
 
 
-def test_gbt_matches_xgboost_parity(xy):
-    """Parity against the reference's 5th classifier — XGBClassifier
-    (``model_training.ipynb · cell 50``) — with matched hyperparameters.
-    Skips where xgboost isn't installed (it is not baked into the CI
-    image); runs in any environment with the reference's dependency set
-    (reference ``pyproject.toml:28``)."""
-    xgboost = pytest.importorskip("xgboost")
+import os as _os
 
+_GOLDEN = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                        "data", "xgb_golden.npz")
+
+
+def _golden():
+    """The vendored xgboost fixture (tools/make_xgb_golden.py), or None.
+
+    Generated once in an environment WITH xgboost (the reference's
+    dependency set); with it committed, the parity tests below assert on
+    every run without the dependency."""
+    if not _os.path.isfile(_GOLDEN):
+        return None
+    return np.load(_GOLDEN, allow_pickle=True)
+
+
+def test_gbt_matches_xgboost_parity(xy):
+    """AUC parity against the reference's 5th classifier — XGBClassifier
+    (``model_training.ipynb · cell 50``) — with matched hyperparameters.
+    Runs from the vendored golden (xgboost's recorded AUC on the same
+    seeded split) when present, else live xgboost, else skips with a
+    pointer at the generator tool."""
     xtr, ytr, xte, yte = xy
     m = train_gbt(xtr, ytr, n_trees=60, max_depth=5, learning_rate=0.1,
                   n_bins=64, reg_lambda=1.0, min_child_weight=1.0)
@@ -87,12 +102,20 @@ def test_gbt_matches_xgboost_parity(xy):
         yte, np.asarray(gbt_predict_proba(m, jnp.asarray(xte, jnp.float32)))
     )
 
-    xgb = xgboost.XGBClassifier(
-        n_estimators=60, max_depth=5, learning_rate=0.1,
-        tree_method="hist", max_bin=64, reg_lambda=1.0,
-        min_child_weight=1.0, eval_metric="logloss",
-    ).fit(xtr, ytr)
-    xgb_auc = roc_auc(yte, xgb.predict_proba(xte)[:, 1])
+    g = _golden()
+    if g is not None:
+        xgb_auc = float(g["auc_matched"])
+    else:
+        xgboost = pytest.importorskip(
+            "xgboost",
+            reason="no vendored golden (tools/make_xgb_golden.py) and "
+                   "no xgboost installed")
+        xgb = xgboost.XGBClassifier(
+            n_estimators=60, max_depth=5, learning_rate=0.1,
+            tree_method="hist", max_bin=64, reg_lambda=1.0,
+            min_child_weight=1.0, eval_metric="logloss",
+        ).fit(xtr, ytr)
+        xgb_auc = roc_auc(yte, xgb.predict_proba(xte)[:, 1])
 
     # Same algorithm family, same capacity: AUCs agree within noise.
     assert abs(ours - xgb_auc) < 0.02
@@ -144,22 +167,98 @@ def test_trees_from_xgb_dump_synthetic():
 
 def test_xgboost_model_import_parity(xy):
     """A fitted XGBClassifier served through the TPU GBT path must match
-    xgboost's own predict_proba (skipped without xgboost, like the AUC
-    parity test above)."""
-    xgboost = pytest.importorskip("xgboost")
-
+    xgboost's own predict_proba. Runs from the vendored golden (the
+    fitted model's tree dumps + recorded predictions) when present, else
+    live xgboost, else skips pointing at the generator tool."""
     from real_time_fraud_detection_system_tpu.models.gbt import (
+        GBTModel,
+        _trees_from_xgb_dump,
         gbt_from_xgboost,
         gbt_predict_proba,
     )
 
     xtr, ytr, xte, yte = xy
-    xgb = xgboost.XGBClassifier(
-        n_estimators=30, max_depth=4, learning_rate=0.2,
-        tree_method="hist", eval_metric="logloss",
-    ).fit(xtr, ytr)
-    model = gbt_from_xgboost(xgb, xtr.shape[1])
+    g = _golden()
+    if g is not None:
+        dumps = [str(d) for d in g["import_dumps"]]
+        model = GBTModel(
+            trees=_trees_from_xgb_dump(dumps, xtr.shape[1]),
+            base_score=jnp.float32(float(g["import_base_score"])))
+        theirs = np.asarray(g["import_probs"])
+    else:
+        xgboost = pytest.importorskip(
+            "xgboost",
+            reason="no vendored golden (tools/make_xgb_golden.py) and "
+                   "no xgboost installed")
+        xgb = xgboost.XGBClassifier(
+            n_estimators=30, max_depth=4, learning_rate=0.2,
+            tree_method="hist", eval_metric="logloss",
+        ).fit(xtr, ytr)
+        model = gbt_from_xgboost(xgb, xtr.shape[1])
+        theirs = xgb.predict_proba(np.asarray(xte, np.float32))[:, 1]
     ours = np.asarray(gbt_predict_proba(
         model, jnp.asarray(xte, jnp.float32)))
-    theirs = xgb.predict_proba(np.asarray(xte, np.float32))[:, 1]
     np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-5)
+
+
+def test_xgb_dump_import_matches_independent_evaluator(rng):
+    """Always-on import coverage at realistic scale, xgboost-free: a
+    randomized 40-tree depth-5 dump in xgboost's JSON format is served
+    through the flat-table GEMM path AND evaluated by an independent
+    pure-NumPy descent written from the documented dump semantics
+    (strict ``x < split_condition`` routes to "yes"). Two independent
+    implementations agreeing per-row pins the parser + kernel without
+    the dependency; thresholds are drawn from the same lattice as the
+    query points so exact-equality routing is exercised constantly."""
+    import json
+
+    from real_time_fraud_detection_system_tpu.models.gbt import (
+        GBTModel,
+        _trees_from_xgb_dump,
+        gbt_predict_proba,
+    )
+
+    n_features, depth, n_trees = 15, 5, 40
+    lattice = np.round(np.linspace(-2, 2, 41), 2)
+
+    def mk_tree():
+        nid = [-1]  # per-tree ids, root 0 — xgboost's dump convention
+
+        def mk(d):
+            nid[0] += 1
+            me = nid[0]
+            if d == depth or rng.random() < 0.15:
+                return {"nodeid": me, "leaf": float(rng.normal(0, 0.3))}
+            yes, no = mk(d + 1), mk(d + 1)
+            return {"nodeid": me,
+                    "split": f"f{int(rng.integers(0, n_features))}",
+                    "split_condition": float(rng.choice(lattice)),
+                    "yes": yes["nodeid"], "no": no["nodeid"],
+                    "missing": yes["nodeid"], "children": [yes, no]}
+
+        return mk(0)
+
+    trees = [mk_tree() for _ in range(n_trees)]
+    base = 0.17
+
+    def ref_eval(x):  # independent NumPy descent, row at a time
+        def walk(node, row):
+            if "leaf" in node:
+                return node["leaf"]
+            f = int(node["split"][1:])
+            cond = np.float32(node["split_condition"])
+            child = node["children"][0] if np.float32(row[f]) < cond \
+                else node["children"][1]
+            return walk(child, row)
+
+        logits = base + np.array(
+            [sum(walk(t, row) for t in trees) for row in x])
+        return 1.0 / (1.0 + np.exp(-logits))
+
+    x = rng.choice(lattice, size=(500, n_features)).astype(np.float32)
+    model = GBTModel(
+        trees=_trees_from_xgb_dump([json.dumps(t) for t in trees],
+                                   n_features),
+        base_score=jnp.float32(base))
+    ours = np.asarray(gbt_predict_proba(model, jnp.asarray(x)))
+    np.testing.assert_allclose(ours, ref_eval(x), rtol=1e-5, atol=1e-6)

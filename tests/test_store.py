@@ -36,7 +36,11 @@ class FakeS3Client:
     def head_object(self, Bucket, Key):
         if (Bucket, Key) not in self.objects:
             raise _ClientError("404")
-        return {}
+        body = self.objects[(Bucket, Key)]
+        import hashlib
+
+        return {"ETag": f'"{hashlib.md5(body).hexdigest()}"',
+                "ContentLength": len(body)}
 
     def delete_object(self, Bucket, Key):
         self.objects.pop((Bucket, Key), None)
@@ -83,6 +87,26 @@ def test_store_roundtrip(store):
 def test_store_missing_key_raises_keyerror(store):
     with pytest.raises(KeyError):
         store.get("nope")
+
+
+def test_head_metadata_change_detection(tmp_path):
+    """head(): change metadata without the body, KeyError on missing —
+    both stores, same contract (the model reloader's HEAD gate)."""
+    local = LocalStore(str(tmp_path / "s"))
+    s3 = S3Store("commerce", client=FakeS3Client())
+    for store in (local, s3):
+        with pytest.raises(KeyError):
+            store.head("nope")
+        store.put("m.bin", b"v1-bytes")
+        h1 = store.head("m.bin")
+        assert h1["size"] == len(b"v1-bytes")
+        assert store.head("m.bin")["etag"] == h1["etag"]  # stable
+        import time as _t
+
+        _t.sleep(0.01)  # LocalStore etag is mtime_ns
+        store.put("m.bin", b"v2-bytes!!")
+        h2 = store.head("m.bin")
+        assert (h2["etag"], h2["size"]) != (h1["etag"], h1["size"])
 
 
 def test_make_store_dispatch(tmp_path, monkeypatch):

@@ -1,0 +1,73 @@
+"""Generate the vendored XGBoost golden fixture for tests/test_gbt.py.
+
+Run ONCE in any environment with xgboost installed (the reference's
+dependency set — ``model_training.ipynb · cell 50`` fits XGBClassifier):
+
+    python tools/make_xgb_golden.py
+
+writes ``tests/data/xgb_golden.npz`` containing the fitted model's tree
+dumps, base score, held-out predictions and AUC on the same seeded
+dataset the test suite regenerates. With the fixture committed, the two
+xgboost parity tests assert on every run — no xgboost needed at test
+time; without it they fall back to live xgboost, else skip (this
+sandbox has neither xgboost nor network egress, so the fixture must be
+produced out-of-band).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+
+def dataset():
+    """The exact ``xy`` fixture from tests/test_gbt.py (seeded rng(0))."""
+    rng = np.random.default_rng(0)
+    n, f = 8000, 15
+    x = rng.normal(0, 1, (n, f))
+    logits = np.sin(x[:, 0] * 2) + x[:, 1] * x[:, 2] + 0.5 * x[:, 3] - 1
+    y = (rng.random(n) < 1 / (1 + np.exp(-logits))).astype(np.float64)
+    return x[:6000], y[:6000], x[6000:], y[6000:]
+
+
+def main() -> None:
+    import xgboost
+    from sklearn.metrics import roc_auc_score
+
+    xtr, ytr, xte, yte = dataset()
+    out = {}
+    # Matched-hyperparameter model (test_gbt_matches_xgboost_parity).
+    xgb = xgboost.XGBClassifier(
+        n_estimators=60, max_depth=5, learning_rate=0.1,
+        tree_method="hist", max_bin=64, reg_lambda=1.0,
+        min_child_weight=1.0, eval_metric="logloss",
+    ).fit(xtr, ytr)
+    out["auc_matched"] = roc_auc_score(yte, xgb.predict_proba(xte)[:, 1])
+
+    # Import-parity model (test_xgboost_model_import_parity).
+    xgb2 = xgboost.XGBClassifier(
+        n_estimators=30, max_depth=4, learning_rate=0.2,
+        tree_method="hist", eval_metric="logloss",
+    ).fit(xtr, ytr)
+    booster = xgb2.get_booster()
+    cfg = json.loads(booster.save_config())
+    p0 = float(cfg["learner"]["learner_model_param"]["base_score"])
+    out["import_dumps"] = np.asarray(
+        booster.get_dump(dump_format="json"), dtype=object)
+    out["import_base_score"] = float(np.log(p0 / (1.0 - p0)))
+    out["import_probs"] = xgb2.predict_proba(xte)[:, 1].astype(np.float64)
+    out["xgboost_version"] = str(xgboost.__version__)
+
+    dest = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests", "data", "xgb_golden.npz")
+    os.makedirs(os.path.dirname(dest), exist_ok=True)
+    np.savez_compressed(dest, **out)  # load with allow_pickle=True
+    print(f"wrote {dest}: matched AUC {out['auc_matched']:.4f}, "
+          f"{len(out['import_dumps'])} import trees, "
+          f"xgboost {out['xgboost_version']}")
+
+
+if __name__ == "__main__":
+    main()
