@@ -68,6 +68,25 @@ test:
 integration:
 	$(PY) -m pytest tests/integration/ -v || [ $$? -eq 5 ]  # 5 = all skipped (deps absent)
 
+# one-command wire-level verification: boot the deploy/ stack (where
+# docker exists), then run the integration suite against it with the
+# matching env. `make integration-down` tears the stack down.
+integration-up:
+	@command -v docker >/dev/null 2>&1 || { \
+	  echo "docker not found: boot deploy/docker-compose.yml on a docker" \
+	       "host, or run 'make integration' with services you provide"; \
+	  exit 2; }
+	cd deploy && docker compose up -d --wait \
+	  postgres kafka connect minio createbuckets
+	RTFDS_KAFKA_BOOTSTRAP=localhost:9092 \
+	RTFDS_PG_DSN="dbname=payment user=payment password=payment host=localhost" \
+	RTFDS_S3_BUCKET=commerce RTFDS_S3_ENDPOINT=http://localhost:9000 \
+	AWS_ACCESS_KEY_ID=minio AWS_SECRET_ACCESS_KEY=minio123 \
+	$(PY) -m pytest tests/integration/ -v
+
+integration-down:
+	cd deploy && docker compose down -v
+
 # prove the analyzed Parquet output serves the dashboard queries as SQL
 # (DuckDB when installed, else pyarrow+sqlite), cross-checked vs io/query
 sqlcheck:
@@ -79,4 +98,4 @@ install:
 clean:
 	rm -rf $(OUT)
 
-.PHONY: demo datagen train score run-all query dashboard connectors dryrun bench test integration sqlcheck install clean
+.PHONY: demo datagen train score run-all query dashboard connectors dryrun bench test integration integration-up integration-down sqlcheck install clean

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Superset bring-up: migrate metadata, ensure the admin user, register
+# the Trino connection over the landed output, serve. Mirrors the
+# reference's superset/entrypoint.sh flow with our catalog URI.
+set -e
+
+echo "superset: migrating metadata db"
+superset db upgrade
+
+echo "superset: ensuring admin user"
+superset fab create-admin --username admin --firstname Admin \
+  --lastname User --email admin@localhost.invalid --password admin || true
+
+echo "superset: init"
+superset init
+
+echo "superset: registering trino connection"
+superset set_database_uri -d trino_lakehouse \
+  -u trino://trino@trino:8080/lakehouse/payment || true
+
+echo "superset: serving"
+exec gunicorn --workers 3 --timeout 120 --bind 0.0.0.0:8088 \
+  "superset.app:create_app()"

@@ -1,0 +1,34 @@
+-- One-shot Trino DDL (run by the trino-init service): register the
+-- scorer's landed parquet as an external table, the analyst-facing
+-- analogue of the reference's nessie.payment.analyzed_transactions
+-- (created by its scorer at fraud_detection.py:136-163). Column names
+-- and types mirror io/sink.py::_result_to_columns exactly; re-running
+-- is a no-op (IF NOT EXISTS).
+CREATE SCHEMA IF NOT EXISTS lakehouse.payment;
+
+CREATE TABLE IF NOT EXISTS lakehouse.payment.analyzed_transactions (
+    tx_id BIGINT,
+    tx_datetime_us BIGINT,
+    customer_id BIGINT,
+    terminal_id BIGINT,
+    tx_amount DOUBLE,
+    tx_during_weekend INTEGER,
+    tx_during_night INTEGER,
+    customer_id_nb_tx_1day_window INTEGER,
+    customer_id_avg_amount_1day_window DOUBLE,
+    customer_id_nb_tx_7day_window INTEGER,
+    customer_id_avg_amount_7day_window DOUBLE,
+    customer_id_nb_tx_30day_window INTEGER,
+    customer_id_avg_amount_30day_window DOUBLE,
+    terminal_id_nb_tx_1day_window INTEGER,
+    terminal_id_risk_1day_window DOUBLE,
+    terminal_id_nb_tx_7day_window INTEGER,
+    terminal_id_risk_7day_window DOUBLE,
+    terminal_id_nb_tx_30day_window INTEGER,
+    terminal_id_risk_30day_window DOUBLE,
+    processed_at_us BIGINT,
+    prediction DOUBLE
+) WITH (
+    external_location = 's3://commerce/analyzed',
+    format = 'PARQUET'
+);
