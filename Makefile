@@ -76,8 +76,10 @@ integration-up:
 	  echo "docker not found: boot deploy/docker-compose.yml on a docker" \
 	       "host, or run 'make integration' with services you provide"; \
 	  exit 2; }
-	cd deploy && docker compose up -d --wait \
-	  postgres kafka connect minio createbuckets
+# createbuckets is a one-shot: run it in the foreground (older compose
+# v2 releases mis-handle exited services under --wait)
+	cd deploy && docker compose up -d --wait postgres kafka connect minio \
+	  && docker compose up createbuckets
 	RTFDS_KAFKA_BOOTSTRAP=localhost:9092 \
 	RTFDS_PG_DSN="dbname=payment user=payment password=payment host=localhost" \
 	RTFDS_S3_BUCKET=commerce RTFDS_S3_ENDPOINT=http://localhost:9000 \

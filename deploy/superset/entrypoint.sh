@@ -15,8 +15,16 @@ echo "superset: init"
 superset init
 
 echo "superset: registering trino connection"
-superset set_database_uri -d trino_lakehouse \
-  -u trino://trino@trino:8080/lakehouse/payment || true
+# Click >= 8.1 exposes the command as set-database-uri (underscores
+# become dashes); older images use the underscore form. Loudly warn if
+# both fail instead of silently serving without the advertised
+# connection.
+superset set-database-uri -d trino_lakehouse \
+    -u trino://trino@trino:8080/lakehouse/payment \
+  || superset set_database_uri -d trino_lakehouse \
+    -u trino://trino@trino:8080/lakehouse/payment \
+  || echo "superset: WARNING: could not register the trino_lakehouse" \
+          "connection — add it manually (trino://trino@trino:8080/lakehouse/payment)"
 
 echo "superset: serving"
 exec gunicorn --workers 3 --timeout 120 --bind 0.0.0.0:8088 \
