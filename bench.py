@@ -522,7 +522,11 @@ def _child_main(args) -> None:
 
         def _guarded(key: str, fn) -> None:
             """A failed variant records ITS OWN error key and never
-            clobbers earlier successful measurements."""
+            clobbers earlier successful measurements. Emits a progress
+            line per variant: each costs a compile + 13 big batches over
+            the tunnel, and three back-to-back variants with no output
+            tripped the parent's 420 s settle timer on a slow link."""
+            _progress(f"engine variant {key}")
             try:
                 engine_stats[key] = fn()
             except Exception as e:
