@@ -322,6 +322,68 @@ def test_score_alerts_only_flag(tmp_path):
     assert p.returncode == 2
 
 
+def test_score_emit_threshold_flag(tmp_path):
+    """--emit-threshold P: predictions identical to full emission for
+    every row, feature columns populated only for rows with prob >= P;
+    incompatible combinations fail fast with rc 2."""
+    import subprocess
+    import sys
+
+    import numpy as np
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               RTFDS_BACKEND_PROBE_TIMEOUT="0")
+
+    def cli(*a):
+        return subprocess.run(
+            [sys.executable, "-m",
+             "real_time_fraud_detection_system_tpu.cli", *a],
+            capture_output=True, text=True, cwd=repo, env=env)
+
+    p = cli("datagen", "--out", str(tmp_path / "txs.npz"),
+            "--customers", "60", "--terminals", "120", "--days", "25")
+    assert p.returncode == 0, p.stderr[-500:]
+    p = cli("train", "--data", str(tmp_path / "txs.npz"),
+            "--out-model", str(tmp_path / "m.npz"), "--model", "logreg")
+    assert p.returncode == 0, p.stderr[-500:]
+    common = ("score", "--data", str(tmp_path / "txs.npz"),
+              "--model-file", str(tmp_path / "m.npz"),
+              "--pipeline-depth", "4", "--coalesce-rows", "2048")
+    p = cli(*common, "--out", str(tmp_path / "full"))
+    assert p.returncode == 0, p.stderr[-800:]
+
+    from real_time_fraud_detection_system_tpu.io.query import load_analyzed
+
+    full = load_analyzed(str(tmp_path / "full"))
+    # calibrate on the served distribution (logreg probs are continuous,
+    # so a quantile threshold flags a predictable fraction). 0.97 keeps
+    # ~3% flagged — 2x under the default emit_cap_fraction (1/16), so no
+    # batch overflows into the full-fetch fallback that would put real
+    # features on clean rows
+    thr = float(np.quantile(full["prediction"], 0.97))
+    p = cli(*common, "--out", str(tmp_path / "sel"),
+            "--emit-threshold", repr(thr))
+    assert p.returncode == 0, p.stderr[-800:]
+
+    sel = load_analyzed(str(tmp_path / "sel"))
+    np.testing.assert_array_equal(sel["prediction"], full["prediction"])
+    flagged = full["prediction"] >= thr
+    assert flagged.any() and not flagged.all()
+    feat = "customer_id_nb_tx_7day_window"
+    np.testing.assert_array_equal(sel[feat][flagged], full[feat][flagged])
+    assert np.all(sel[feat][~flagged] == 0)
+
+    # incompatible combinations fail fast with rc 2 (in-process — the
+    # validation runs before any device work, no subprocess needed)
+    for extra in (("--alerts-only",), ("--emit-bf16",),
+                  ("--scorer", "cpu"), ("--emit-threshold", "1.5")):
+        args = list(common) + list(extra)
+        if "--emit-threshold" not in extra:
+            args += ["--emit-threshold", "0.5"]
+        assert cli_main(args) == 2, extra
+
+
 def test_import_model_from_reference_pickles(tmp_path):
     """rtfds import-model: the reference's pickled trained_model.pkl +
     scaler.pkl (sklearn RF + joblib StandardScaler,
