@@ -768,6 +768,7 @@ class ScoringEngine:
         t_start = time.perf_counter()
         rows0 = self.state.rows_done  # report THIS run's throughput, not
         batches0 = self.state.batches_done  # lifetime totals (warmup runs)
+        ovf0 = self.selective_overflows
         from collections import deque
 
         q: deque = deque()  # in-flight batch handles, FIFO
@@ -909,7 +910,7 @@ class ScoringEngine:
         _drain()
         wall = time.perf_counter() - t_start
         lat = np.asarray(latencies) if latencies else np.zeros(1)
-        return {
+        stats = {
             "rows": self.state.rows_done - rows0,
             "batches": self.state.batches_done - batches0,
             "wall_s": wall,
@@ -933,3 +934,10 @@ class ScoringEngine:
             ),
             "pipeline_depth": depth,
         }
+        if self._selective:
+            # per-run delta, like rows/batches — nonzero tells the
+            # operator the threshold/cap calibration is sending full
+            # fetches (correct output, just slower; recalibrate
+            # emit_threshold or raise emit_cap_fraction)
+            stats["selective_overflows"] = self.selective_overflows - ovf0
+        return stats

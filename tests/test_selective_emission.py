@@ -118,6 +118,33 @@ def test_selective_threshold_above_all_probs_emits_zero_features(
     assert eng.selective_overflows == 0
 
 
+def test_run_stats_carry_selective_overflows(cfg, trained):
+    """run() stats expose THIS run's overflow count in selective mode
+    (operator signal for threshold/cap calibration) — a per-run delta
+    like rows/batches, so warmup-then-measure patterns stay honest —
+    and omit the key entirely when selective emission is off."""
+    model, txs = trained
+    eng = ScoringEngine(_with_threshold(cfg, 1e-6, cap=0.001),
+                        kind="forest", params=model.params,
+                        scaler=model.scaler)
+    stats = eng.run(ReplaySource(txs.slice(slice(0, 1200)), START_EPOCH_S,
+                                 batch_rows=512))
+    assert stats["selective_overflows"] == eng.selective_overflows > 0
+    # second run on the same engine: the stat is the run's own count,
+    # not the engine's lifetime total
+    stats2 = eng.run(ReplaySource(txs.slice(slice(0, 600)), START_EPOCH_S,
+                                  batch_rows=512))
+    assert stats2["selective_overflows"] > 0
+    assert (stats["selective_overflows"] + stats2["selective_overflows"]
+            == eng.selective_overflows)
+
+    plain = ScoringEngine(cfg, kind="forest", params=model.params,
+                          scaler=model.scaler)
+    stats = plain.run(ReplaySource(txs.slice(slice(0, 600)), START_EPOCH_S,
+                                   batch_rows=512))
+    assert "selective_overflows" not in stats
+
+
 def test_selective_guards(cfg, trained):
     model, txs = trained
 
