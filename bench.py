@@ -899,10 +899,14 @@ def _child_main(args) -> None:
                 # floor (round 4 used a fixed 8-epoch delta, which on TPU
                 # finished under the threshold and silently dropped the
                 # warm number — the figure the training story owes).
+                _progress(f"train {name} cold")
                 w1 = _timed_fit(fit, 1)
                 train_stats[f"{name}_cold_rows_per_s"] = round(
                     tr_rows / w1, 1)
                 for hi in (41, 201):
+                    # each rung is minutes of silent dispatches on a slow
+                    # link — keep the supervisor's settle timer re-armed
+                    _progress(f"train {name} warm x{hi}")
                     whi = _timed_fit(fit, hi)
                     if whi - w1 > 0.25:
                         train_stats[f"{name}_warm_rows_per_s"] = round(
