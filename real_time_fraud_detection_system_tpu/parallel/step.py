@@ -157,6 +157,22 @@ def _route(
     return dest * b + rank, rank
 
 
+def _make_xchg(axis, n_dev: int, cap: int):
+    """The bucketed all_to_all: [n_dev·cap, ...] laid out owner-major →
+    same shape with bucket b holding what every peer sent to owner b.
+    Its own inverse (routing results back is ``xchg(...)[send_pos]``);
+    carries arbitrary trailing feature dims."""
+
+    def xchg(x):
+        rest = x.shape[1:]
+        return jax.lax.all_to_all(
+            x.reshape((n_dev, cap) + rest), axis, split_axis=0,
+            concat_axis=0, tiled=False,
+        ).reshape((n_dev * cap,) + rest)
+
+    return xchg
+
+
 def owner_route(
     dest: jnp.ndarray,  # int32 [bl] owner device per row
     valid: jnp.ndarray,  # bool [bl]
@@ -164,22 +180,16 @@ def owner_route(
     axis,
     bl: int,
 ):
-    """Bucketed-``all_to_all`` primitives shared by the window, sequence,
-    and expert routed paths: → (send_pos, xchg, scatter).
+    """Bucketed-``all_to_all`` primitives shared by the sequence and
+    expert routed paths: → (send_pos, xchg, scatter).
 
     ``scatter(x)`` lays local rows into the [n_dev × bl, ...] send buffer
-    at their owner bucket; ``xchg`` runs the all_to_all (its own inverse,
-    so routing results back is ``xchg(...)[send_pos]``). Both carry
-    arbitrary trailing feature dims (scalars per row, or [*, D]
-    vectors)."""
+    at their owner bucket; ``xchg`` runs the all_to_all. Buckets are
+    worst-case-sized (``bl`` per pair — any skew fits); the window path
+    (``exchanged_compute``) instead runs capacity-bounded buffers with a
+    skew fallback."""
     send_pos, _ = _route(dest, valid, n_dev)
-
-    def xchg(x):
-        rest = x.shape[1:]
-        return jax.lax.all_to_all(
-            x.reshape((n_dev, bl) + rest), axis, split_axis=0,
-            concat_axis=0, tiled=False,
-        ).reshape((n_dev * bl,) + rest)
+    xchg = _make_xchg(axis, n_dev, bl)
 
     def scatter(x, fill=0):
         buf = jnp.full((n_dev * bl,) + x.shape[1:], fill, dtype=x.dtype)
@@ -249,36 +259,95 @@ def make_sharded_step(
         bl = batch.customer_key.shape[0]
         fraud = jnp.maximum(batch.label, 0).astype(jnp.float32)
 
-        def owner_exchange(key):
+        def exchanged_compute(key, fn, state):
             """Route (key, day, amount, fraud, valid) to the key's owner
-            device; returns received fields + a ``back`` that routes
-            per-row [*, NW] aggregates to the sending rows."""
+            device, run ``fn(state, key, day, amount, fraud, valid) ->
+            (state', mat)`` there, and route ``mat``'s per-row aggregates
+            back to the sending rows: → (state', local_mat [bl, K]).
+
+            Wire format: ONE all_to_all carries the 5 forward fields as
+            a packed [*, 5] uint32 matrix (32-bit fields travel as bit
+            patterns — all_to_all is pure data movement, bitcasts are
+            exact) and ONE carries the result columns back.
+
+            Receive-buffer sizing is the multi-chip scaling lever. A
+            bucketed all_to_all with per-(sender,owner) bucket capacity
+            ``bl`` is always correct but hands every device an
+            [n_dev × bl] buffer — per-device window scatter work then
+            equals a SINGLE chip processing the whole batch, so adding
+            chips stops helping (measured: the virtual-mesh curve decayed
+            ~4× from width 1 → 8). Under the balanced load a uniform key
+            hash delivers, each sender holds only ~bl/n_dev rows per
+            owner — so the common case runs with bucket capacity
+            ``2·ceil(bl/n_dev)`` (2× balanced headroom, receive buffer
+            2·bl regardless of width: per-device work now SHRINKS with
+            width). Skew beyond the headroom (hot terminal) is detected
+            with a psum'd overflow flag — uniform across devices, so the
+            ``lax.cond`` fallback to the always-correct full-capacity
+            exchange takes the same branch everywhere and the collectives
+            inside stay matched. Exactness is never capacity-dependent.
+            """
             if n_dev == 1:
-                # Width-1 mesh: every key is owner-local already. The
-                # generic path's argsort + scatter/gather permutation is
-                # pure overhead here (measured as most of the sharded
-                # engine's 29% single-device tax, round-4 bench
-                # `sharded_1dev`); window updates are permutation-
-                # invariant, so the identity exchange is exact.
-                return (key, batch.day, batch.amount, fraud, batch.valid,
-                        lambda mat: mat)
+                # Width-1 mesh: every key is owner-local already; the
+                # exchange machinery is pure overhead (measured as most
+                # of the round-4 29% single-device tax).
+                return fn(state, key, batch.day, batch.amount, fraud,
+                          batch.valid)
             dest = (key % jnp.uint32(n_dev)).astype(jnp.int32)
-            send_pos, xchg, scatter = owner_route(
-                dest, batch.valid, n_dev, axis, bl)
+            # Rank VALID rows only (invalid rows sort into a trailing
+            # pseudo-bucket): padding never inflates a valid row's rank
+            # into a spurious overflow fallback, never occupies receive
+            # slots, and the compact branch's efficiency stops depending
+            # on partition_batch_spill's valid-rows-first layout.
+            _, rank = _route(
+                jnp.where(batch.valid, dest, n_dev).astype(jnp.int32),
+                batch.valid, n_dev)
+            pk = jnp.stack(
+                [
+                    key,
+                    jax.lax.bitcast_convert_type(batch.day, jnp.uint32),
+                    jax.lax.bitcast_convert_type(
+                        batch.amount, jnp.uint32),
+                    jax.lax.bitcast_convert_type(fraud, jnp.uint32),
+                    batch.valid.astype(jnp.uint32),
+                ],
+                axis=1,
+            )
 
-            r_key = xchg(scatter(key))
-            r_day = xchg(scatter(batch.day))
-            r_amount = xchg(scatter(batch.amount))
-            r_fraud = xchg(scatter(fraud))
-            r_valid = xchg(scatter(batch.valid, fill=False))
+            def run(b_pair):
+                def go(st):
+                    # invalid rows and overflow rows (rank >= b_pair) get
+                    # an out-of-bounds position: scatters DROP them (jax
+                    # semantics), the back-gather clamps — harmless,
+                    # because the capacity branch is only taken when no
+                    # VALID row overflows and invalid rows are masked
+                    # downstream
+                    pos = jnp.where(
+                        batch.valid & (rank < b_pair),
+                        dest * b_pair + rank, n_dev * b_pair)
+                    xchg = _make_xchg(axis, n_dev, b_pair)
+                    r = xchg(jnp.zeros((n_dev * b_pair, 5), jnp.uint32)
+                             .at[pos].set(pk))
+                    st, mat = fn(
+                        st,
+                        r[:, 0],
+                        jax.lax.bitcast_convert_type(r[:, 1], jnp.int32),
+                        jax.lax.bitcast_convert_type(r[:, 2],
+                                                     jnp.float32),
+                        jax.lax.bitcast_convert_type(r[:, 3],
+                                                     jnp.float32),
+                        r[:, 4].astype(bool),
+                    )
+                    return st, xchg(mat)[pos]
 
-            def back(mat):
-                b = jnp.stack(
-                    [xchg(mat[:, i]) for i in range(mat.shape[1])], axis=1
-                )
-                return b[send_pos]
+                return go
 
-            return r_key, r_day, r_amount, r_fraud, r_valid, back
+            cap_pair = min(bl, 2 * -(-bl // n_dev))
+            if cap_pair >= bl:
+                return run(bl)(state)
+            over = (batch.valid & (rank >= cap_pair)).any()
+            over = jax.lax.psum(over.astype(jnp.int32), axis) > 0
+            return jax.lax.cond(over, run(bl), run(cap_pair), state)
 
         # ---- customer velocity ------------------------------------------
         # Owner-local (chunk 0: rows placed by customer % n_dev) or routed
@@ -289,53 +358,60 @@ def make_sharded_step(
             if cms is not None
             else None
         )
+        def customer_fn(st, c_key, c_day, c_amt, c_fraud, c_valid):
+            """Owner-side customer velocity: sketch/window update + query
+            on the rows this device owns; returns [*, 2·NW] aggregates."""
+            local_cms, customer = st
+            if local_cms is not None:
+                local_cms = cms_update(local_cms, c_key, c_amt, c_day,
+                                       c_valid)
+            if use_cms:
+                # BASELINE config 3 × config 5: unbounded-key velocity
+                # from the per-device sketch (each sketch holds only this
+                # device's customers — fewer collisions than one global
+                # sketch).
+                cc, ca = cms_query(local_cms, c_key, c_day, windows)
+            else:
+                c_slot = ((c_key // jnp.uint32(n_dev))
+                          & jnp.uint32(c_cap_local - 1)).astype(jnp.int32)
+                customer = update_windows(
+                    customer, c_slot, c_day, c_amt, c_fraud, c_valid,
+                    track_fraud=False,  # customer features: count+avg
+                )
+                cc, ca, _ = query_windows(customer, c_slot, c_day,
+                                          windows)
+            return (local_cms, customer), jnp.concatenate([cc, ca],
+                                                          axis=1)
+
         if route_customers:
-            c_key, c_day, c_amt, c_fraud, c_valid, c_back = owner_exchange(
-                batch.customer_key
-            )
+            (local_cms, customer), cb = exchanged_compute(
+                batch.customer_key, customer_fn,
+                (local_cms, fstate.customer))
         else:
-            c_key, c_day, c_amt, c_fraud, c_valid = (
-                batch.customer_key, batch.day, batch.amount, fraud,
-                batch.valid,
-            )
+            (local_cms, customer), cb = customer_fn(
+                (local_cms, fstate.customer), batch.customer_key,
+                batch.day, batch.amount, fraud, batch.valid)
+        c_count, c_amount = cb[:, :nw], cb[:, nw:]
         if cms is not None:
-            local_cms = cms_update(local_cms, c_key, c_amt, c_day, c_valid)
             cms = jax.tree.map(lambda x: x[None], local_cms)
-        if use_cms:
-            # BASELINE config 3 × config 5: unbounded-key velocity from the
-            # per-device sketch (each sketch holds only this device's
-            # customers — fewer collisions than one global sketch).
-            customer = fstate.customer
-            cc, ca = cms_query(local_cms, c_key, c_day, windows)
-        else:
-            c_slot = ((c_key // jnp.uint32(n_dev))
-                      & jnp.uint32(c_cap_local - 1)).astype(jnp.int32)
-            customer = update_windows(
-                fstate.customer, c_slot, c_day, c_amt, c_fraud, c_valid,
-                track_fraud=False,  # customer features are count+avg only
-            )
-            cc, ca, _ = query_windows(customer, c_slot, c_day, windows)
-        if route_customers:
-            c_count = c_back(cc)
-            c_amount = c_back(ca)
-        else:
-            c_count, c_amount = cc, ca
 
         # ---- terminal windows: always routed to owner over ICI ----------
-        r_key, r_day, r_amount, r_fraud, r_valid, t_back = owner_exchange(
-            batch.terminal_key
-        )
-        t_slot = ((r_key // jnp.uint32(n_dev))
-                  & jnp.uint32(t_cap_local - 1)).astype(jnp.int32)
-        terminal = update_windows(
-            fstate.terminal, t_slot, r_day, r_amount, r_fraud, r_valid,
-            track_amount=False,  # terminal features are count+risk only
-        )
-        t_count, _, t_fraud = query_windows(
-            terminal, t_slot, r_day, windows, delay=fcfg.delay_days
-        )
-        t_count_l = t_back(t_count)
-        t_fraud_l = t_back(t_fraud)
+        def terminal_fn(terminal, t_key, t_day, t_amt, t_fraud_in,
+                        t_valid):
+            t_slot = ((t_key // jnp.uint32(n_dev))
+                      & jnp.uint32(t_cap_local - 1)).astype(jnp.int32)
+            terminal = update_windows(
+                terminal, t_slot, t_day, t_amt, t_fraud_in, t_valid,
+                track_amount=False,  # terminal features: count+risk
+            )
+            t_count, _, t_fraud = query_windows(
+                terminal, t_slot, t_day, windows, delay=fcfg.delay_days
+            )
+            return terminal, jnp.concatenate([t_count, t_fraud], axis=1)
+
+        terminal, tb = exchanged_compute(
+            batch.terminal_key, terminal_fn, fstate.terminal)
+        t_count_l, t_fraud_l = tb[:, :nw], tb[:, nw:]
 
         # ---- assemble the 15-feature matrix (order = features/spec.py)
         c_avg = jnp.where(c_count > 0, c_amount / jnp.maximum(c_count, 1.0), 0.0)

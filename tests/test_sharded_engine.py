@@ -476,6 +476,47 @@ def test_dense_spill_matches_single_chip(source):
                                rtol=1e-5, atol=1e-4)
 
 
+@pytest.mark.parametrize("skew", ["balanced", "hot_terminal"])
+def test_exchange_capacity_branches_match_single_chip(skew):
+    """The owner exchange's two capacity branches both reproduce
+    single-chip results: balanced terminals ride the 2x-headroom compact
+    buffers (per-device work shrinks with width), a hot terminal
+    overflows the per-pair capacity and takes the psum-uniform fallback
+    to the always-correct full-capacity exchange."""
+    from real_time_fraud_detection_system_tpu.core.batch import US_PER_DAY
+
+    n, rps, n_dev = 256, 32, N_DEV
+    # bl=32, cap_pair = 2*ceil(32/8) = 8: balanced (%97) sends ~4 rows
+    # per (sender, owner) pair -> compact; hot sends all 32 -> fallback
+    rng = np.random.default_rng(5)
+    terminal = (np.full(n, 5, np.int64) if skew == "hot_terminal"
+                else (np.arange(n) % 97).astype(np.int64))
+    cols = {
+        "tx_id": np.arange(n, dtype=np.int64),
+        "tx_datetime_us": np.full(n, 20200, np.int64) * US_PER_DAY
+        + np.arange(n, dtype=np.int64) * 1_000_000,
+        "customer_id": np.arange(n, dtype=np.int64) % 200,
+        "terminal_id": terminal,
+        "tx_amount_cents": rng.integers(100, 30000, n).astype(np.int64),
+        "kafka_ts_ms": np.zeros(n, dtype=np.int64),
+    }
+    cfg = Config(
+        features=FeatureConfig(customer_capacity=512,
+                               terminal_capacity=1024),
+        runtime=RuntimeConfig(batch_buckets=(n,), max_batch_rows=n,
+                              trigger_seconds=0.0))
+    params, scaler = _model()
+
+    single = ScoringEngine(cfg, kind="logreg", params=params,
+                           scaler=scaler).process_batch(cols)
+    res = ShardedScoringEngine(
+        cfg, kind="logreg", params=params, scaler=scaler,
+        n_devices=n_dev, rows_per_shard=rps).process_batch(cols)
+    np.testing.assert_allclose(res.probs, single.probs, atol=1e-6)
+    np.testing.assert_allclose(res.features, single.features,
+                               rtol=1e-5, atol=1e-4)
+
+
 def test_sharded_alerts_only_same_probs_zero_features(small_dataset):
     """emit_features=False on the mesh: identical probabilities, zero
     feature payload (the per-shard feats D2H is skipped)."""
