@@ -457,15 +457,22 @@ def _child_main(args) -> None:
                     t_hi = time.perf_counter() - t0
                     per_step.append((t_hi - t_lo) / (n_hi - n_lo))
                 ps = np.asarray(per_step) * 1e3
+                p50_ms = float(np.percentile(ps, 50))
                 device_latency_by_batch[str(n_rows)] = {
-                    "step_ms_p50": round(float(np.percentile(ps, 50)), 4),
+                    "step_ms_p50": round(p50_ms, 4),
                     "step_ms_max": round(float(ps.max()), 4),
+                    # device-side throughput the chained steps imply —
+                    # what a locally attached host would sustain at this
+                    # batch size (no per-call wire costs). None when the
+                    # differenced timing is jitter-dominated (<= 0).
+                    "device_rows_per_s": (
+                        round(n_rows / (p50_ms / 1e3), 1)
+                        if p50_ms > 0 else None),
                     "chained_n": [n_lo, n_hi],
                     "trials": trials,
                 }
                 _progress(
-                    f"device step size={n_rows} "
-                    f"p50={float(np.percentile(ps, 50)):.3f}ms")
+                    f"device step size={n_rows} p50={p50_ms:.3f}ms")
             except Exception as e:
                 device_latency_by_batch[str(n_rows)] = {
                     "error": f"{type(e).__name__}: {str(e)[:160]}"}
