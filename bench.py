@@ -688,7 +688,11 @@ def _child_main(args) -> None:
                     os.path.dirname(os.path.abspath(__file__)),
                     "tools", "sharded_scaling_bench.py")
                 p = subprocess.Popen(
-                    [sys.executable, tool, "--quick"], env=env,
+                    # 16k rows: big enough that per-shard-program
+                    # dispatch noise stops dominating (the 2k quick
+                    # size wobbles ±40%), ~15 s on one host core
+                    [sys.executable, tool, "--rows", "16384",
+                     "--batches", "3"], env=env,
                     stdout=subprocess.PIPE, stderr=subprocess.PIPE,
                     text=True)
                 t0 = time.monotonic()
