@@ -302,18 +302,36 @@ def _make_model_reloader(path: str, kind: str, every_batches: int, log):
                 store = make_store(url)
                 # Change-gate on HEAD metadata (ETag/size) so an
                 # unchanged artifact costs one HEAD per interval, not a
-                # full GET; digest only when metadata says it changed.
+                # full GET. When metadata says it changed, the STORED
+                # signature comes from the GET response itself
+                # (get_with_meta) so it always describes the bytes
+                # actually loaded — a pre-GET HEAD sig could belong to an
+                # older version overwritten between the two requests
+                # (safe direction, but one redundant swap per overwrite).
                 # Stores without head() (older fakes) fall back to the
                 # GET+digest gate.
                 head = getattr(store, "head", None)
+                get_with_meta = getattr(store, "get_with_meta", None)
                 meta = head(key) if head is not None else {}
-                if meta.get("etag") or meta.get("size") is not None:
-                    sig = f"{meta.get('etag')}:{meta.get('size')}"
+
+                def _meta_sig(md):
+                    if md.get("etag") or md.get("size") is not None:
+                        return f"{md.get('etag')}:{md.get('size')}"
+                    return None
+
+                sig = _meta_sig(meta)
+                if sig is not None:
                     if state["sig"] is not None and sig == state["sig"]:
                         return None
-                    data = store.get(key)
+                    if get_with_meta is not None:
+                        data, gmeta = get_with_meta(key)
+                        sig = _meta_sig(gmeta) or sig
+                    else:
+                        data = store.get(key)
                 else:
-                    # no head() or degenerate metadata: digest-gate
+                    # no head() or degenerate metadata: digest-gate (the
+                    # digest is computed from the loaded bytes, so it is
+                    # always self-consistent)
                     data = store.get(key)
                     sig = hashlib.sha256(data).hexdigest()
                     if state["sig"] is not None and sig == state["sig"]:
@@ -568,6 +586,37 @@ def cmd_score(args) -> int:
             "--max-batches for a usable trace"
         )
 
+    server = None
+    recorder = None
+    if args.metrics_port or args.flight_record:
+        from real_time_fraud_detection_system_tpu.utils.metrics import (
+            FlightRecorder,
+            MetricsServer,
+            run_manifest,
+            set_active_recorder,
+        )
+    if args.metrics_port:
+        # Opt-in ops endpoints for the serve loop: /metrics (Prometheus
+        # text), /metrics.json, /healthz (source lag + last-batch-age).
+        # 0.0.0.0 so a scrape sidecar / probe can reach it in-container.
+        server = MetricsServer(
+            port=args.metrics_port, host="0.0.0.0",
+            max_batch_age_s=args.healthz_max_batch_age,
+            max_source_lag_rows=args.healthz_max_lag_rows or None)
+        server.start()
+        log.info("telemetry: /metrics /metrics.json /healthz on port %d",
+                 server.port)
+    if args.flight_record:
+        recorder = FlightRecorder(
+            args.flight_record,
+            manifest=run_manifest(
+                cfg=cfg, model_kind=model.kind, scorer=args.scorer,
+                source=args.source, devices=args.devices))
+        # process-wide: the engine loop, checkpointer, supervisor, and
+        # fault injectors all append to this run's record
+        set_active_recorder(recorder)
+        log.info("flight record: %s", args.flight_record)
+
     fb = None
     try:
         with profile_to(args.trace_dir or None):
@@ -609,6 +658,11 @@ def cmd_score(args) -> int:
             close()
         if fb is not None:
             fb.close()
+        if recorder is not None:
+            set_active_recorder(None)
+            recorder.close()
+        if server is not None:
+            server.stop()
     if raw_table is not None:
         raw_table.flush()
         stats["raw_tx_rows"] = len(raw_table)
@@ -973,17 +1027,31 @@ def cmd_dashboard(args) -> int:
     """Render the static-HTML ops dashboard (the Superset role)."""
     from real_time_fraud_detection_system_tpu.io.dashboard import (
         write_dashboard,
+        write_ops_dashboard,
     )
 
+    if bool(args.data) == bool(args.flight_record):
+        # exactly one input: each view is a full page written to --out,
+        # so taking both would silently drop one of them
+        print(_json_line(
+            {"error": "pass exactly one of --data (analyzed view) or "
+                      "--flight-record (ops-health view); render them "
+                      "to separate --out files"}))
+        return 2
     try:
-        manifest = write_dashboard(
-            args.data,
-            args.out,
-            threshold=args.threshold,
-            top_k=args.top_k,
-            bucket=args.bucket,
-            title=args.title,
-        )
+        if args.flight_record:
+            # Ops-health view over the serving run's flight record.
+            manifest = write_ops_dashboard(
+                args.flight_record, args.out, title=args.title)
+        else:
+            manifest = write_dashboard(
+                args.data,
+                args.out,
+                threshold=args.threshold,
+                top_k=args.top_k,
+                bucket=args.bucket,
+                title=args.title,
+            )
     except FileNotFoundError as e:
         print(_json_line({"error": str(e)}))
         return 2
@@ -1286,6 +1354,22 @@ def main(argv=None) -> int:
     p.add_argument("--trace-dir", default="",
                    help="capture a jax.profiler/TensorBoard trace of the "
                         "serving run into this directory")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="serve /metrics (Prometheus text), /metrics.json "
+                        "and /healthz on this port while scoring "
+                        "(0 = off)")
+    p.add_argument("--healthz-max-batch-age", type=float, default=300.0,
+                   help="/healthz goes 503 when the last finished batch "
+                        "is older than this many seconds")
+    p.add_argument("--healthz-max-lag-rows", type=float, default=0.0,
+                   help="/healthz goes 503 when the source backlog "
+                        "(rtfds_source_lag_rows) exceeds this many rows "
+                        "(0 = lag check off)")
+    p.add_argument("--flight-record", default="",
+                   help="append one JSONL record per micro-batch (per-"
+                        "phase timings, queue depth) plus checkpoint/"
+                        "feedback/fault events to this file; render it "
+                        "with `rtfds dashboard --flight-record`")
     p.set_defaults(fn=cmd_score)
 
     p = sub.add_parser("demo",
@@ -1372,8 +1456,12 @@ def main(argv=None) -> int:
         "dashboard",
         help="render the static-HTML ops dashboard (the Superset role)",
     )
-    p.add_argument("--data", required=True,
+    p.add_argument("--data", default="",
                    help="analyzed output directory (ParquetSink)")
+    p.add_argument("--flight-record", default="",
+                   help="render the ops-health view from a flight-record "
+                        "JSONL (per-phase latency series + event strip) "
+                        "instead of the analyzed-output view")
     p.add_argument("--out", default="dashboard.html")
     p.add_argument("--threshold", type=float, default=0.5)
     p.add_argument("--top-k", type=int, default=10)

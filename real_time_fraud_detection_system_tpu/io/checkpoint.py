@@ -16,10 +16,36 @@ from __future__ import annotations
 import io as _io
 import json
 import os
+import time
 from typing import Optional
 
 import jax
 import numpy as np
+
+from real_time_fraud_detection_system_tpu.utils.metrics import (
+    active_recorder,
+    get_registry,
+)
+
+
+def _observe_checkpoint(op: str, backend: str, t0: float, nbytes: int,
+                        batches_done: int) -> None:
+    """Shared save/restore instrumentation + the flight-record event a
+    checkpoint IS (the exactly-once fence every replay reasons from)."""
+    dt = time.perf_counter() - t0
+    reg = get_registry()
+    reg.histogram("rtfds_checkpoint_seconds",
+                  "checkpoint save/restore wall time", op=op,
+                  backend=backend).observe(dt)
+    reg.counter("rtfds_checkpoint_ops_total", "checkpoint operations",
+                op=op, backend=backend).inc()
+    if nbytes:
+        reg.gauge("rtfds_checkpoint_bytes",
+                  "size of the last checkpoint").set(nbytes)
+    rec = active_recorder()
+    if rec is not None:
+        rec.record_event("checkpoint", op=op, batches_done=batches_done,
+                         bytes=nbytes, seconds=round(dt, 6))
 
 
 def write_state_npz(fileobj, engine_state) -> None:
@@ -103,12 +129,16 @@ class Checkpointer:
         return os.path.join(self.directory, f"ckpt-{step:010d}.npz")
 
     def save(self, engine_state) -> str:
+        t0 = time.perf_counter()
         path = self._path(engine_state.batches_done)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             write_state_npz(f, engine_state)  # streamed, no bytes copy
+        nbytes = os.path.getsize(tmp)
         os.replace(tmp, path)  # atomic on POSIX
         self._gc()
+        _observe_checkpoint("save", "local", t0, nbytes,
+                            int(engine_state.batches_done))
         return path
 
     def list_checkpoints(self) -> list:
@@ -147,8 +177,13 @@ class Checkpointer:
         path = path or self.latest()
         if path is None:
             return None
+        t0 = time.perf_counter()
+        nbytes = os.path.getsize(path)
         with open(path, "rb") as f:
-            return read_state_npz(f, engine_state)
+            out = read_state_npz(f, engine_state)
+        _observe_checkpoint("restore", "local", t0, nbytes,
+                            int(out.batches_done))
+        return out
 
     def _gc(self) -> None:
         for p in self.list_checkpoints()[: -self.keep]:
@@ -185,10 +220,14 @@ class StoreCheckpointer:
         ]
 
     def save(self, engine_state) -> str:
+        t0 = time.perf_counter()
         key = self._key(engine_state.batches_done)
-        self.store.put(key, state_to_bytes(engine_state))
+        data = state_to_bytes(engine_state)
+        self.store.put(key, data)
         for old in sorted(self._list())[: -self.keep]:
             self.store.delete(old)
+        _observe_checkpoint("save", "store", t0, len(data),
+                            int(engine_state.batches_done))
         return key
 
     def list_checkpoints(self) -> list:
@@ -230,7 +269,12 @@ class StoreCheckpointer:
         key = path or self.latest()
         if key is None:
             return None
-        return bytes_to_state(self.store.get(key), engine_state)
+        t0 = time.perf_counter()
+        data = self.store.get(key)
+        out = bytes_to_state(data, engine_state)
+        _observe_checkpoint("restore", "store", t0, len(data),
+                            int(out.batches_done))
+        return out
 
 
 def make_checkpointer(path_or_url: str, keep: int = 3):

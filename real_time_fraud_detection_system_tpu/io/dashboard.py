@@ -437,6 +437,216 @@ def render_dashboard_html(
     return "".join(parts)
 
 
+# ---------------------------------------------------------------------------
+# Ops-health view: the flight-record twin of the analyzed-output dashboard
+# ---------------------------------------------------------------------------
+
+# Engine loop-time decomposition, in pipeline order (matches
+# runtime.engine.PHASES; duplicated here so the io layer renders flight
+# records from any producer without importing the runtime).
+_OPS_PHASES = ("source_poll", "host_prep", "dispatch", "result_wait",
+               "sink_write")
+
+_EVENT_CLASS = {"fault": "serious", "restart": "serious",
+                "checkpoint": "info", "feedback": "good"}
+
+
+def _downsample_max(ys: np.ndarray, limit: int = 240):
+    """Aggregate to <= limit points by windowed MAX (spikes — the thing
+    an ops view exists to show — survive; means would flatten them).
+    Returns (values, window) where window is the batches-per-point."""
+    n = len(ys)
+    if n <= limit:
+        return ys, 1
+    w = -(-n // limit)
+    pad = (-n) % w
+    padded = np.concatenate([ys, np.full(pad, -np.inf)]) if pad else ys
+    return padded.reshape(-1, w).max(axis=1), w
+
+
+def _event_strip(events: List[dict], t0: float, t1: float) -> str:
+    """Fault/feedback/checkpoint/restart markers on the run's time axis."""
+    if not events:
+        return "<p class='empty'>no events</p>"
+    h = 46
+    span = max(t1 - t0, 1e-9)
+    marks = []
+    for ev in events:
+        # clamp: events outside the batch span (e.g. a checkpoint
+        # restore before the first batch finished) stay on-axis
+        frac = min(max((float(ev.get("t", t0)) - t0) / span, 0.0), 1.0)
+        x = _PAD_L + (_W - _PAD_L - _PAD_R) * frac
+        kind = str(ev.get("event", "?"))
+        cls = _EVENT_CLASS.get(kind, "info")
+        detail = ", ".join(
+            f"{k}={v}" for k, v in ev.items()
+            if k not in ("kind", "t", "event"))
+        tip = f"{kind}" + (f" ({detail})" if detail else "")
+        marks.append(
+            f"<line class='ev {cls}' x1='{x:.1f}' y1='8' x2='{x:.1f}' "
+            f"y2='{h - 16}'/>"
+            f"<rect class='hit' x='{x - 5:.1f}' y='0' width='10' "
+            f"height='{h}' tabindex='0' data-tip='{_esc(tip)}'></rect>"
+        )
+    axis = (f"<line class='axis' x1='{_PAD_L}' y1='{h - 14}' "
+            f"x2='{_W - _PAD_R}' y2='{h - 14}'/>")
+    return (f"<svg viewBox='0 0 {_W} {h}' role='img'>{axis}"
+            + "".join(marks) + "</svg>")
+
+
+def render_ops_html(
+    manifest: Optional[dict],
+    records: List[dict],
+    *,
+    title: str = "Fraud detection — ops health",
+) -> str:
+    """Render the flight-record ops view: run manifest tiles, per-phase
+    latency time series (one chart per phase, batch-indexed), and the
+    fault/feedback/checkpoint/restart event strip."""
+    batches = [r for r in records if r.get("kind") == "batch"]
+    events = [r for r in records if r.get("kind") == "event"]
+    gen = time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime())
+    man = manifest or {}
+    meta_bits = [f"generated {gen}"]
+    for k in ("backend", "model_kind", "n_devices", "config_hash"):
+        if man.get(k) not in (None, ""):
+            meta_bits.append(f"{k} {man[k]}")
+    parts = [
+        "<!doctype html><html><head><meta charset='utf-8'>",
+        f"<title>{_esc(title)}</title>",
+        "<meta name='viewport' content='width=device-width, "
+        "initial-scale=1'>",
+        f"<style>{_CSS}"
+        ".ev { stroke-width: 2; }"
+        ".ev.serious { stroke: var(--st-serious); }"
+        ".ev.good { stroke: var(--st-good); }"
+        ".ev.info { stroke: var(--s1); }"
+        "</style></head><body class='viz'>",
+        f"<h1>{_esc(title)}</h1>",
+        f"<div class='meta'>{_esc(' · '.join(meta_bits))}</div>",
+    ]
+    if not batches:
+        # A run that died before its first batch completed is exactly
+        # where the event strip matters most (the fault/restart events
+        # explain the death) — render them even with no batch records.
+        parts.append("<p class='empty'>no batch records</p>")
+        if events:
+            t0 = float(events[0].get("t", 0.0))
+            t1 = float(events[-1].get("t", t0))
+            ev_twin = _table_twin(
+                ("time", "event", "detail"),
+                [(_ts_label(int(float(e.get("t", t0)) * _US)),
+                  str(e.get("event", "?")),
+                  ", ".join(f"{k}={v}" for k, v in e.items()
+                            if k not in ("kind", "t", "event")))
+                 for e in events])
+            parts += [
+                "<div class='cards'><div class='card'><h2>Events"
+                "</h2>", _event_strip(events, t0, t1), ev_twin,
+                "</div></div>",
+            ]
+        parts += [f"<div id='tip'></div><script>{_JS}</script>"
+                  "</body></html>"]
+        return "".join(parts)
+
+    rows_total = sum(int(b.get("rows", 0)) for b in batches)
+    lat = np.asarray([float(b.get("latency_s", 0.0)) for b in batches])
+    t_first = float(batches[0].get("t", 0.0))
+    t_last = float(batches[-1].get("t", t_first))
+    span_s = t_last - t_first
+    if span_s <= 0:
+        # single-batch record: timestamps carry no span — fall back to
+        # the batches' own latency rather than headline nonsense
+        span_s = float(lat.sum())
+    throughput = (f"{_compact(rows_total / span_s)}/s" if span_s > 0
+                  else "—")
+    n_faults = sum(1 for e in events if e.get("event") == "fault")
+    n_restarts = sum(1 for e in events if e.get("event") == "restart")
+    tiles = [
+        ("Batches", _compact(len(batches)), ""),
+        ("Rows", _compact(rows_total), ""),
+        ("Throughput", throughput, "rows over the record span"),
+        ("Batch p50", f"{np.percentile(lat, 50) * 1e3:.2f} ms",
+         f"p99 {np.percentile(lat, 99) * 1e3:.2f} ms"),
+        ("Faults injected", _compact(n_faults),
+         f"{n_restarts} restarts" if n_restarts else ""),
+        ("Checkpoints", _compact(sum(
+            1 for e in events if e.get("event") == "checkpoint"
+            and e.get("op") == "save")), ""),
+    ]
+    tile_html = []
+    for label, value, sub in tiles:
+        subdiv = f"<div class='sub'>{_esc(sub)}</div>" if sub else ""
+        tile_html.append(
+            f"<div class='tile'><div class='lbl'>{_esc(label)}</div>"
+            f"<div class='num'>{_esc(value)}</div>{subdiv}</div>")
+    parts.append("<div class='tiles'>" + "".join(tile_html) + "</div>")
+
+    parts.append("<div class='cards'>")
+    idx = [str(int(b.get("batch", i))) for i, b in enumerate(batches)]
+    for phase in _OPS_PHASES:
+        ys_ms = np.asarray([
+            1e3 * float(b.get("phases", {}).get(phase, 0.0))
+            for b in batches
+        ])
+        if not ys_ms.any():
+            continue  # e.g. sink_write with no sink attached
+        ds, w = _downsample_max(ys_ms)
+        labels = [idx[min(i * w, len(idx) - 1)] for i in range(len(ds))]
+        note = f" (max per {w} batches)" if w > 1 else ""
+        twin = _table_twin(
+            ("batch", f"{phase} ms"),
+            [(labels[i], f"{ds[i]:.3f}") for i in range(len(ds))])
+        parts += [
+            f"<div class='card'><h2>{_esc(phase)} per batch{_esc(note)}"
+            "</h2>",
+            _line_chart(labels, ds, unit=" ms"),
+            twin, "</div>",
+        ]
+    # event strip + table twin (values never color-gated)
+    ev_twin = _table_twin(
+        ("time", "event", "detail"),
+        [(_ts_label(int(float(e.get("t", t_first)) * _US)),
+          str(e.get("event", "?")),
+          ", ".join(f"{k}={v}" for k, v in e.items()
+                    if k not in ("kind", "t", "event")))
+         for e in events]) if events else ""
+    parts += [
+        "<div class='card'><h2>Events (faults · feedback · checkpoints "
+        "· restarts)</h2>",
+        _event_strip(events, t_first, t_last),
+        ev_twin, "</div>",
+        "</div>",
+        f"<div id='tip'></div><script>{_JS}</script></body></html>",
+    ]
+    return "".join(parts)
+
+
+def write_ops_dashboard(
+    flight_path: str,
+    out_path: str,
+    *,
+    title: Optional[str] = None,
+) -> dict:
+    """Load a flight-record JSONL and write the ops-health dashboard."""
+    from real_time_fraud_detection_system_tpu.utils.metrics import (
+        FlightRecorder,
+    )
+
+    manifest, records = FlightRecorder.read(flight_path)
+    htm = render_ops_html(
+        manifest, records,
+        title=title or "Fraud detection — ops health")
+    with open(out_path, "w", encoding="utf-8") as f:
+        f.write(htm)
+    return {
+        "dashboard": out_path,
+        "batches": sum(1 for r in records if r.get("kind") == "batch"),
+        "events": sum(1 for r in records if r.get("kind") == "event"),
+        "bytes": len(htm.encode()),
+    }
+
+
 def write_dashboard(
     analyzed_dir: str,
     out_path: str,

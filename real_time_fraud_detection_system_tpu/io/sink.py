@@ -23,6 +23,30 @@ from typing import List, Optional
 import numpy as np
 
 from real_time_fraud_detection_system_tpu.features.spec import FEATURE_NAMES
+from real_time_fraud_detection_system_tpu.utils.metrics import get_registry
+
+
+class _SinkTelemetry:
+    """Shared sink instrumentation: write latency, rows, bytes, failures
+    (labeled by sink kind). Series resolve once per sink instance."""
+
+    def _init_sink_metrics(self, sink_kind: str) -> None:
+        reg = get_registry()
+        self._m_write = reg.histogram(
+            "rtfds_sink_write_seconds", "sink append wall time",
+            sink=sink_kind)
+        self._m_rows = reg.counter(
+            "rtfds_sink_rows_total", "rows written", sink=sink_kind)
+        self._m_bytes = reg.counter(
+            "rtfds_sink_bytes_total", "bytes written", sink=sink_kind)
+        self._m_failures = reg.counter(
+            "rtfds_sink_failures_total", "failed appends", sink=sink_kind)
+
+    def _observe_write(self, t0: float, rows: int, nbytes: int) -> None:
+        self._m_write.observe(time.perf_counter() - t0)
+        self._m_rows.inc(rows)
+        if nbytes:
+            self._m_bytes.inc(nbytes)
 
 
 def _result_to_columns(res) -> dict:
@@ -125,7 +149,7 @@ def _part_order(name: str):
     return (1, 0, name)
 
 
-class ParquetSink:
+class ParquetSink(_SinkTelemetry):
     """One part file per batch: ``<dir>/part-<batch_index>.parquet``.
 
     Exactly-once across crash-replay: part files are named by the
@@ -142,23 +166,32 @@ class ParquetSink:
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
         self._seq = 0
+        self._init_sink_metrics("parquet")
 
     def append(self, res) -> None:
         import pyarrow as pa
         import pyarrow.parquet as pq
 
-        cols = _result_to_columns(res)
-        table = pa.table({k: pa.array(v) for k, v in cols.items()})
-        idx = getattr(res, "batch_index", -1)
-        if idx >= 0:
-            name = f"part-{idx:08d}.parquet"
-        else:
-            name = f"part-{int(time.time() * 1e3)}-{self._seq:06d}.parquet"
-            self._seq += 1
-        path = os.path.join(self.directory, name)
-        tmp = path + ".tmp"
-        pq.write_table(table, tmp)
-        os.replace(tmp, path)
+        t0 = time.perf_counter()
+        try:
+            cols = _result_to_columns(res)
+            table = pa.table({k: pa.array(v) for k, v in cols.items()})
+            idx = getattr(res, "batch_index", -1)
+            if idx >= 0:
+                name = f"part-{idx:08d}.parquet"
+            else:
+                name = (f"part-{int(time.time() * 1e3)}-"
+                        f"{self._seq:06d}.parquet")
+                self._seq += 1
+            path = os.path.join(self.directory, name)
+            tmp = path + ".tmp"
+            pq.write_table(table, tmp)
+            nbytes = os.path.getsize(tmp)
+            os.replace(tmp, path)
+        except Exception:
+            self._m_failures.inc()
+            raise
+        self._observe_write(t0, len(res.tx_id), nbytes)
 
     def truncate_after(self, batch_index: int) -> None:
         """Drop indexed parts beyond ``batch_index`` — the sink-side
@@ -190,7 +223,7 @@ class ParquetSink:
         return {c: table[c].to_numpy() for c in table.column_names}
 
 
-class StoreParquetSink:
+class StoreParquetSink(_SinkTelemetry):
     """:class:`ParquetSink` semantics over an object store (S3/MinIO).
 
     The reference lands all streaming output on MinIO
@@ -206,22 +239,31 @@ class StoreParquetSink:
     def __init__(self, store):
         self.store = store
         self._seq = 0
+        self._init_sink_metrics("store_parquet")
 
     def append(self, res) -> None:
         import pyarrow as pa
         import pyarrow.parquet as pq
 
-        cols = _result_to_columns(res)
-        table = pa.table({k: pa.array(v) for k, v in cols.items()})
-        idx = getattr(res, "batch_index", -1)
-        if idx >= 0:
-            name = f"part-{idx:08d}.parquet"
-        else:
-            name = f"part-{int(time.time() * 1e3)}-{self._seq:06d}.parquet"
-            self._seq += 1
-        buf = pa.BufferOutputStream()
-        pq.write_table(table, buf)
-        self.store.put(name, buf.getvalue().to_pybytes())
+        t0 = time.perf_counter()
+        try:
+            cols = _result_to_columns(res)
+            table = pa.table({k: pa.array(v) for k, v in cols.items()})
+            idx = getattr(res, "batch_index", -1)
+            if idx >= 0:
+                name = f"part-{idx:08d}.parquet"
+            else:
+                name = (f"part-{int(time.time() * 1e3)}-"
+                        f"{self._seq:06d}.parquet")
+                self._seq += 1
+            buf = pa.BufferOutputStream()
+            pq.write_table(table, buf)
+            data = buf.getvalue().to_pybytes()
+            self.store.put(name, data)
+        except Exception:
+            self._m_failures.inc()
+            raise
+        self._observe_write(t0, len(res.tx_id), len(data))
 
     def truncate_after(self, batch_index: int) -> None:
         for key in self.store.list(""):
@@ -259,7 +301,7 @@ def make_parquet_sink(path_or_url: str, **store_kwargs):
     return ParquetSink(path_or_url)
 
 
-class IcebergSink:
+class IcebergSink(_SinkTelemetry):
     """Append scored rows to an Iceberg ``analyzed_transactions`` table.
 
     The reference's scorer streams into ``nessie.payment.
@@ -281,6 +323,7 @@ class IcebergSink:
         self.catalog = catalog
         self.table_name = table_name
         self.table = self._load_or_create(catalog, table_name)
+        self._init_sink_metrics("iceberg")
 
     @staticmethod
     def arrow_schema():
@@ -339,7 +382,14 @@ class IcebergSink:
         return pa.table(dict(zip(names, arrays)))
 
     def append(self, res) -> None:
-        self.table.append(self._to_arrow(res))
+        t0 = time.perf_counter()
+        try:
+            tbl = self._to_arrow(res)
+            self.table.append(tbl)
+        except Exception:
+            self._m_failures.inc()
+            raise
+        self._observe_write(t0, len(res.tx_id), tbl.nbytes)
 
 
 def make_iceberg_sink(

@@ -53,6 +53,21 @@ class LocalStore:
         except FileNotFoundError:
             raise KeyError(key) from None
 
+    def get_with_meta(self, key: str) -> "tuple[bytes, dict]":
+        """Body + change-detection metadata from ONE consistent read: the
+        open fd is fstat'ed before reading, so under atomic-replace
+        writers (:meth:`put`) the etag always describes the bytes
+        returned — the gate the serving-loop model reloader needs (a
+        separate HEAD before or after the GET can describe a different
+        object version)."""
+        try:
+            with open(self._path(key), "rb") as f:
+                st = os.fstat(f.fileno())
+                return f.read(), {"etag": str(st.st_mtime_ns),
+                                  "size": st.st_size}
+        except FileNotFoundError:
+            raise KeyError(key) from None
+
     def exists(self, key: str) -> bool:
         return os.path.isfile(self._path(key))
 
@@ -145,6 +160,29 @@ class S3Store:
             raise
         body = obj["Body"]
         return body.read() if hasattr(body, "read") else body
+
+    def get_with_meta(self, key: str) -> "tuple[bytes, dict]":
+        """Body + metadata from the SAME GetObject response — the etag is
+        guaranteed to describe the returned bytes even if the key is
+        overwritten concurrently (S3 GETs are atomic per version)."""
+        try:
+            obj = self.client.get_object(Bucket=self.bucket,
+                                         Key=self._key(key))
+        except Exception as e:
+            if _is_missing(e):
+                raise KeyError(key) from None
+            raise
+        body = obj["Body"]
+        data = body.read() if hasattr(body, "read") else body
+        # EXACTLY head()'s extraction: callers gate on sig equality
+        # across the two methods, so a response without metadata (some
+        # fakes) must degrade to the same empty/None shape head() never
+        # produces differently — not to a fabricated signature.
+        return data, {
+            "etag": str(obj.get("ETag", "")) or str(
+                obj.get("LastModified", "")),
+            "size": obj.get("ContentLength"),
+        }
 
     def exists(self, key: str) -> bool:
         try:

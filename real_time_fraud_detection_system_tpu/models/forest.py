@@ -54,7 +54,17 @@ def ftz_safe_thresholds(t32: np.ndarray) -> np.ndarray:
     the exact stand-ins are: positive denormal → ``0.0`` (x <= denorm ⟺
     x <= 0), negative denormal → ``-FLT_MIN`` (x <= -denorm ⟺ x < 0 ⟺
     x <= -smallest-normal). Found by the randomized xgboost-dump parity
-    test (a split_condition of exactly 0.0 routed wrong)."""
+    test (a split_condition of exactly 0.0 routed wrong).
+
+    Caveat (non-FTZ backends): the stand-ins are exact only when the
+    comparison INPUTS are normals or zero — true under FTZ, where
+    denormal features cannot reach the comparator. On a backend that
+    does NOT flush denormals in comparisons, a denormal input
+    ``x ∈ (-FLT_MIN, 0)`` routes differently against the ``-FLT_MIN``
+    stand-in (``x <= -FLT_MIN`` is False though ``x < 0``) than it did
+    against the original ``nextafter`` threshold. Accepted tradeoff: the
+    engineered features (counts, averages of cent-quantized amounts,
+    risk ratios) make denormal inputs practically impossible."""
     t32 = np.asarray(t32, dtype=np.float32).copy()
     tiny = np.float32(np.finfo(np.float32).tiny)
     denorm = (t32 != 0.0) & (np.abs(t32) < tiny)

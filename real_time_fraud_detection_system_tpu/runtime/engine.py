@@ -58,6 +58,18 @@ from real_time_fraud_detection_system_tpu.core import native
 from real_time_fraud_detection_system_tpu.ops.dedup import (
     latest_wins_mask_host,
 )
+from real_time_fraud_detection_system_tpu.utils.metrics import (
+    active_recorder,
+    get_registry,
+)
+from real_time_fraud_detection_system_tpu.utils.timing import LatencyTracker
+
+# The per-batch loop-time decomposition every layer reports under
+# (rtfds_phase_seconds{phase=...} and the flight record's "phases" dict):
+# source poll → host prep (dedup+pack) → dispatch (H2D + jit call) →
+# result wait (device compute minus overlap + unpack) → sink write.
+PHASES = ("source_poll", "host_prep", "dispatch", "result_wait",
+          "sink_write")
 
 
 def device_params_for(kind: str, params):
@@ -163,12 +175,14 @@ class ScoringEngine:
         cpu_model=None,
         online_lr: float = 0.0,
         feature_cache=None,
+        metrics=None,
     ):
         self.cfg = cfg
         self.kind = kind
         self.scorer = scorer or cfg.runtime.scorer
         self.cpu_model = cpu_model
         self.online_lr = online_lr
+        self._init_telemetry(metrics)
         if cfg.runtime.emit_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"emit_dtype must be float32|bfloat16, "
@@ -333,6 +347,32 @@ class ScoringEngine:
             return fstate, params, probs, feats
 
         self._step = jax.jit(step, donate_argnums=(0,))
+
+    def _init_telemetry(self, metrics) -> None:
+        """Resolve the registry series ONCE at build time: the hot loop
+        then pays one method call per event, never a name lookup. A
+        ``FlightRecorder`` can be attached via ``self.recorder`` (the CLI
+        installs a process-wide one; ``run`` falls back to it)."""
+        self.recorder = None
+        reg = metrics if metrics is not None else get_registry()
+        self.metrics = reg
+        self._m_batches = reg.counter(
+            "rtfds_batches_total", "micro-batches scored")
+        self._m_rows = reg.counter("rtfds_rows_total", "rows scored")
+        self._m_lat = reg.histogram(
+            "rtfds_batch_latency_seconds",
+            "end-to-end micro-batch latency (poll wait excluded)")
+        self._m_phase = {
+            ph: reg.histogram(
+                "rtfds_phase_seconds",
+                "per-batch loop-time decomposition by phase", phase=ph)
+            for ph in PHASES
+        }
+        self._m_last = reg.gauge(
+            "rtfds_last_batch_unix_seconds",
+            "wall-clock time the last batch finished (healthz input)")
+        self._m_qdepth = reg.gauge(
+            "rtfds_queue_depth", "micro-batches currently in flight")
 
     def _maybe_use_pallas_forest(self, kind: str, params) -> None:
         """Swap the tree-ensemble scorer for the fused Pallas kernel.
@@ -515,7 +555,10 @@ class ScoringEngine:
         pad = em["full"].shape[0]
         cap = (em["packed"].shape[0] - pad - 1) // (1 + N_FEATURES)
         flat = np.asarray(em["packed"])
-        probs_np = flat[:n]
+        # copy: a view into the packed fetch would pin the whole
+        # pad+1+(1+15)·cap f32 buffer (~MBs/batch at the 262k big-batch
+        # cap) for as long as any sink retains BatchResult.probs
+        probs_np = flat[:n].copy()
         count = int(flat[pad])
         feats_np = np.zeros((n, N_FEATURES), np.float32)
         if count > cap:
@@ -550,7 +593,10 @@ class ScoringEngine:
             )
         self.state.batches_done += 1
         self.state.rows_done += n
-        return BatchResult(
+        self._m_batches.inc()
+        self._m_rows.inc(n)
+        self._m_last.set(time.time())
+        res = BatchResult(
             tx_id=cols["tx_id"],
             tx_datetime_us=cols["tx_datetime_us"],
             customer_id=cols["customer_id"],
@@ -564,6 +610,8 @@ class ScoringEngine:
             ),
             batch_index=self.state.batches_done,
         )
+        self._m_lat.observe(res.latency_s)
+        return res
 
     def _ensure_layout(self) -> None:
         """Adopt a restored checkpoint written at a different device
@@ -761,10 +809,22 @@ class ScoringEngine:
         every = self.cfg.runtime.checkpoint_every_batches
         depth = max(1, self.cfg.runtime.pipeline_depth)
         coalesce = self.cfg.runtime.coalesce_rows
-        latencies: List[float] = []
-        preps: List[float] = []
-        dispatches: List[float] = []
-        blocks: List[float] = []
+        # Per-run percentile trackers (bounded reservoirs, exact within
+        # the window) — the run-report twin of the process-lifetime
+        # rtfds_phase_seconds registry histograms.
+        trackers = {
+            "latency": LatencyTracker(),
+            "host_prep": LatencyTracker(),
+            "dispatch": LatencyTracker(),
+            "result_wait": LatencyTracker(),
+        }
+        recorder = self.recorder if self.recorder is not None \
+            else active_recorder()
+        phase_hist = self._m_phase
+        # Source-poll time since the last finished batch — attributed to
+        # the NEXT batch's flight record so per-batch phases sum to the
+        # loop's wall time (minus trigger pacing, reported separately).
+        pending = {"poll_s": 0.0}
         t_start = time.perf_counter()
         rows0 = self.state.rows_done  # report THIS run's throughput, not
         batches0 = self.state.batches_done  # lifetime totals (warmup runs)
@@ -784,17 +844,39 @@ class ScoringEngine:
             # Loop-time decomposition: host prep (dedup + pad) vs H2D +
             # dispatch (the per-step overhead pipelining hides) vs the
             # result wait (device compute minus overlap).
-            preps.append(handle.get("prep_s", 0.0))
-            dispatches.append(handle.get("dispatch_s", 0.0))
-            blocks.append(time.perf_counter() - t_block)
+            prep_s = handle.get("prep_s", 0.0)
+            dispatch_s = handle.get("dispatch_s", 0.0)
+            wait_s = time.perf_counter() - t_block
+            trackers["host_prep"].record(prep_s)
+            trackers["dispatch"].record(dispatch_s)
+            trackers["result_wait"].record(wait_s)
+            trackers["latency"].record(res.latency_s, rows=len(res.tx_id))
+            phase_hist["host_prep"].observe(prep_s)
+            phase_hist["dispatch"].observe(dispatch_s)
+            phase_hist["result_wait"].observe(wait_s)
             self.state.offsets = handle["source_offsets"]
-            latencies.append(res.latency_s)
+            sink_s = 0.0
             if sink is not None:
+                t_sink = time.perf_counter()
                 sink.append(res)
+                sink_s = time.perf_counter() - t_sink
+                phase_hist["sink_write"].observe(sink_s)
+            if recorder is not None:
+                recorder.record_batch(
+                    res.batch_index, len(res.tx_id),
+                    {"source_poll": pending["poll_s"],
+                     "host_prep": prep_s, "dispatch": dispatch_s,
+                     "result_wait": wait_s, "sink_write": sink_s},
+                    queue_depth=len(q), latency_s=res.latency_s,
+                )
+                pending["poll_s"] = 0.0
             if feedback is not None:
                 # Between-batch label application (before the checkpoint,
                 # so saved state includes the landed labels).
-                feedback.poll_and_apply()
+                applied = feedback.poll_and_apply()
+                if recorder is not None and applied:
+                    recorder.record_event("feedback", applied=applied,
+                                          batch=res.batch_index)
             if model_reload is not None:
                 # Hot model swap (the reference restarts the Spark job to
                 # pick up a retrained pickle; here the loop swaps weights
@@ -839,7 +921,10 @@ class ScoringEngine:
         def _poll():
             t_poll = time.perf_counter()
             c = source.poll_batch()
-            _add_wait(time.perf_counter() - t_poll)
+            dt = time.perf_counter() - t_poll
+            _add_wait(dt)
+            phase_hist["source_poll"].observe(dt)
+            pending["poll_s"] += dt
             return c
 
         exhausted = False
@@ -905,11 +990,17 @@ class ScoringEngine:
             handle["index"] = idx
             handle["source_offsets"] = offs
             q.append(handle)
+            self._m_qdepth.set(len(q))
             while len(q) >= depth:
                 _finish(q.popleft())
+                self._m_qdepth.set(len(q))
         _drain()
+        self._m_qdepth.set(0)
         wall = time.perf_counter() - t_start
-        lat = np.asarray(latencies) if latencies else np.zeros(1)
+        # LatencyTracker-backed snapshots: exact percentiles over the
+        # bounded recent window (identical to the old full-list math for
+        # runs under the window size, O(1) memory beyond it).
+        snaps = {k: t.snapshot() for k, t in trackers.items()}
         stats = {
             "rows": self.state.rows_done - rows0,
             "batches": self.state.batches_done - batches0,
@@ -917,21 +1008,11 @@ class ScoringEngine:
             "rows_per_s": (
                 (self.state.rows_done - rows0) / wall if wall > 0 else 0.0
             ),
-            "latency_p50_ms": float(np.percentile(lat, 50) * 1e3),
-            "latency_p99_ms": float(np.percentile(lat, 99) * 1e3),
-            "host_prep_p50_ms": float(
-                np.percentile(np.asarray(preps) if preps else np.zeros(1),
-                              50) * 1e3
-            ),
-            "dispatch_p50_ms": float(
-                np.percentile(
-                    np.asarray(dispatches) if dispatches else np.zeros(1),
-                    50) * 1e3
-            ),
-            "result_wait_p50_ms": float(
-                np.percentile(np.asarray(blocks) if blocks else np.zeros(1),
-                              50) * 1e3
-            ),
+            "latency_p50_ms": snaps["latency"].get("p50_ms", 0.0),
+            "latency_p99_ms": snaps["latency"].get("p99_ms", 0.0),
+            "host_prep_p50_ms": snaps["host_prep"].get("p50_ms", 0.0),
+            "dispatch_p50_ms": snaps["dispatch"].get("p50_ms", 0.0),
+            "result_wait_p50_ms": snaps["result_wait"].get("p50_ms", 0.0),
             "pipeline_depth": depth,
         }
         if self._selective:

@@ -28,8 +28,24 @@ from dataclasses import dataclass
 from typing import Callable, Optional, Sequence, Tuple, Type
 
 from real_time_fraud_detection_system_tpu.utils.logging import get_logger
+from real_time_fraud_detection_system_tpu.utils.metrics import (
+    active_recorder,
+    get_registry,
+)
 
 log = get_logger("faults")
+
+
+def _record_fault(kind: str, count: int = 1, **fields) -> None:
+    """Count an injected fault (by kind) and land it in the flight
+    record, so a chaos run's telemetry shows exactly which failures were
+    scripted vs organic."""
+    get_registry().counter(
+        "rtfds_faults_injected_total", "injected faults by kind",
+        kind=kind).inc(count)
+    rec = active_recorder()
+    if rec is not None:
+        rec.record_event("fault", fault_kind=kind, count=count, **fields)
 
 
 class TransientError(RuntimeError):
@@ -135,6 +151,7 @@ class HangingSource:
         self._polls += 1
         if i in self.hang_at:
             self.hang_at.discard(i)
+            _record_fault("hang", poll=i)
             self.release.wait(timeout=self.max_hang_s)  # silent stall
         return self.inner.poll_batch()
 
@@ -164,6 +181,7 @@ class FlakySource:
         i = self._polls
         self._polls += 1
         if i in self.fail_at:
+            _record_fault("flaky_poll", poll=i)
             raise TransientError(f"injected poll failure #{i}")
         return self.inner.poll_batch()
 
@@ -183,10 +201,14 @@ def corrupt_messages(msgs: Sequence[bytes],
     (the golden-decode robustness property, SURVEY §4). Produce the result
     into a broker/topic to exercise the full envelope path."""
     k = max(int(corrupt_every), 1)
-    return [
+    out = [
         m[: max(len(m) // 2, 1)] if i % k == k - 1 else m
         for i, m in enumerate(msgs)
     ]
+    n_corrupt = len(msgs) // k
+    if n_corrupt:
+        _record_fault("corrupt_envelope", count=n_corrupt)
+    return out
 
 
 class _FencedCheckpointer:
@@ -533,5 +555,20 @@ def run_with_recovery(
                     pass
             log.warning("engine crashed (%s); restart %d/%d",
                         e, restarts, max_restarts)
+            cause = "stall" if last_was_stall else "crash"
+            rec = active_recorder()
             if restarts > max_restarts:
+                # budget exhausted: the final failure is NOT a restart —
+                # counting it would skew the baseline chaos PRs assert on
+                if rec is not None:
+                    rec.record_event(
+                        "gave_up", restarts=restarts - 1, cause=cause,
+                        error=f"{type(e).__name__}: {e}"[:200])
                 raise
+            get_registry().counter(
+                "rtfds_engine_restarts_total",
+                "supervisor restarts by cause", cause=cause).inc()
+            if rec is not None:
+                rec.record_event(
+                    "restart", restarts=restarts, cause=cause,
+                    error=f"{type(e).__name__}: {e}"[:200])

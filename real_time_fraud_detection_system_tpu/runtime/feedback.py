@@ -37,6 +37,7 @@ import numpy as np
 from real_time_fraud_detection_system_tpu.features.spec import N_FEATURES
 from real_time_fraud_detection_system_tpu.ops.dedup import latest_wins_mask_np
 from real_time_fraud_detection_system_tpu.utils.logging import get_logger
+from real_time_fraud_detection_system_tpu.utils.metrics import get_registry
 
 log = get_logger("feedback")
 
@@ -276,6 +277,20 @@ class FeedbackLoop:
         # applied ⊆ hits (the rest were already labeled or label < 0).
         self.stats = {"events": 0, "applied": 0, "missed": 0,
                       "duplicates": 0}
+        # Registry twin of self.stats (process-lifetime, scrapeable)
+        # with DISJOINT outcome labels so sum() over the family equals
+        # total events: applied + skipped (cache hit, but already
+        # labeled or label < 0) + missed (evicted/never scored) +
+        # duplicates (within-poll dedup). A rising missed share is the
+        # operator's cue that labels arrive after cache eviction (raise
+        # FeatureCache capacity).
+        reg = get_registry()
+        self._m_stats = {
+            k: reg.counter("rtfds_feedback_events_total",
+                           "feedback label events by disjoint outcome",
+                           outcome=k)
+            for k in ("applied", "skipped", "missed", "duplicates")
+        }
 
     def _drain(self) -> List[bytes]:
         poll_messages = getattr(self.broker, "poll_messages", None)
@@ -326,17 +341,21 @@ class FeedbackLoop:
             # orders by partition number, not recency. Same latest-wins
             # rule and helper as the ingest MERGE path.
             keep = latest_wins_mask_np(tx_ids, ts_ms)
-            self.stats["duplicates"] += int(len(tx_ids) - keep.sum())
+            dup = int(len(tx_ids) - keep.sum())
+            self.stats["duplicates"] += dup
+            self._m_stats["duplicates"].inc(dup)
             tx_ids, labels = tx_ids[keep], labels[keep]
         feats, term_ids, days, hit, done = self.cache.get_batch_full(tx_ids)
         n_hit = int(hit.sum())
         self.stats["missed"] += len(tx_ids) - n_hit
+        self._m_stats["missed"].inc(len(tx_ids) - n_hit)
         if n_hit == 0:
             return 0
         # Idempotence: rows whose label already reached the state (in-band
         # at scoring time, or an earlier feedback event) are skipped — the
         # state scatter is additive and must run at most once per tx.
         fresh = (labels[hit] >= 0) & ~done
+        self._m_stats["skipped"].inc(n_hit - int(fresh.sum()))
         if not fresh.any():
             return 0
         y = labels[hit][fresh]
@@ -352,4 +371,5 @@ class FeedbackLoop:
         self.cache.mark_labeled(tx_ids[hit][fresh])
         n_labeled = int(len(y))
         self.stats["applied"] += n_labeled
+        self._m_stats["applied"].inc(n_labeled)
         return n_labeled

@@ -89,6 +89,7 @@ class ShardedScoringEngine(ScoringEngine):
         feature_cache=None,
         feature_state=None,
         feature_state_n_old: Optional[int] = None,
+        metrics=None,
     ):
         """``feature_state``: a pre-built state for elastic recovery of a
         checkpoint taken at a different device count. Pass
@@ -147,11 +148,30 @@ class ShardedScoringEngine(ScoringEngine):
         super().__init__(
             cfg, kind, params, scaler, feature_state=pre_state,
             online_lr=online_lr, feature_cache=feature_cache,
+            metrics=metrics,
         )
         self.mesh = mesh
         self.axis = axis
         self.n_dev = int(self.mesh.devices.size)
         self.state.layout_devices = self.n_dev
+        # Mesh-level telemetry: per-shard row placement (imbalance is THE
+        # sharded-serving failure mode worth watching), replicated-leaf
+        # commits, and sharded-step (re)builds — a retrace inside the
+        # serving loop costs ~1 s and should be visible, not inferred.
+        self._m_shard_rows = [
+            self.metrics.gauge(
+                "rtfds_shard_rows",
+                "rows routed to this shard in the last batch",
+                shard=str(i))
+            for i in range(self.n_dev)
+        ]
+        self._m_commits = self.metrics.counter(
+            "rtfds_replicated_commits_total",
+            "params/scaler trees committed to the mesh (each avoided a "
+            "silent in-loop retrace)")
+        self._m_step_builds = self.metrics.counter(
+            "rtfds_sharded_step_builds_total",
+            "sharded step compilations (local + routed variants)")
         # Commit replicated leaves (params, scaler) to the mesh NOW: the
         # step's out_specs return them mesh-committed, so leaving the
         # build-time copies on the default device makes the SECOND step
@@ -246,16 +266,28 @@ class ShardedScoringEngine(ScoringEngine):
         rep = NamedSharding(self.mesh, P())
 
         def needs(t) -> bool:
+            # Inspect ALL leaves, not just the first one carrying a
+            # .sharding: a partially swapped params tree (e.g. a hot
+            # reload that replaced some leaves with host arrays) would
+            # otherwise be skipped on the strength of its one committed
+            # leaf, silently reintroducing the per-call retrace this
+            # guard exists to prevent. A leaf WITHOUT a .sharding at all
+            # (numpy array, python scalar) is a host leaf and equally
+            # needs the commit — after it, every leaf is a committed
+            # device array, so this stays a one-shot.
             for leaf in jax.tree.leaves(t):
                 sh = getattr(leaf, "sharding", None)
-                if sh is not None:
-                    return not (isinstance(sh, NamedSharding)
-                                and sh.mesh.shape == self.mesh.shape)
-            return True  # no device leaves yet: commit them
+                if sh is None:
+                    return True  # host leaf: commit
+                if not (isinstance(sh, NamedSharding)
+                        and sh.mesh.shape == self.mesh.shape):
+                    return True
+            return False  # every leaf already mesh-committed (or empty)
 
         for name in ("params", "scaler"):
             t = getattr(self.state, name)
             if needs(t):
+                self._m_commits.inc()
                 setattr(self.state, name, jax.tree.map(
                     lambda x: jax.device_put(jnp.asarray(x), rep), t))
 
@@ -301,6 +333,15 @@ class ShardedScoringEngine(ScoringEngine):
         cols = {k: v[keep] for k, v in cols.items()}
         n = len(cols["tx_id"])
         self._ensure_sharded()
+        if n:
+            # Same placement rule as partition_batch_spill (customer_id
+            # % n_dev): one bincount per batch, so the dashboard can see
+            # hot-key imbalance the moment it starts spilling.
+            loads = np.bincount(
+                (cols["customer_id"] % self.n_dev).astype(np.int64),
+                minlength=self.n_dev)
+            for i, g in enumerate(self._m_shard_rows):
+                g.set(int(loads[i]))
 
         chunks = partition_batch_spill(
             cols, self.n_dev, self.rows_per_shard
@@ -348,6 +389,7 @@ class ShardedScoringEngine(ScoringEngine):
                 continue
             if part_cols.get("__routed__", False):
                 if self._sharded_step_routed is None:
+                    self._m_step_builds.inc()
                     self._sharded_step_routed = self._sharded_build_routed(
                         self.state.feature_state, self.state.params,
                         self.state.scaler, jbatch,
@@ -355,6 +397,7 @@ class ShardedScoringEngine(ScoringEngine):
                 step = self._sharded_step_routed
             else:
                 if self._sharded_step is None:
+                    self._m_step_builds.inc()
                     self._sharded_step = self._sharded_build(
                         self.state.feature_state, self.state.params,
                         self.state.scaler, jbatch,

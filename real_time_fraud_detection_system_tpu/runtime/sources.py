@@ -22,6 +22,7 @@ image, so it is import-gated.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -34,6 +35,46 @@ from real_time_fraud_detection_system_tpu.core.envelope import (
 from real_time_fraud_detection_system_tpu.data.generator import (
     Transactions,
 )
+from real_time_fraud_detection_system_tpu.utils.metrics import get_registry
+
+
+class _SourceTelemetry:
+    """Shared per-source instrumentation: poll latency histogram, rows
+    ingested counter, seek/replay counter, and (for sources that know
+    their backlog) the ``rtfds_source_lag_rows`` gauge that ``/healthz``
+    applies its lag threshold to. Series resolve once at construction."""
+
+    def _init_source_metrics(self, source_kind: str) -> None:
+        reg = get_registry()
+        self._m_poll = reg.histogram(
+            "rtfds_source_poll_seconds", "source poll_batch wall time",
+            source=source_kind)
+        self._m_ingested = reg.counter(
+            "rtfds_source_rows_total", "rows ingested", source=source_kind)
+        self._m_seeks = reg.counter(
+            "rtfds_source_seeks_total",
+            "checkpoint-resume / replay seeks", source=source_kind)
+        # The lag gauge is registered LAZILY on first set: a source that
+        # cannot compute a backlog (Kafka) must not create a permanent-0
+        # series, or /healthz's lag threshold would check the fake zero
+        # and report healthy while the consumer falls behind. Unlabeled
+        # on purpose: /healthz reads it without knowing which source
+        # implementation is serving.
+        self._m_lag = None
+
+    def _observe_poll(self, t0: float, cols: Optional[dict],
+                      lag: Optional[int] = None) -> None:
+        self._m_poll.observe(time.perf_counter() - t0)
+        if cols is not None:
+            n = len(next(iter(cols.values()), ()))
+            if n:
+                self._m_ingested.inc(n)
+        if lag is not None:
+            if self._m_lag is None:
+                self._m_lag = get_registry().gauge(
+                    "rtfds_source_lag_rows",
+                    "known backlog: rows available but not yet served")
+            self._m_lag.set(lag)
 
 
 @dataclass
@@ -96,7 +137,7 @@ class InProcBroker:
             return [len(p) for p in t]
 
 
-class ReplaySource:
+class ReplaySource(_SourceTelemetry):
     """Serves micro-batches from a transactions table.
 
     ``mode='columnar'`` returns numpy column dicts directly (zero-parse
@@ -121,6 +162,7 @@ class ReplaySource:
         self.with_labels = with_labels
         self.n_partitions = n_partitions
         self._pos = 0
+        self._init_source_metrics("replay")
         if mode == "envelope":
             self.broker = InProcBroker(n_partitions)
             t_us = txs.epoch_us(start_epoch_s)
@@ -137,6 +179,17 @@ class ReplaySource:
 
     def poll_batch(self) -> Optional[dict]:
         """Next micro-batch as a column dict (None when exhausted)."""
+        t0 = time.perf_counter()
+        cols = self._poll_inner()
+        if self.mode == "columnar":
+            lag = self.txs.n - self._pos
+        else:
+            lag = sum(self.broker.end_offsets(
+                "debezium.payment.transactions")) - sum(self._offsets)
+        self._observe_poll(t0, cols, lag=lag)
+        return cols
+
+    def _poll_inner(self) -> Optional[dict]:
         if self.mode == "columnar":
             n = self.txs.n
             if self._pos >= n:
@@ -183,6 +236,7 @@ class ReplaySource:
 
     def seek(self, offsets: Sequence[int]) -> None:
         """Restore consumption position (checkpoint resume)."""
+        self._m_seeks.inc()
         if self.mode == "columnar":
             self._pos = int(offsets[0])
         else:
@@ -222,7 +276,7 @@ class SyntheticSource:
         self._replay.seek(offsets)
 
 
-class RawTableSource:
+class RawTableSource(_SourceTelemetry):
     """Stream the persistent raw-transactions table back through the
     engine — backfill / re-score-after-retrain.
 
@@ -310,19 +364,23 @@ class RawTableSource:
                               int(self._cols["tx_id"][-1]))
         else:
             self._snapshot = (0, -1, -1)
+        self._init_source_metrics("raw_table")
 
     @property
     def n(self) -> int:
         return len(self._cols["tx_id"])
 
     def poll_batch(self) -> Optional[dict]:
+        t0 = time.perf_counter()
         if self._pos >= self.n:
+            self._observe_poll(t0, None, lag=0)
             return None
         s, e = self._pos, min(self._pos + self.batch_rows, self.n)
         self._pos = e
         out = {k: v[s:e] for k, v in self._cols.items()}
         # replayed history: event time doubles as the transport timestamp
         out["kafka_ts_ms"] = out["tx_datetime_us"] // 1000
+        self._observe_poll(t0, out, lag=self.n - self._pos)
         return out
 
     @property
@@ -347,6 +405,7 @@ class RawTableSource:
                     "backfill from scratch (or bound it with "
                     "from_day/to_day)."
                 )
+        self._m_seeks.inc()
         self._pos = int(offsets[0])
 
 
@@ -368,7 +427,7 @@ def raise_for_kafka_error(ck, err) -> bool:
     raise ck.KafkaException(err)
 
 
-class KafkaSource:
+class KafkaSource(_SourceTelemetry):
     """Real Kafka consumer → columnar micro-batches.
 
     The production ingress of the reference is the Debezium transaction
@@ -439,6 +498,7 @@ class KafkaSource:
         }
         factory = consumer_factory or ck.Consumer
         self._consumer = factory(conf)
+        self._init_source_metrics("kafka")
         self._next: Dict[int, int] = {}  # partition -> next offset
         self._n_partitions = n_partitions
         self._manual = partitions is not None
@@ -484,6 +544,15 @@ class KafkaSource:
         (the default) returns an empty poll as a zero-row wait instead,
         by polling again on the next engine trigger.
         """
+        t0 = time.perf_counter()
+        cols = self._poll_inner()
+        # no lag gauge: a broker high-watermark query per poll is an
+        # extra RPC on the hot path; scrape consumer-group lag from the
+        # broker's own exporter instead
+        self._observe_poll(t0, cols)
+        return cols
+
+    def _poll_inner(self) -> Optional[dict]:
         import time as _time
 
         msgs: List[bytes] = []
@@ -569,6 +638,7 @@ class KafkaSource:
         applied by the rebalance callback on (re-)assignment, and with
         ``seek()`` on partitions already being consumed.
         """
+        self._m_seeks.inc()
         ck = self._ck
         for p, off in enumerate(offsets):
             if int(off) >= 0:

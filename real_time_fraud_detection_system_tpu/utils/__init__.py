@@ -11,3 +11,13 @@ from real_time_fraud_detection_system_tpu.utils.tracing import (  # noqa: F401
     trace_span,
     profile_to,
 )
+from real_time_fraud_detection_system_tpu.utils.metrics import (  # noqa: F401
+    LATENCY_BUCKETS_S,
+    FlightRecorder,
+    MetricsRegistry,
+    MetricsServer,
+    active_recorder,
+    get_registry,
+    run_manifest,
+    set_active_recorder,
+)

@@ -166,3 +166,101 @@ def test_cli_dashboard_missing_dir(tmp_path, capsys):
     assert rc == 2
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert "error" in out
+
+
+@pytest.fixture
+def flight_record(tmp_path):
+    from real_time_fraud_detection_system_tpu.utils.metrics import (
+        FlightRecorder,
+    )
+
+    path = str(tmp_path / "flight.jsonl")
+    rec = FlightRecorder(path, manifest={
+        "model_kind": "logreg", "backend": "cpu", "n_devices": 1,
+        "config_hash": "deadbeef00000000"})
+    for i in range(1, 9):
+        rec.record_batch(i, 512, {
+            "source_poll": 0.0005, "host_prep": 0.001,
+            "dispatch": 0.004 + 0.01 * (i == 5),  # one spike
+            "result_wait": 0.0002, "sink_write": 0.002,
+        }, queue_depth=1, latency_s=0.008)
+    rec.record_event("fault", fault_kind="flaky_poll", poll=3)
+    rec.record_event("checkpoint", op="save", batches_done=4, bytes=1024)
+    rec.record_event("feedback", applied=7, batch=6)
+    rec.close()
+    return path
+
+
+def test_ops_dashboard_view(flight_record, tmp_path):
+    from real_time_fraud_detection_system_tpu.io.dashboard import (
+        write_ops_dashboard,
+    )
+
+    out = tmp_path / "ops.html"
+    manifest = write_ops_dashboard(flight_record, str(out))
+    assert manifest["batches"] == 8
+    assert manifest["events"] == 3
+    htm = out.read_text()
+    # per-phase latency series + event strip + accessibility twins
+    for phase in ("source_poll", "host_prep", "dispatch", "result_wait",
+                  "sink_write"):
+        assert phase in htm
+    assert "fault" in htm and "checkpoint" in htm and "feedback" in htm
+    assert "Table view" in htm
+    assert "config_hash deadbeef00000000" in htm
+
+
+def test_cli_ops_dashboard(flight_record, tmp_path, capsys):
+    import json
+
+    from real_time_fraud_detection_system_tpu.cli import main
+
+    out = tmp_path / "ops.html"
+    rc = main(["--platform", "cpu", "dashboard",
+               "--flight-record", flight_record, "--out", str(out)])
+    assert rc == 0
+    manifest = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert manifest["batches"] == 8
+    assert out.exists()
+
+
+def test_cli_dashboard_requires_some_input(capsys):
+    import json
+
+    from real_time_fraud_detection_system_tpu.cli import main
+
+    rc = main(["--platform", "cpu", "dashboard"])
+    assert rc == 2
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "error" in out
+
+
+def test_ops_dashboard_empty_record(tmp_path):
+    from real_time_fraud_detection_system_tpu.io.dashboard import (
+        render_ops_html,
+    )
+
+    htm = render_ops_html(None, [])
+    assert "no batch records" in htm
+
+
+def test_ops_dashboard_events_without_batches(tmp_path):
+    """A run that died before its first batch still renders its events —
+    the fault/restart records are what explain the death."""
+    from real_time_fraud_detection_system_tpu.io.dashboard import (
+        render_ops_html,
+    )
+    from real_time_fraud_detection_system_tpu.utils.metrics import (
+        FlightRecorder,
+    )
+
+    path = str(tmp_path / "dead.jsonl")
+    rec = FlightRecorder(path, manifest={"model_kind": "logreg"})
+    rec.record_event("fault", fault_kind="hang", poll=0)
+    rec.record_event("restart", restarts=1, cause="stall")
+    rec.close()
+    manifest, records = FlightRecorder.read(path)
+    htm = render_ops_html(manifest, records)
+    assert "no batch records" in htm
+    assert "fault" in htm and "restart" in htm
+    assert "Table view" in htm

@@ -1,0 +1,574 @@
+"""Unified telemetry: registry semantics, renderers, flight recorder,
+HTTP endpoints, engine integration, instrumentation overhead."""
+
+import json
+import os
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from real_time_fraud_detection_system_tpu.utils.metrics import (
+    LATENCY_BUCKETS_S,
+    FlightRecorder,
+    MetricsRegistry,
+    MetricsServer,
+    get_registry,
+    run_manifest,
+)
+
+START_EPOCH_S = 1_743_465_600  # 2025-04-01
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+def test_counter_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("txs_total", "help text")
+    c.inc()
+    c.inc(41)
+    assert c.value == 42
+    # get-or-create: same (name, labels) -> same series object
+    assert reg.counter("txs_total") is c
+    with pytest.raises(ValueError):
+        c.inc(-1)  # counters only go up
+    # labeled children are distinct series
+    a = reg.counter("txs_total", source="a")
+    assert a is not c
+    a.inc(5)
+    assert c.value == 42 and a.value == 5
+
+
+def test_gauge_semantics():
+    reg = MetricsRegistry()
+    g = reg.gauge("depth")
+    g.set(3)
+    g.set(1.5)
+    assert g.value == 1.5
+    g.inc(0.5)
+    assert g.value == 2.0
+
+
+def test_type_conflict_raises():
+    reg = MetricsRegistry()
+    reg.counter("x_total")
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")
+
+
+def test_histogram_bucket_conflict_raises():
+    reg = MetricsRegistry()
+    h = reg.histogram("h_seconds", buckets=(0.1, 1.0))
+    # omitted buckets adopt the family ladder; same explicit ladder ok
+    assert reg.histogram("h_seconds") is h
+    assert reg.histogram("h_seconds", buckets=(1.0, 0.1)) is h
+    with pytest.raises(ValueError):
+        reg.histogram("h_seconds", buckets=(0.5, 2.0))
+    # labeled child of a default-ladder family inherits it
+    reg2 = MetricsRegistry()
+    a = reg2.histogram("p_seconds", phase="a")
+    assert a.bounds == LATENCY_BUCKETS_S
+
+
+def test_histogram_buckets_and_percentiles():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    assert h.count == 5
+    assert h.sum == pytest.approx(5.56)
+    cum = dict(h.cumulative())
+    assert cum[0.01] == 2       # le semantics: v <= bound
+    assert cum[0.1] == 3
+    assert cum[1.0] == 4
+    assert cum[float("inf")] == 5
+    # exact-boundary observation lands in its own bucket (le, not lt)
+    h.observe(0.1)
+    assert dict(h.cumulative())[0.1] == 4
+    # interpolated percentile sits inside the owning bucket
+    assert 0.0 < h.percentile(50) <= 0.1
+    assert h.percentile(0) >= 0.0
+    # default ladder is log-spaced and shared
+    assert LATENCY_BUCKETS_S == tuple(sorted(LATENCY_BUCKETS_S))
+
+
+def test_histogram_thread_safety():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_seconds")
+    c = reg.counter("t_total")
+
+    def work():
+        for _ in range(1000):
+            h.observe(0.001)
+            c.inc()
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.count == 8000
+    assert c.value == 8000
+
+
+# ---------------------------------------------------------------------------
+# renderers
+# ---------------------------------------------------------------------------
+
+def test_prometheus_text_exact_lines():
+    reg = MetricsRegistry()
+    reg.counter("rtfds_rows_total", "rows scored").inc(128)
+    reg.gauge("rtfds_queue_depth", "in flight", engine="main").set(2)
+    h = reg.histogram("rtfds_lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    text = reg.render_prometheus()
+    lines = text.splitlines()
+    assert "# HELP rtfds_rows_total rows scored" in lines
+    assert "# TYPE rtfds_rows_total counter" in lines
+    assert "rtfds_rows_total 128" in lines
+    assert "# TYPE rtfds_queue_depth gauge" in lines
+    assert 'rtfds_queue_depth{engine="main"} 2' in lines
+    assert "# TYPE rtfds_lat_seconds histogram" in lines
+    assert 'rtfds_lat_seconds_bucket{le="0.1"} 1' in lines
+    assert 'rtfds_lat_seconds_bucket{le="1"} 2' in lines
+    assert 'rtfds_lat_seconds_bucket{le="+Inf"} 2' in lines
+    assert "rtfds_lat_seconds_sum 0.55" in lines
+    assert "rtfds_lat_seconds_count 2" in lines
+    assert text.endswith("\n")
+
+
+def test_prometheus_label_escaping():
+    reg = MetricsRegistry()
+    reg.counter("c_total", source='we"ird\\thing').inc()
+    line = [ln for ln in reg.render_prometheus().splitlines()
+            if ln.startswith("c_total{")][0]
+    assert line == 'c_total{source="we\\"ird\\\\thing"} 1'
+
+
+def test_json_snapshot_round_trip():
+    reg = MetricsRegistry()
+    reg.counter("a_total", "ca").inc(3)
+    reg.gauge("b", "gb", k="v").set(1.25)
+    reg.histogram("h_seconds", buckets=(0.1, 1.0)).observe(0.2)
+    snap = reg.snapshot()
+    # JSON round-trip is lossless (the /metrics.json contract)
+    again = json.loads(json.dumps(snap))
+    assert again == snap
+    assert again["a_total"]["type"] == "counter"
+    assert again["a_total"]["series"][0]["value"] == 3
+    assert again["b"]["series"][0]["labels"] == {"k": "v"}
+    hs = again["h_seconds"]["series"][0]
+    assert hs["count"] == 1
+    assert hs["buckets"][-1] == ["+Inf", 1]
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_flight_recorder_jsonl_replay(tmp_path):
+    path = str(tmp_path / "flight.jsonl")
+    rec = FlightRecorder(path, manifest={"model_kind": "logreg",
+                                         "config_hash": "abc123"})
+    rec.record_batch(1, 256, {"host_prep": 0.001, "dispatch": 0.002},
+                     queue_depth=1, latency_s=0.01)
+    rec.record_event("fault", fault_kind="flaky_poll", poll=3)
+    rec.record_event("checkpoint", op="save", batches_done=1)
+    rec.close()
+    manifest, records = FlightRecorder.read(path)
+    assert manifest["model_kind"] == "logreg"
+    assert manifest["config_hash"] == "abc123"
+    assert manifest["start_unix_s"] > 0
+    kinds = [r["kind"] for r in records]
+    assert kinds == ["batch", "event", "event"]
+    b = records[0]
+    assert b["batch"] == 1 and b["rows"] == 256
+    assert b["phases"] == {"host_prep": 0.001, "dispatch": 0.002}
+    assert b["queue_depth"] == 1
+    assert records[1]["event"] == "fault"
+    # every line is standalone JSON (tail-tolerant log contract)
+    with open(path) as f:
+        for line in f:
+            json.loads(line)
+
+
+def test_flight_recorder_append_and_torn_tail(tmp_path):
+    path = str(tmp_path / "f.jsonl")
+    rec = FlightRecorder(path, manifest={"model_kind": "x"})
+    rec.record_batch(1, 10, {})
+    rec.close()
+    # a crash mid-write leaves a torn final line: replay must skip it
+    with open(path, "a") as f:
+        f.write('{"kind": "batch", "batch": 2, "ro')
+    manifest, records = FlightRecorder.read(path)
+    assert manifest["model_kind"] == "x"
+    assert len(records) == 1
+    # reopening heals the torn tail and appends its own manifest segment
+    # marker: read() hands back ONLY the latest segment (a second run's
+    # batches are never mixed with — or attributed to — the first's);
+    # read_segments() exposes the full history
+    rec2 = FlightRecorder(path, manifest={"model_kind": "forest"})
+    rec2.record_batch(3, 5, {})
+    rec2.close()
+    manifest, records = FlightRecorder.read(path)
+    assert manifest["model_kind"] == "forest"
+    assert [r["batch"] for r in records if r["kind"] == "batch"] == [3]
+    segments = FlightRecorder.read_segments(path)
+    assert [m["model_kind"] for m, _ in segments] == ["x", "forest"]
+    assert [[r["batch"] for r in rs] for _, rs in segments] == [[1], [3]]
+
+
+def test_run_manifest_fields():
+    man = run_manifest(model_kind="forest", scorer="tpu")
+    assert man["model_kind"] == "forest"
+    assert man["scorer"] == "tpu"
+    assert man["backend"] == "cpu"  # conftest pins JAX_PLATFORMS=cpu
+    assert man["n_devices"] >= 1
+    from real_time_fraud_detection_system_tpu.config import Config
+
+    m2 = run_manifest(cfg=Config(), model_kind="forest")
+    assert len(m2["config_hash"]) == 16
+    # the hash is a function of the config value, not the object
+    assert m2["config_hash"] == run_manifest(cfg=Config())["config_hash"]
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoints
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def served_registry():
+    reg = MetricsRegistry()
+    server = MetricsServer(port=0, registry=reg,
+                           max_batch_age_s=60.0).start()
+    yield reg, server
+    server.stop()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, r.read(), r.headers.get("Content-Type", "")
+
+
+def test_endpoints_smoke(served_registry):
+    reg, server = served_registry
+    reg.counter("rtfds_rows_total", "rows").inc(7)
+    status, body, ctype = _get(server.url + "/metrics")
+    assert status == 200
+    assert ctype.startswith("text/plain")
+    assert "rtfds_rows_total 7" in body.decode()
+    status, body, _ = _get(server.url + "/metrics.json")
+    assert status == 200
+    snap = json.loads(body)
+    assert snap["rtfds_rows_total"]["series"][0]["value"] == 7
+    status, body, _ = _get(server.url + "/healthz")
+    assert status == 200
+    assert json.loads(body)["healthy"] is True
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(server.url + "/nope")
+    assert ei.value.code == 404
+
+
+def test_healthz_trips_on_stale_batch_age(served_registry):
+    import time
+
+    reg, server = served_registry
+    # a batch finished 1h ago with a 60s budget: unhealthy (503)
+    reg.gauge("rtfds_last_batch_unix_seconds").set(time.time() - 3600)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(server.url + "/healthz")
+    assert ei.value.code == 503
+    body = json.loads(ei.value.read())
+    assert body["healthy"] is False
+    assert body["checks"]["last_batch_age_s"]["ok"] is False
+    # fresh batch -> healthy again
+    reg.gauge("rtfds_last_batch_unix_seconds").set(time.time())
+    status, body, _ = _get(server.url + "/healthz")
+    assert status == 200
+
+
+def test_healthz_source_lag_threshold():
+    reg = MetricsRegistry()
+    server = MetricsServer(port=0, registry=reg,
+                           max_source_lag_rows=1000).start()
+    try:
+        reg.gauge("rtfds_source_lag_rows").set(50_000)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(server.url + "/healthz")
+        assert ei.value.code == 503
+        reg.gauge("rtfds_source_lag_rows").set(10)
+        status, _, _ = _get(server.url + "/healthz")
+        assert status == 200
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# engine integration
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def engine_cfg():
+    from real_time_fraud_detection_system_tpu.config import (
+        Config,
+        DataConfig,
+        FeatureConfig,
+        RuntimeConfig,
+        TrainConfig,
+    )
+
+    return Config(
+        data=DataConfig(n_customers=120, n_terminals=240, n_days=45,
+                        seed=7, start_date="2025-04-01"),
+        features=FeatureConfig(customer_capacity=256,
+                               terminal_capacity=512),
+        train=TrainConfig(delta_train_days=25, delta_delay_days=5,
+                          delta_test_days=10, epochs=2),
+        runtime=RuntimeConfig(batch_buckets=(256, 1024, 4096)),
+    )
+
+
+@pytest.fixture(scope="module")
+def trained_logreg(engine_cfg, small_dataset):
+    from real_time_fraud_detection_system_tpu.models import train_model
+
+    _, _, _, txs = small_dataset
+    model, _ = train_model(txs, engine_cfg, kind="logreg")
+    return model, txs
+
+
+def test_engine_populates_registry_and_flight_record(
+        engine_cfg, trained_logreg, tmp_path):
+    from real_time_fraud_detection_system_tpu.io import MemorySink
+    from real_time_fraud_detection_system_tpu.runtime import (
+        ReplaySource,
+        ScoringEngine,
+    )
+
+    model, txs = trained_logreg
+    reg = MetricsRegistry()
+    eng = ScoringEngine(engine_cfg, model.kind, model.params,
+                        model.scaler, metrics=reg)
+    path = str(tmp_path / "flight.jsonl")
+    rec = FlightRecorder(path, manifest=run_manifest(
+        cfg=engine_cfg, model_kind=model.kind))
+    eng.recorder = rec
+    src = ReplaySource(txs, START_EPOCH_S, batch_rows=1024)
+    stats = eng.run(src, sink=MemorySink(), max_batches=6)
+    rec.close()
+
+    assert stats["batches"] == 6
+    # registry: batch/row counters and every per-phase histogram
+    assert reg.get("rtfds_batches_total").value == 6
+    assert reg.get("rtfds_rows_total").value == stats["rows"] > 0
+    from real_time_fraud_detection_system_tpu.runtime.engine import PHASES
+
+    for ph in PHASES:
+        h = reg.get("rtfds_phase_seconds", phase=ph)
+        assert h is not None and h.count >= 6, ph
+    assert reg.get("rtfds_batch_latency_seconds").count == 6
+    assert reg.get("rtfds_last_batch_unix_seconds").value > 0
+    # prometheus text carries the acceptance-named series
+    text = reg.render_prometheus()
+    assert "rtfds_batches_total 6" in text
+    assert 'rtfds_phase_seconds_bucket{le="+Inf",phase="host_prep"}' in text
+
+    # flight record: one batch record per batch, per-phase timings sum
+    # to within 10% of the reported wall time (the phases are the serial
+    # decomposition of the loop thread)
+    manifest, records = FlightRecorder.read(path)
+    assert manifest["model_kind"] == "logreg"
+    assert manifest["backend"] == "cpu"
+    batches = [r for r in records if r["kind"] == "batch"]
+    assert len(batches) == 6
+    assert [b["batch"] for b in batches] == [1, 2, 3, 4, 5, 6]
+    assert sum(b["rows"] for b in batches) == stats["rows"]
+    phase_sum = sum(sum(b["phases"].values()) for b in batches)
+    assert phase_sum == pytest.approx(stats["wall_s"],
+                                      rel=0.10, abs=0.05)
+
+
+def test_engine_run_stats_shape_unchanged(engine_cfg, trained_logreg):
+    """The LatencyTracker-backed stats keep the report contract that
+    bench.py / pipeline.py consume."""
+    from real_time_fraud_detection_system_tpu.runtime import (
+        ReplaySource,
+        ScoringEngine,
+    )
+
+    model, txs = trained_logreg
+    eng = ScoringEngine(engine_cfg, model.kind, model.params,
+                        model.scaler, metrics=MetricsRegistry())
+    stats = eng.run(ReplaySource(txs, START_EPOCH_S, batch_rows=2048),
+                    max_batches=3)
+    for key in ("rows", "batches", "wall_s", "rows_per_s",
+                "latency_p50_ms", "latency_p99_ms", "host_prep_p50_ms",
+                "dispatch_p50_ms", "result_wait_p50_ms",
+                "pipeline_depth"):
+        assert key in stats, key
+    assert stats["latency_p50_ms"] > 0
+    assert stats["latency_p99_ms"] >= stats["latency_p50_ms"]
+
+
+def test_source_and_sink_metrics_land_in_default_registry(
+        engine_cfg, trained_logreg, tmp_path):
+    from real_time_fraud_detection_system_tpu.io.sink import ParquetSink
+    from real_time_fraud_detection_system_tpu.runtime import (
+        ReplaySource,
+        ScoringEngine,
+    )
+
+    model, txs = trained_logreg
+    reg = get_registry()
+    rows0 = reg.counter("rtfds_source_rows_total", source="replay").value
+    sink_rows0 = reg.counter("rtfds_sink_rows_total", sink="parquet").value
+    eng = ScoringEngine(engine_cfg, model.kind, model.params,
+                        model.scaler, metrics=MetricsRegistry())
+    src = ReplaySource(txs, START_EPOCH_S, batch_rows=1024)
+    sink = ParquetSink(str(tmp_path / "out"))
+    stats = eng.run(src, sink=sink, max_batches=2)
+    assert (reg.counter("rtfds_source_rows_total", source="replay").value
+            - rows0) >= stats["rows"]
+    assert (reg.counter("rtfds_sink_rows_total", sink="parquet").value
+            - sink_rows0) == stats["rows"]
+    assert reg.counter("rtfds_sink_bytes_total", sink="parquet").value > 0
+    assert reg.gauge("rtfds_source_lag_rows").value >= 0
+
+
+def test_checkpointer_metrics_and_flight_events(
+        engine_cfg, trained_logreg, tmp_path):
+    from real_time_fraud_detection_system_tpu.io import Checkpointer
+    from real_time_fraud_detection_system_tpu.runtime import (
+        ReplaySource,
+        ScoringEngine,
+    )
+    from real_time_fraud_detection_system_tpu.utils.metrics import (
+        set_active_recorder,
+    )
+
+    model, txs = trained_logreg
+    reg = get_registry()
+    saves0 = reg.counter("rtfds_checkpoint_ops_total", op="save",
+                         backend="local").value
+    path = str(tmp_path / "flight.jsonl")
+    rec = FlightRecorder(path, manifest={"model_kind": model.kind})
+    set_active_recorder(rec)
+    try:
+        import dataclasses as dc
+
+        cfg = engine_cfg.replace(runtime=dc.replace(
+            engine_cfg.runtime, checkpoint_every_batches=2))
+        eng = ScoringEngine(cfg, model.kind, model.params, model.scaler,
+                            metrics=MetricsRegistry())
+        ckpt = Checkpointer(str(tmp_path / "ck"))
+        eng.run(ReplaySource(txs, START_EPOCH_S, batch_rows=1024),
+                checkpointer=ckpt, max_batches=4)
+    finally:
+        set_active_recorder(None)
+        rec.close()
+    assert (reg.counter("rtfds_checkpoint_ops_total", op="save",
+                        backend="local").value - saves0) == 2
+    assert reg.gauge("rtfds_checkpoint_bytes").value > 0
+    _, records = FlightRecorder.read(path)
+    ck_events = [r for r in records
+                 if r["kind"] == "event" and r["event"] == "checkpoint"]
+    assert len(ck_events) == 2
+    assert ck_events[0]["op"] == "save"
+    assert ck_events[0]["bytes"] > 0
+    # the engine loop attached as the active recorder too: batch records
+    # interleave with checkpoint events in one run log
+    assert sum(1 for r in records if r["kind"] == "batch") == 4
+
+
+def test_fault_injection_counters(trained_logreg):
+    from real_time_fraud_detection_system_tpu.runtime import (
+        FlakySource,
+        ReplaySource,
+        TransientError,
+    )
+    from real_time_fraud_detection_system_tpu.runtime.faults import (
+        corrupt_messages,
+    )
+
+    _, txs = trained_logreg
+    reg = get_registry()
+    flaky0 = reg.counter("rtfds_faults_injected_total",
+                         kind="flaky_poll").value
+    corrupt0 = reg.counter("rtfds_faults_injected_total",
+                           kind="corrupt_envelope").value
+    src = FlakySource(ReplaySource(txs, START_EPOCH_S, batch_rows=512),
+                      fail_at=[0, 2])
+    with pytest.raises(TransientError):
+        src.poll_batch()
+    src.poll_batch()
+    with pytest.raises(TransientError):
+        src.poll_batch()
+    assert (reg.counter("rtfds_faults_injected_total",
+                        kind="flaky_poll").value - flaky0) == 2
+    corrupt_messages([b"x" * 10] * 34, corrupt_every=17)
+    assert (reg.counter("rtfds_faults_injected_total",
+                        kind="corrupt_envelope").value - corrupt0) == 2
+
+
+def test_instrumentation_overhead_bounded():
+    """Per-batch instrumentation cost: 5 phase observes + 2 counter incs
+    + 2 gauge sets + 1 latency observe, measured over 2000 synthetic
+    batches. The acceptance bar is <=3% of engine throughput; at the
+    tier-1 bench's ~10ms batches that allows 300µs — assert an order of
+    magnitude under it so the margin is structural, not luck."""
+    import time
+
+    reg = MetricsRegistry()
+    phases = [reg.histogram("rtfds_phase_seconds", phase=p)
+              for p in ("a", "b", "c", "d", "e")]
+    batches = reg.counter("rtfds_batches_total")
+    rows = reg.counter("rtfds_rows_total")
+    lat = reg.histogram("rtfds_batch_latency_seconds")
+    last = reg.gauge("rtfds_last_batch_unix_seconds")
+    depth = reg.gauge("rtfds_queue_depth")
+    n = 2000
+    t0 = time.perf_counter()
+    for i in range(n):
+        for h in phases:
+            h.observe(0.003)
+        batches.inc()
+        rows.inc(4096)
+        lat.observe(0.01)
+        last.set(1e9)
+        depth.set(2)
+    per_batch = (time.perf_counter() - t0) / n
+    assert per_batch < 30e-6, f"instrumentation {per_batch * 1e6:.1f}µs/batch"
+
+
+def test_kafka_style_source_never_sets_lag_gauge():
+    """A source that cannot compute a backlog must not register a
+    permanent-0 lag gauge — /healthz would check the fake zero and
+    report healthy while the consumer falls behind. The gauge is
+    registered lazily on first real set."""
+    from real_time_fraud_detection_system_tpu.runtime.sources import (
+        _SourceTelemetry,
+    )
+
+    reg = get_registry()
+    reg.clear()
+    try:
+        src = _SourceTelemetry()
+        src._init_source_metrics("kafka")
+        src._observe_poll(0.0, {"tx_id": [1, 2]})  # no lag known
+        assert reg.get("rtfds_source_lag_rows") is None
+        server = MetricsServer(port=0, registry=reg,
+                               max_source_lag_rows=10).start()
+        try:
+            ok, body = server.health()
+            assert ok
+            assert "source_lag_rows" not in body["checks"]
+        finally:
+            server.stop()
+        src._observe_poll(0.0, None, lag=50)  # a source that CAN: sets
+        assert reg.get("rtfds_source_lag_rows").value == 50
+    finally:
+        reg.clear()

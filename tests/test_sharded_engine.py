@@ -839,3 +839,49 @@ def test_sharded_emit_bf16_predictions_exact(small_dataset):
     assert fcols
     for c in fcols:
         np.testing.assert_allclose(bf[c][b], f32[c][a], rtol=1e-2, atol=1e-2)
+
+
+def test_commit_replicated_inspects_all_leaves():
+    """A params tree with a MIXED committed/uncommitted leaf set (e.g. a
+    hot reload that swapped one leaf to a host array) must be
+    re-committed: deciding from the first device leaf alone would skip
+    it and silently reintroduce the per-call retrace (ADVICE r5)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    params, scaler = _model()
+    eng = ShardedScoringEngine(_cfg(), kind="logreg", params=params,
+                               scaler=scaler, n_devices=N_DEV)
+    rep = NamedSharding(eng.mesh, P())
+    committed = eng.state.params
+    assert isinstance(committed.w.sharding, NamedSharding)
+    commits0 = eng._m_commits.value
+    # already fully committed: a no-op
+    eng._commit_replicated()
+    assert eng._m_commits.value == commits0
+
+    # first leaf committed, second leaf a fresh host/default-device array
+    # — the old first-leaf-wins check skipped this tree
+    mixed = committed._replace(b=jnp.zeros(()))
+    assert isinstance(mixed.w.sharding, NamedSharding)
+    assert not (isinstance(mixed.b.sharding, NamedSharding)
+                and mixed.b.sharding.mesh.shape == eng.mesh.shape)
+    eng.state.params = mixed
+    eng._commit_replicated()
+    assert eng._m_commits.value == commits0 + 1
+    for leaf in jax.tree.leaves(eng.state.params):
+        assert isinstance(leaf.sharding, NamedSharding)
+        assert leaf.sharding.mesh.shape == eng.mesh.shape
+    assert leaf.sharding == rep
+
+    # a raw NUMPY leaf has no .sharding at all — it is a host leaf and
+    # must trigger the commit too (skipping it would ride a host array
+    # into every sharded step call)
+    committed = eng.state.params
+    eng.state.params = committed._replace(b=np.zeros(()))
+    eng._commit_replicated()
+    assert eng._m_commits.value == commits0 + 2
+    for leaf in jax.tree.leaves(eng.state.params):
+        assert isinstance(leaf.sharding, NamedSharding)
+        assert leaf.sharding.mesh.shape == eng.mesh.shape

@@ -283,3 +283,35 @@ def test_store_checkpointer_flat_lineage(store):
     store.put("app/jobB/ckpt-0000000999.npz", b"other lineage")
     assert ck.list_checkpoints() == []
     assert ck.latest() is None
+
+
+def test_get_with_meta_consistent_with_head(store):
+    """The model-reload gate compares a stored signature built from
+    get_with_meta's metadata against head()'s on later polls: for an
+    unchanged object the two must be sig-equal (etag+size format), and
+    the metadata must describe the bytes actually returned."""
+    store.put("m/model.npz", b"v1-bytes")
+    data, meta = store.get_with_meta("m/model.npz")
+    assert data == b"v1-bytes"
+    head = store.head("m/model.npz")
+
+    def sig(md):
+        if md.get("etag") or md.get("size") is not None:
+            return f"{md.get('etag')}:{md.get('size')}"
+        return None
+
+    # a degenerate GET response (fakes without metadata) yields sig None
+    # — the caller then keeps the HEAD-derived signature; when the GET
+    # does carry metadata it must match head()'s for unchanged bytes
+    if sig(meta) is not None:
+        assert sig(meta) == sig(head)
+    store.put("m/model.npz", b"v2-bytes-longer")
+    data2, meta2 = store.get_with_meta("m/model.npz")
+    assert data2 == b"v2-bytes-longer"
+    if sig(meta2) is not None:
+        assert sig(meta2) != sig(meta)
+
+
+def test_get_with_meta_missing_key(store):
+    with pytest.raises(KeyError):
+        store.get_with_meta("nope/missing.npz")
