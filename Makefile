@@ -57,6 +57,12 @@ dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
+# produce a sample span trace on CPU (Chrome-trace JSON for Perfetto +
+# the ASCII waterfall) — the zero-hardware tour of the tracing layer
+trace-demo:
+	@mkdir -p $(OUT)
+	JAX_PLATFORMS=cpu $(PY) tools/trace_demo.py --out $(OUT)/trace_demo.json
+
 bench:
 	$(PY) bench.py
 
@@ -100,4 +106,4 @@ install:
 clean:
 	rm -rf $(OUT)
 
-.PHONY: demo datagen train score run-all query dashboard connectors dryrun bench test integration integration-up integration-down sqlcheck install clean
+.PHONY: demo datagen train score run-all query dashboard connectors dryrun trace-demo bench test integration integration-up integration-down sqlcheck install clean

@@ -1156,6 +1156,23 @@ def _child_main(args) -> None:
     if size_error:
         detail["size_scale_stopped"] = size_error
 
+    # Registry snapshot beside the headline (ROADMAP PR-1 note): the
+    # engine loops above populated rtfds_phase_seconds / rtfds_batch_
+    # latency_seconds / rtfds_xla_* in the process registry — dump the
+    # /metrics.json shape to a sidecar file so bench claims can cite
+    # per-phase p50s instead of re-deriving them from RTT decomposition.
+    snap_path = os.environ.get("BENCH_METRICS_OUT", "BENCH_METRICS.json")
+    try:
+        from real_time_fraud_detection_system_tpu.utils.metrics import (
+            get_registry,
+        )
+
+        with open(snap_path, "w", encoding="utf-8") as f:
+            json.dump(get_registry().snapshot(), f)
+        detail["metrics_snapshot"] = snap_path
+    except Exception as e:  # never let telemetry dumping kill the bench
+        detail["metrics_snapshot_error"] = f"{type(e).__name__}: {e}"
+
     value = round(best_tps, 1)
     if on_cpu and cpu_tps:
         # On CPU the framework serves via the sklearn oracle

@@ -588,6 +588,25 @@ def cmd_score(args) -> int:
 
     server = None
     recorder = None
+    tracer = None
+    if args.trace_out or args.metrics_port:
+        from real_time_fraud_detection_system_tpu.utils.trace import (
+            get_tracer,
+        )
+
+        # Span tracing for the serving run: per-batch waterfalls as
+        # Chrome-trace JSON (Perfetto / chrome://tracing / `rtfds
+        # trace`). The ring buffer keeps the most recent spans, so an
+        # unbounded stream stays memory-bounded — unlike --trace-dir's
+        # full jax.profiler capture. A --metrics-port run enables it
+        # too (µs/batch): GET /trace must serve a live timeline, not a
+        # silently empty one.
+        tracer = get_tracer().configure(enabled=True)
+        if args.trace_out:
+            log.info("span tracing on: will export %s", args.trace_out)
+        else:
+            log.info("span tracing on: GET /trace serves the live "
+                     "span ring buffer")
     if args.metrics_port or args.flight_record:
         from real_time_fraud_detection_system_tpu.utils.metrics import (
             FlightRecorder,
@@ -611,7 +630,9 @@ def cmd_score(args) -> int:
             args.flight_record,
             manifest=run_manifest(
                 cfg=cfg, model_kind=model.kind, scorer=args.scorer,
-                source=args.source, devices=args.devices))
+                source=args.source, devices=args.devices),
+            max_bytes=int(args.flight_record_max_mb * 2 ** 20)
+            if args.flight_record_max_mb > 0 else None)
         # process-wide: the engine loop, checkpointer, supervisor, and
         # fault injectors all append to this run's record
         set_active_recorder(recorder)
@@ -663,6 +684,18 @@ def cmd_score(args) -> int:
             recorder.close()
         if server is not None:
             server.stop()
+        if tracer is not None and args.trace_out:
+            # export even on a failed run — a crash mid-stream is
+            # exactly when the last batches' waterfalls matter
+            try:
+                man = tracer.export(args.trace_out)
+                log.info("span trace: %s (%d events) — summarize with "
+                         "`rtfds trace --trace %s`, or load in "
+                         "ui.perfetto.dev", man["trace"], man["events"],
+                         args.trace_out)
+            except OSError as e:
+                log.warning("span trace export to %s failed: %s",
+                            args.trace_out, e)
     if raw_table is not None:
         raw_table.flush()
         stats["raw_tx_rows"] = len(raw_table)
@@ -1059,6 +1092,63 @@ def cmd_dashboard(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Summarize an exported span trace: per-batch critical path, top-K
+    slowest spans, XLA compile/recompile events, and an ASCII waterfall
+    of the slowest (or a chosen) batch.
+
+    Input is the Chrome-trace JSON written by ``rtfds score
+    --trace-out``, fetched from the serving loop's ``GET /trace``, or
+    produced by ``make trace-demo`` — the same file loads graphically
+    in ui.perfetto.dev / chrome://tracing."""
+    from real_time_fraud_detection_system_tpu.io.dashboard import (
+        render_trace_waterfall,
+    )
+    from real_time_fraud_detection_system_tpu.utils.trace import (
+        summarize_chrome,
+    )
+
+    try:
+        with open(args.trace, encoding="utf-8") as f:
+            trace = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(_json_line({"error": f"{type(e).__name__}: {e}"}))
+        return 2
+    summary = summarize_chrome(trace, top_k=args.top_k)
+    if args.json:
+        print(_json_line(summary))
+        return 0
+    batches = summary["batches"]
+    print(f"{summary['n_events']} span events, {len(batches)} batches, "
+          f"{len(summary['compile_events'])} XLA compile events")
+    if batches:
+        worst = sorted(batches, key=lambda b: -b["total_ms"])[:args.top_k]
+        print(f"\nslowest batches (top {len(worst)}), critical phase "
+              "per batch:")
+        for b in worst:
+            phases = " ".join(f"{k}={v:.2f}" for k, v in
+                              b["phases_ms"].items())
+            print(f"  {b['trace_id']}  total {b['total_ms']:9.3f} ms  "
+                  f"critical {b['critical_phase']} "
+                  f"({b['critical_ms']:.3f} ms)  [{phases}]")
+    if summary["slowest_spans"]:
+        print(f"\nslowest spans (top {len(summary['slowest_spans'])}):")
+        for s in summary["slowest_spans"]:
+            print(f"  {s['dur_ms']:9.3f} ms  {s['name']:<16} "
+                  f"{s['trace_id'] or '-'}")
+    if summary["compile_events"]:
+        print("\nXLA compile/recompile events:")
+        for c in summary["compile_events"]:
+            extra = (" " + ", ".join(f"{k}={v}" for k, v in
+                                     c["args"].items())
+                     if c.get("args") else "")
+            print(f"  {c['name']:<14} {c['dur_ms']:9.3f} ms  "
+                  f"{c['trace_id'] or '-'}{extra}")
+    print()
+    print(render_trace_waterfall(trace, trace_id=args.batch or None))
+    return 0
+
+
 def cmd_compare(args) -> int:
     """Fit every requested model kind on one shared split and report
     metrics + fit/predict wall-clock per kind — the reference's
@@ -1370,6 +1460,17 @@ def main(argv=None) -> int:
                         "phase timings, queue depth) plus checkpoint/"
                         "feedback/fault events to this file; render it "
                         "with `rtfds dashboard --flight-record`")
+    p.add_argument("--flight-record-max-mb", type=float, default=256.0,
+                   help="rotate the flight record when it exceeds this "
+                        "many MB (previous generation kept at <path>.1; "
+                        "a `rotated` event marks the trip; 0 = "
+                        "unbounded)")
+    p.add_argument("--trace-out", default="",
+                   help="export per-batch span waterfalls as Chrome-"
+                        "trace JSON to this file at run end (load in "
+                        "ui.perfetto.dev or summarize with `rtfds "
+                        "trace`); bounded ring buffer — safe on "
+                        "unbounded streams, unlike --trace-dir")
     p.set_defaults(fn=cmd_score)
 
     p = sub.add_parser("demo",
@@ -1469,6 +1570,24 @@ def main(argv=None) -> int:
     p.add_argument("--title", default=None,
                    help="page title (default set in io.dashboard)")
     p.set_defaults(fn=cmd_dashboard, needs_backend=False)
+
+    p = sub.add_parser(
+        "trace",
+        help="summarize an exported span trace (critical path, top-K "
+             "slowest spans, recompiles, ASCII waterfall)",
+    )
+    p.add_argument("--trace", required=True,
+                   help="Chrome-trace JSON from `rtfds score "
+                        "--trace-out`, GET /trace, or make trace-demo")
+    p.add_argument("--top-k", type=int, default=10,
+                   help="slowest batches/spans to list")
+    p.add_argument("--batch", default="",
+                   help="trace id (e.g. b00000042) to render the "
+                        "waterfall for (default: the slowest batch)")
+    p.add_argument("--json", action="store_true",
+                   help="print the machine-readable summary as one "
+                        "JSON line instead of the text report")
+    p.set_defaults(fn=cmd_trace, needs_backend=False)
 
     p = sub.add_parser(
         "compare",

@@ -647,6 +647,63 @@ def write_ops_dashboard(
     }
 
 
+# ---------------------------------------------------------------------------
+# ASCII span waterfall: the terminal twin of the Perfetto timeline
+# ---------------------------------------------------------------------------
+
+def render_trace_waterfall(trace: dict, trace_id: Optional[str] = None,
+                           width: int = 56) -> str:
+    """Render one batch's span waterfall from a Chrome-trace JSON object
+    (as exported by ``utils/trace.py``) as plain ASCII — the
+    no-browser view `rtfds trace` prints.
+
+    ``trace_id`` picks the batch; default is the batch with the largest
+    total span time (the one an operator is hunting). Spans render in
+    start order, each bar positioned on the batch's time extent::
+
+        trace b00000003 — 3 spans, 12.42 ms span extent
+        source_poll    |##....................|    0.18 ms
+        host_prep      |..####................|    4.73 ms
+        dispatch       |......############....|    7.51 ms
+    """
+    events = [e for e in trace.get("traceEvents", [])
+              if e.get("ph") == "X"
+              and (e.get("args") or {}).get("trace_id")]
+    if not events:
+        return "no spans in trace"
+    by_id: Dict[str, List[dict]] = {}
+    for e in events:
+        by_id.setdefault(str(e["args"]["trace_id"]), []).append(e)
+    if trace_id is None:
+        trace_id = max(
+            by_id,
+            key=lambda t: sum(float(e.get("dur", 0.0)) for e in by_id[t]))
+    evs = by_id.get(str(trace_id))
+    if not evs:
+        known = ", ".join(sorted(by_id)[:8])
+        return (f"trace id {trace_id!r} not in trace "
+                f"(known ids: {known}{'…' if len(by_id) > 8 else ''})")
+    evs = sorted(evs, key=lambda e: float(e.get("ts", 0.0)))
+    t0 = min(float(e["ts"]) for e in evs)
+    t1 = max(float(e["ts"]) + float(e.get("dur", 0.0)) for e in evs)
+    span_us = max(t1 - t0, 1e-9)
+    name_w = max(len(str(e["name"])) for e in evs)
+    lines = [
+        f"trace {trace_id} — {len(evs)} spans, "
+        f"{span_us / 1e3:.2f} ms span extent"
+    ]
+    for e in evs:
+        s = int(width * (float(e["ts"]) - t0) / span_us)
+        w = max(1, int(round(width * float(e.get("dur", 0.0)) / span_us)))
+        s = min(s, width - 1)
+        w = min(w, width - s)
+        bar = "." * s + "#" * w + "." * (width - s - w)
+        lines.append(
+            f"{str(e['name']):<{name_w}} |{bar}| "
+            f"{float(e.get('dur', 0.0)) / 1e3:>9.3f} ms")
+    return "\n".join(lines)
+
+
 def write_dashboard(
     analyzed_dir: str,
     out_path: str,

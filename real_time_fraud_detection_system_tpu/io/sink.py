@@ -31,7 +31,13 @@ class _SinkTelemetry:
     (labeled by sink kind). Series resolve once per sink instance."""
 
     def _init_sink_metrics(self, sink_kind: str) -> None:
+        from real_time_fraud_detection_system_tpu.utils.trace import (
+            get_tracer,
+        )
+
         reg = get_registry()
+        self._tracer = get_tracer()
+        self._sink_kind = sink_kind
         self._m_write = reg.histogram(
             "rtfds_sink_write_seconds", "sink append wall time",
             sink=sink_kind)
@@ -43,10 +49,19 @@ class _SinkTelemetry:
             "rtfds_sink_failures_total", "failed appends", sink=sink_kind)
 
     def _observe_write(self, t0: float, rows: int, nbytes: int) -> None:
-        self._m_write.observe(time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        self._m_write.observe(t1 - t0)
         self._m_rows.inc(rows)
         if nbytes:
             self._m_bytes.inc(nbytes)
+        if self._tracer.enabled:
+            # Timeline-only (batch=""): the engine's sink_write span
+            # carries the batch attribution — with pipelining the
+            # tracer's CURRENT batch can be newer than the one whose
+            # rows are being written, so claiming it would lie. On the
+            # Perfetto timeline the span still nests under sink_write.
+            self._tracer.add_span(f"sink/{self._sink_kind}", t0, t1,
+                                  batch="", rows=rows, bytes=nbytes)
 
 
 def _result_to_columns(res) -> dict:

@@ -63,6 +63,13 @@ from real_time_fraud_detection_system_tpu.utils.metrics import (
     get_registry,
 )
 from real_time_fraud_detection_system_tpu.utils.timing import LatencyTracker
+from real_time_fraud_detection_system_tpu.utils.trace import get_tracer
+from real_time_fraud_detection_system_tpu.utils.xla_telemetry import (
+    DeviceMemoryTelemetry,
+    RecompileDetector,
+    install_compile_telemetry,
+    step_signature,
+)
 
 # The per-batch loop-time decomposition every layer reports under
 # (rtfds_phase_seconds{phase=...} and the flight record's "phases" dict):
@@ -373,6 +380,14 @@ class ScoringEngine:
             "wall-clock time the last batch finished (healthz input)")
         self._m_qdepth = reg.gauge(
             "rtfds_queue_depth", "micro-batches currently in flight")
+        # Tracing + XLA/device telemetry: the tracer is the process-wide
+        # one (disabled by default — span() is then one attribute check);
+        # compile counters are process-global (the jit cache is), while
+        # the recompile alarm and memory gauges honor THIS registry.
+        self.tracer = get_tracer()
+        install_compile_telemetry()
+        self._recompile = RecompileDetector(registry=reg)
+        self._devmem = DeviceMemoryTelemetry(reg)
 
     def _maybe_use_pallas_forest(self, kind: str, params) -> None:
         """Swap the tree-ensemble scorer for the fused Pallas kernel.
@@ -478,38 +493,46 @@ class ScoringEngine:
         # one O(n) hash pass + one fused pack pass, bit-identical
         # (differential-pinned); it lifts the host ceiling past what a
         # locally attached chip can consume. NumPy is the fallback.
-        use_native = native.hostprep_available()
-        keep = latest_wins_mask_host(cols["tx_id"], cols["kafka_ts_ms"])
-        cols = {k: v[keep] for k, v in cols.items()}
-        n = len(cols["tx_id"])
-        pad = bucket_size(n, self.cfg.runtime.batch_buckets)
-        if use_native:
-            packed = native.pack_rows(
-                cols["tx_datetime_us"], cols["customer_id"],
-                cols["terminal_id"], cols["tx_amount_cents"],
-                cols.get("label"), pad,
-            )
-            t1 = time.perf_counter()
-            jbatch = jnp.asarray(packed)
-        else:
-            packed = pack_batch(make_batch(
-                customer_id=cols["customer_id"],
-                terminal_id=cols["terminal_id"],
-                tx_datetime_us=cols["tx_datetime_us"],
-                amount_cents=cols["tx_amount_cents"],
-                label=cols.get("label"),
-                pad_to=pad,
-            ))
+        with self.tracer.span("host_prep"):
+            use_native = native.hostprep_available()
+            keep = latest_wins_mask_host(cols["tx_id"], cols["kafka_ts_ms"])
+            cols = {k: v[keep] for k, v in cols.items()}
+            n = len(cols["tx_id"])
+            pad = bucket_size(n, self.cfg.runtime.batch_buckets)
+            if use_native:
+                packed = native.pack_rows(
+                    cols["tx_datetime_us"], cols["customer_id"],
+                    cols["terminal_id"], cols["tx_amount_cents"],
+                    cols.get("label"), pad,
+                )
+            else:
+                packed = pack_batch(make_batch(
+                    customer_id=cols["customer_id"],
+                    terminal_id=cols["terminal_id"],
+                    tx_datetime_us=cols["tx_datetime_us"],
+                    amount_cents=cols["tx_amount_cents"],
+                    label=cols.get("label"),
+                    pad_to=pad,
+                ))
             # t1 sits after ALL host packing on both paths, so
             # prep_s/dispatch_s attribute the same stages either way
             t1 = time.perf_counter()
+        with self.tracer.span("dispatch", rows=n, pad=pad):
             jbatch = jnp.asarray(packed)
-        fstate, params, probs, feats = self._step(
-            self.state.feature_state, self.state.params, self.state.scaler, jbatch
-        )
-        self.state.feature_state = fstate
-        self.state.params = params
-        t2 = time.perf_counter()
+            # Steady-state recompile alarm: the signature keys on what
+            # the jit cache keys on from the engine's side — the packed
+            # batch's (shape, dtype) bucket plus the step's static facts
+            # (kind, donation layout). A compile observed inside this
+            # window after warmup is a retrace paid in the serving loop.
+            with self._recompile.step(step_signature(
+                    jbatch, static=(self.kind, "donate0"))):
+                fstate, params, probs, feats = self._step(
+                    self.state.feature_state, self.state.params,
+                    self.state.scaler, jbatch,
+                )
+            self.state.feature_state = fstate
+            self.state.params = params
+            t2 = time.perf_counter()
         return {"cols": cols, "n": n, "probs": probs, "feats": feats,
                 "t0": t0, "prep_s": t1 - t0, "dispatch_s": t2 - t1}
 
@@ -596,6 +619,9 @@ class ScoringEngine:
         self._m_batches.inc()
         self._m_rows.inc(n)
         self._m_last.set(time.time())
+        # Device-memory gauges ride the batch cadence; on backends
+        # without memory stats (CPU) this is a single boolean check.
+        self._devmem.sample()
         res = BatchResult(
             tx_id=cols["tx_id"],
             tx_datetime_us=cols["tx_datetime_us"],
@@ -635,7 +661,10 @@ class ScoringEngine:
     def process_batch(self, cols: dict) -> BatchResult:
         """One micro-batch: dedup → pad → device step → host result."""
         self._ensure_layout()
-        return self._finish_batch(self._start_batch(cols))
+        tid = self.tracer.begin_batch(self.state.batches_done + 1)
+        handle = self._start_batch(cols)
+        with self.tracer.span("result_wait", batch=tid):
+            return self._finish_batch(handle)
 
     @property
     def supports_online_sgd(self) -> bool:
@@ -840,7 +869,11 @@ class ScoringEngine:
 
         def _finish(handle: dict) -> None:
             t_block = time.perf_counter()
-            res = self._finish_batch(handle)
+            # explicit batch= : with pipeline_depth > 1 this handle's
+            # trace id is OLDER than the tracer's current batch
+            with self.tracer.span("result_wait",
+                                  batch=handle.get("trace_id")):
+                res = self._finish_batch(handle)
             # Loop-time decomposition: host prep (dedup + pad) vs H2D +
             # dispatch (the per-step overhead pipelining hides) vs the
             # result wait (device compute minus overlap).
@@ -858,16 +891,23 @@ class ScoringEngine:
             sink_s = 0.0
             if sink is not None:
                 t_sink = time.perf_counter()
-                sink.append(res)
+                with self.tracer.span("sink_write",
+                                      batch=handle.get("trace_id")):
+                    sink.append(res)
                 sink_s = time.perf_counter() - t_sink
                 phase_hist["sink_write"].observe(sink_s)
             if recorder is not None:
+                extra = {}
+                if handle.get("trace_id"):
+                    # cross-reference: a slow batch in the flight record
+                    # names its span waterfall in the exported trace
+                    extra["trace_id"] = handle["trace_id"]
                 recorder.record_batch(
                     res.batch_index, len(res.tx_id),
                     {"source_poll": pending["poll_s"],
                      "host_prep": prep_s, "dispatch": dispatch_s,
                      "result_wait": wait_s, "sink_write": sink_s},
-                    queue_depth=len(q), latency_s=res.latency_s,
+                    queue_depth=len(q), latency_s=res.latency_s, **extra,
                 )
                 pending["poll_s"] = 0.0
             if feedback is not None:
@@ -920,7 +960,15 @@ class ScoringEngine:
 
         def _poll():
             t_poll = time.perf_counter()
-            c = source.poll_batch()
+            # Attribute the poll to the batch that will CONSUME it (the
+            # same next-batch attribution the flight record uses via
+            # pending["poll_s"]): begin_batch(idx) only runs after the
+            # poll returns, so the current trace id here is still the
+            # PREVIOUS batch's.
+            nid = (f"b{self.state.batches_done + len(q) + 1:08d}"
+                   if self.tracer.enabled else None)
+            with self.tracer.span("source_poll", batch=nid):
+                c = source.poll_batch()
             dt = time.perf_counter() - t_poll
             _add_wait(dt)
             phase_hist["source_poll"].observe(dt)
@@ -986,8 +1034,10 @@ class ScoringEngine:
                 # first so no newer batch is in flight at save time.
                 _drain()
             idx = self.state.batches_done + len(q) + 1
+            tid = self.tracer.begin_batch(idx)
             handle = self._start_batch(cols)
             handle["index"] = idx
+            handle["trace_id"] = tid
             handle["source_offsets"] = offs
             q.append(handle)
             self._m_qdepth.set(len(q))

@@ -306,14 +306,25 @@ class FeedbackLoop:
 
     def poll_and_apply(self) -> int:
         """Drain available label events; returns number of rows learned."""
-        msgs = self._drain()
+        from real_time_fraud_detection_system_tpu.utils.trace import (
+            get_tracer,
+        )
+
+        tracer = get_tracer()
+        with tracer.span("feedback_poll"):
+            msgs = self._drain()
         if not msgs:
             return 0
-        applied = self._apply(msgs)
-        # At-least-once transports (KafkaFeedbackSource) commit only after
-        # apply succeeded: a crash in between replays, never drops.
-        if self.auto_commit:
-            self.commit()
+        # its own span (attributed to the current batch's trace id): a
+        # label burst landing between device steps is serving latency
+        # the per-phase decomposition alone cannot explain
+        with tracer.span("feedback_apply", events=len(msgs)):
+            applied = self._apply(msgs)
+            # At-least-once transports (KafkaFeedbackSource) commit only
+            # after apply succeeded: a crash in between replays, never
+            # drops.
+            if self.auto_commit:
+                self.commit()
         return applied
 
     def commit(self) -> None:

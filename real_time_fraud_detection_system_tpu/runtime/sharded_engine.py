@@ -60,6 +60,9 @@ from real_time_fraud_detection_system_tpu.runtime.engine import (
     ScoringEngine,
     loss_fn_for,
 )
+from real_time_fraud_detection_system_tpu.utils.xla_telemetry import (
+    step_signature,
+)
 
 
 class ShardedScoringEngine(ScoringEngine):
@@ -329,23 +332,29 @@ class ShardedScoringEngine(ScoringEngine):
         next batch's partition + H2D with this batch's mesh compute.
         """
         t0 = time.perf_counter()
-        keep = latest_wins_mask_host(cols["tx_id"], cols["kafka_ts_ms"])
-        cols = {k: v[keep] for k, v in cols.items()}
-        n = len(cols["tx_id"])
-        self._ensure_sharded()
-        if n:
-            # Same placement rule as partition_batch_spill (customer_id
-            # % n_dev): one bincount per batch, so the dashboard can see
-            # hot-key imbalance the moment it starts spilling.
-            loads = np.bincount(
-                (cols["customer_id"] % self.n_dev).astype(np.int64),
-                minlength=self.n_dev)
-            for i, g in enumerate(self._m_shard_rows):
-                g.set(int(loads[i]))
+        with self.tracer.span("host_prep"):
+            keep = latest_wins_mask_host(cols["tx_id"], cols["kafka_ts_ms"])
+            cols = {k: v[keep] for k, v in cols.items()}
+            n = len(cols["tx_id"])
+            self._ensure_sharded()
+            if n:
+                # Same placement rule as partition_batch_spill
+                # (customer_id % n_dev): one bincount per batch, so the
+                # dashboard can see hot-key imbalance the moment it
+                # starts spilling.
+                loads = np.bincount(
+                    (cols["customer_id"] % self.n_dev).astype(np.int64),
+                    minlength=self.n_dev)
+                for i, g in enumerate(self._m_shard_rows):
+                    g.set(int(loads[i]))
 
-        chunks = partition_batch_spill(
-            cols, self.n_dev, self.rows_per_shard
-        ) if n else []
+            chunks = partition_batch_spill(
+                cols, self.n_dev, self.rows_per_shard
+            ) if n else []
+        # host prep ends here: the chunk loop below is dispatch (make_
+        # batch + H2D + jit launches), split out so the sharded loop's
+        # phase decomposition matches the single-chip engine's.
+        t_prep = time.perf_counter()
         parts = []
         for part_cols, rows, pos in chunks:
             batch = make_batch(
@@ -371,47 +380,63 @@ class ShardedScoringEngine(ScoringEngine):
                 jbatch = jnp.asarray(pack_batch(batch))
             else:
                 jbatch = jax.tree.map(jnp.asarray, batch)
+            routed = bool(part_cols.get("__routed__", False))
             if self.kind == "sequence":
-                step = (self._seq_step_routed
-                        if part_cols.get("__routed__", False)
-                        else self._seq_step)
+                step = self._seq_step_routed if routed else self._seq_step
                 # original batch row index per chunk slot — the
                 # same-second tiebreaker (chunk packing permutes rows)
                 okey = np.zeros(len(part_cols["__valid__"]), np.int32)
                 okey[pos] = rows.astype(np.int32)
-                hstate, probs = step(
-                    self.state.feature_state, self.state.params, jbatch,
-                    jnp.asarray(okey))
+                sig = step_signature(
+                    *jax.tree.leaves(jbatch),
+                    static=(self.kind, routed, self.n_dev))
+                with self._recompile.step(sig):
+                    hstate, probs = step(
+                        self.state.feature_state, self.state.params,
+                        jbatch, jnp.asarray(okey))
                 self.state.feature_state = hstate
                 # the sequence scorer has no engineered feature matrix;
                 # None skips the feats copy (_finish_batch's buffer is 0)
                 parts.append((rows, pos, probs, None))
                 continue
-            if part_cols.get("__routed__", False):
-                if self._sharded_step_routed is None:
-                    self._m_step_builds.inc()
-                    self._sharded_step_routed = self._sharded_build_routed(
-                        self.state.feature_state, self.state.params,
-                        self.state.scaler, jbatch,
-                    )
-                step = self._sharded_step_routed
-            else:
-                if self._sharded_step is None:
-                    self._m_step_builds.inc()
-                    self._sharded_step = self._sharded_build(
-                        self.state.feature_state, self.state.params,
-                        self.state.scaler, jbatch,
-                    )
-                step = self._sharded_step
-            fstate, params, probs, feats = step(
-                self.state.feature_state, self.state.params,
-                self.state.scaler, jbatch,
-            )
+            # The detector window covers the lazy step BUILD too: a
+            # routed variant first compiled on a hot-key overflow deep
+            # into serving is a real in-loop compile and must alarm.
+            sig = step_signature(jbatch,
+                                 static=(self.kind, routed, self.n_dev))
+            with self._recompile.step(sig):
+                if routed:
+                    if self._sharded_step_routed is None:
+                        self._m_step_builds.inc()
+                        self._sharded_step_routed = \
+                            self._sharded_build_routed(
+                                self.state.feature_state, self.state.params,
+                                self.state.scaler, jbatch,
+                            )
+                    step = self._sharded_step_routed
+                else:
+                    if self._sharded_step is None:
+                        self._m_step_builds.inc()
+                        self._sharded_step = self._sharded_build(
+                            self.state.feature_state, self.state.params,
+                            self.state.scaler, jbatch,
+                        )
+                    step = self._sharded_step
+                fstate, params, probs, feats = step(
+                    self.state.feature_state, self.state.params,
+                    self.state.scaler, jbatch,
+                )
             self.state.feature_state = fstate
             self.state.params = params
             parts.append((rows, pos, probs, feats))
+        t_disp = time.perf_counter()
+        if chunks:
+            # one dispatch span over all chunk launches (the per-chunk
+            # jit calls are its children on the profiler timeline)
+            self.tracer.add_span("dispatch", t_prep, t_disp,
+                                 chunks=len(chunks))
         return {"cols": cols, "n": n, "parts": parts, "t0": t0,
-                "prep_s": time.perf_counter() - t0}
+                "prep_s": t_prep - t0, "dispatch_s": t_disp - t_prep}
 
     def _finish_batch(self, handle: dict) -> BatchResult:
         n = handle["n"]

@@ -45,7 +45,13 @@ class _SourceTelemetry:
     applies its lag threshold to. Series resolve once at construction."""
 
     def _init_source_metrics(self, source_kind: str) -> None:
+        from real_time_fraud_detection_system_tpu.utils.trace import (
+            get_tracer,
+        )
+
         reg = get_registry()
+        self._tracer = get_tracer()
+        self._source_kind = source_kind
         self._m_poll = reg.histogram(
             "rtfds_source_poll_seconds", "source poll_batch wall time",
             source=source_kind)
@@ -64,11 +70,21 @@ class _SourceTelemetry:
 
     def _observe_poll(self, t0: float, cols: Optional[dict],
                       lag: Optional[int] = None) -> None:
-        self._m_poll.observe(time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        self._m_poll.observe(t1 - t0)
+        n = 0
         if cols is not None:
             n = len(next(iter(cols.values()), ()))
             if n:
                 self._m_ingested.inc(n)
+        if self._tracer.enabled:
+            # Timeline-only (batch=""): the engine's source_poll span
+            # carries the batch attribution; with pipelining this poll
+            # may serve a LATER batch than the tracer's current one, so
+            # claiming the current id would lie. On the Perfetto
+            # timeline the span still nests under source_poll by time.
+            self._tracer.add_span(f"source/{self._source_kind}", t0, t1,
+                                  batch="", rows=n)
         if lag is not None:
             if self._m_lag is None:
                 self._m_lag = get_registry().gauge(

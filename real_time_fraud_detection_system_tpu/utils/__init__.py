@@ -21,3 +21,14 @@ from real_time_fraud_detection_system_tpu.utils.metrics import (  # noqa: F401
     run_manifest,
     set_active_recorder,
 )
+from real_time_fraud_detection_system_tpu.utils.trace import (  # noqa: F401
+    Tracer,
+    get_tracer,
+    summarize_chrome,
+)
+from real_time_fraud_detection_system_tpu.utils.xla_telemetry import (  # noqa: F401,E501
+    DeviceMemoryTelemetry,
+    RecompileDetector,
+    install_compile_telemetry,
+    step_signature,
+)

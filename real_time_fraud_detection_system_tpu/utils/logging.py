@@ -1,23 +1,85 @@
 """Structured logging — replaces the reference's bare ``print()``/``.show()``
-observability (SURVEY §5.5, e.g. ``fraud_detection.py:56``)."""
+observability (SURVEY §5.5, e.g. ``fraud_detection.py:56``).
+
+Environment knobs (read once, at first ``get_logger`` call):
+
+- ``RTFDS_LOG_LEVEL`` — root level for the ``rtfds`` logger tree
+  (``DEBUG``/``INFO``/``WARNING``/``ERROR``/``CRITICAL`` or a numeric
+  level; unknown values keep the INFO default and say so).
+- ``RTFDS_LOG_JSON=1`` — emit JSON lines instead of the human format.
+  Each record carries the current per-batch trace id
+  (``utils/trace.py``), so a log line lands next to its span waterfall:
+  ``jq 'select(.trace_id=="b00000042")'`` over the log is the textual
+  twin of filtering that batch in Perfetto.
+"""
 
 from __future__ import annotations
 
+import json
 import logging
+import os
 import sys
 
 _FORMAT = "%(asctime)s %(levelname).1s %(name)s: %(message)s"
 _configured = False
 
 
+class JsonLineFormatter(logging.Formatter):
+    """One JSON object per line: ts, level, logger, message, and the
+    current trace/batch id for log↔span correlation."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        # lazy import: logging must stay importable first (trace.py
+        # itself logs through get_logger)
+        from real_time_fraud_detection_system_tpu.utils.trace import (
+            current_ids,
+        )
+
+        trace_id, batch = current_ids()
+        out = {
+            "t": round(record.created, 6),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        if trace_id:
+            out["trace_id"] = trace_id
+            out["batch"] = batch
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, separators=(",", ":"), default=str)
+
+
+def _resolve_level(spec: str) -> int:
+    try:
+        return int(spec)
+    except ValueError:
+        pass
+    level = logging.getLevelName(spec.strip().upper())
+    return level if isinstance(level, int) else -1
+
+
 def get_logger(name: str = "rtfds") -> logging.Logger:
     global _configured
     if not _configured:
         handler = logging.StreamHandler(sys.stderr)
-        handler.setFormatter(logging.Formatter(_FORMAT, datefmt="%H:%M:%S"))
+        if os.environ.get("RTFDS_LOG_JSON", "") not in ("", "0"):
+            handler.setFormatter(JsonLineFormatter())
+        else:
+            handler.setFormatter(
+                logging.Formatter(_FORMAT, datefmt="%H:%M:%S"))
         root = logging.getLogger("rtfds")
         root.addHandler(handler)
         root.setLevel(logging.INFO)
+        spec = os.environ.get("RTFDS_LOG_LEVEL", "")
+        if spec:
+            level = _resolve_level(spec)
+            if level >= 0:
+                root.setLevel(level)
+            else:
+                root.warning(
+                    "RTFDS_LOG_LEVEL=%r is not a known level; keeping "
+                    "INFO", spec)
         root.propagate = False
         _configured = True
     if name == "rtfds" or name.startswith("rtfds."):

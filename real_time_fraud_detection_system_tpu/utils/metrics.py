@@ -374,8 +374,15 @@ class FlightRecorder:
     Kafka log).
     """
 
-    def __init__(self, path: str, manifest: Optional[dict] = None):
+    def __init__(self, path: str, manifest: Optional[dict] = None,
+                 max_bytes: Optional[int] = None):
+        """``max_bytes`` caps the JSONL's size: when an append pushes the
+        file past it, the file rotates to ``<path>.1`` (one generation,
+        overwritten on the next trip — disk use is bounded at ~2×cap)
+        and the fresh file opens with the manifest plus a ``rotated``
+        event. ``None``/0 = unbounded (the pre-rotation behavior)."""
         self.path = path
+        self.max_bytes = int(max_bytes) if max_bytes else 0
         self._lock = threading.Lock()
         self._f = open(path, "a", encoding="utf-8")
         self.manifest = dict(manifest or {})
@@ -400,6 +407,29 @@ class FlightRecorder:
         with self._lock:
             self._f.write(line + "\n")
             self._f.flush()
+            if self.max_bytes and self._f.tell() > self.max_bytes:
+                self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        """Size-cap rotation (caller holds the lock): current file moves
+        to ``<path>.1``; a fresh segment opens with the manifest and a
+        ``rotated`` event, so readers of the live path see an honest
+        marker instead of silently missing history."""
+        import os
+
+        rotated_bytes = self._f.tell()
+        self._f.close()
+        os.replace(self.path, self.path + ".1")
+        self._f = open(self.path, "a", encoding="utf-8")
+        for obj in (
+            {"kind": "manifest", **self.manifest},
+            {"kind": "event", "t": time.time(), "event": "rotated",
+             "previous": self.path + ".1",
+             "previous_bytes": rotated_bytes},
+        ):
+            self._f.write(json.dumps(obj, separators=(",", ":"),
+                                     default=str) + "\n")
+        self._f.flush()
 
     def record_batch(self, batch_index: int, rows: int,
                      phases: Dict[str, float], queue_depth: int = 0,
@@ -476,7 +506,9 @@ def active_recorder() -> Optional[FlightRecorder]:
 
 class MetricsServer:
     """Stdlib-only background HTTP server: ``/metrics`` (Prometheus
-    text), ``/metrics.json`` (snapshot), ``/healthz``.
+    text), ``/metrics.json`` (snapshot), ``/healthz``, ``/trace``
+    (the process tracer's span ring buffer as Chrome-trace JSON —
+    save the response body to a file and load it in ui.perfetto.dev).
 
     ``/healthz`` is 200 when the serving loop is making progress:
 
@@ -561,6 +593,18 @@ class MetricsServer:
                         self._send(200 if ok else 503,
                                    json.dumps(body).encode(),
                                    "application/json")
+                    elif path == "/trace":
+                        # lazy import: metrics stays importable without
+                        # the trace module (and vice versa — trace
+                        # imports metrics for its span counter)
+                        from real_time_fraud_detection_system_tpu.utils \
+                            .trace import get_tracer
+
+                        self._send(
+                            200,
+                            json.dumps(get_tracer().export_chrome())
+                            .encode(),
+                            "application/json")
                     else:
                         self._send(404, b'{"error":"not found"}',
                                    "application/json")

@@ -35,8 +35,17 @@ def enable_compilation_cache(path: Optional[str] = None) -> None:
     try:
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
-    except Exception:
-        pass  # older jax without the knobs: compile uncached
+    except Exception as e:
+        # A silently-cold cache costs 20-40 s PER COMPILE over the
+        # tunnel on every restart — the operator must see why.
+        from real_time_fraud_detection_system_tpu.utils.logging import (
+            get_logger,
+        )
+
+        get_logger("tracing").warning(
+            "persistent XLA compilation cache could not be enabled at "
+            "%s (%s: %s); every compile will run cold", path,
+            type(e).__name__, e)
 
 
 @contextlib.contextmanager

@@ -1,0 +1,95 @@
+"""Produce a sample span trace on CPU — the `make trace-demo` target.
+
+Runs a small synthetic stream through the single-chip engine with the
+process tracer enabled, exports the Chrome-trace JSON, and prints the
+`rtfds trace`-style summary plus the slowest batch's ASCII waterfall.
+The exported file loads directly in ui.perfetto.dev / chrome://tracing.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/trace_demo.py --out out/trace_demo.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="trace_demo.json")
+    ap.add_argument("--batches", type=int, default=12)
+    ap.add_argument("--batch-rows", type=int, default=1024)
+    args = ap.parse_args()
+
+    from real_time_fraud_detection_system_tpu.config import (
+        Config,
+        DataConfig,
+        FeatureConfig,
+        RuntimeConfig,
+        TrainConfig,
+    )
+    from real_time_fraud_detection_system_tpu.data import generate_dataset
+    from real_time_fraud_detection_system_tpu.io import MemorySink
+    from real_time_fraud_detection_system_tpu.io.dashboard import (
+        render_trace_waterfall,
+    )
+    from real_time_fraud_detection_system_tpu.models import train_model
+    from real_time_fraud_detection_system_tpu.runtime import (
+        ReplaySource,
+        ScoringEngine,
+    )
+    from real_time_fraud_detection_system_tpu.utils.timing import (
+        date_to_epoch_s,
+    )
+    from real_time_fraud_detection_system_tpu.utils.trace import (
+        get_tracer,
+        summarize_chrome,
+    )
+
+    cfg = Config(
+        data=DataConfig(n_customers=200, n_terminals=400, n_days=40,
+                        seed=0, start_date="2025-04-01"),
+        features=FeatureConfig(customer_capacity=512,
+                               terminal_capacity=1024),
+        train=TrainConfig(delta_train_days=20, delta_delay_days=5,
+                          delta_test_days=10, epochs=2),
+        runtime=RuntimeConfig(batch_buckets=(256, 1024, 4096)),
+    )
+    _, _, txs = generate_dataset(cfg.data)
+    model, _ = train_model(txs, cfg, kind="logreg")
+
+    tracer = get_tracer().configure(enabled=True)
+    engine = ScoringEngine(cfg, model.kind, model.params, model.scaler)
+    source = ReplaySource(txs, date_to_epoch_s(cfg.data.start_date),
+                          batch_rows=args.batch_rows)
+    stats = engine.run(source, sink=MemorySink(),
+                       max_batches=args.batches)
+
+    out_dir = os.path.dirname(os.path.abspath(args.out))
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+    manifest = tracer.export(args.out)
+    trace = tracer.export_chrome()
+    summary = summarize_chrome(trace, top_k=5)
+
+    print(f"scored {stats['rows']} rows in {stats['batches']} batches "
+          f"({stats['rows_per_s']:.0f} rows/s)")
+    print(f"trace: {manifest['trace']} ({manifest['events']} events) — "
+          "load in ui.perfetto.dev, or run "
+          f"`python -m real_time_fraud_detection_system_tpu.cli trace "
+          f"--trace {args.out}`")
+    print(f"compile events on the timeline: "
+          f"{len(summary['compile_events'])}")
+    print()
+    print(render_trace_waterfall(trace))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
