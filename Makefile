@@ -66,6 +66,12 @@ trace-demo:
 bench:
 	$(PY) bench.py
 
+# fast CPU perf gate: loop-thread sink_write stays enqueue-bounded under
+# the async sink, and precompiled serving records ZERO mid-stream XLA
+# recompiles across every bucket size (the PR-3 hot-loop invariants)
+perf-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_perf_smoke.py -q
+
 test:
 	$(PY) -m pytest tests/ -q
 
@@ -106,4 +112,4 @@ install:
 clean:
 	rm -rf $(OUT)
 
-.PHONY: demo datagen train score run-all query dashboard connectors dryrun trace-demo bench test integration integration-up integration-down sqlcheck install clean
+.PHONY: demo datagen train score run-all query dashboard connectors dryrun trace-demo bench perf-smoke test integration integration-up integration-down sqlcheck install clean

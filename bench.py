@@ -480,6 +480,7 @@ def _child_main(args) -> None:
     # ---- engine-loop latency (host decode + device step per micro-batch)
     _progress("engine loop")
     engine_stats = None
+    phase_p50 = None
     if args.model == "forest":
         from real_time_fraud_detection_system_tpu.runtime.engine import (
             ScoringEngine,
@@ -544,6 +545,120 @@ def _child_main(args) -> None:
         engine_stats = _engine_stats(
             ScoringEngine(ecfg, kind="forest", params=params, scaler=scaler)
         )
+
+        # ---- registry-backed before/after evidence (ROADMAP PR-1 note):
+        # per-phase p50s for sync vs async sink and precompile off/on,
+        # straight from the run-stats trackers + the engine's registry.
+        _progress("engine loop phase p50 before/after")
+
+        def _phase_p50_block():
+            import dataclasses as _pdc
+            import shutil
+            import tempfile
+
+            from real_time_fraud_detection_system_tpu.io.sink import (
+                AsyncSink,
+                ParquetSink,
+            )
+            from real_time_fraud_detection_system_tpu.utils.metrics import (
+                MetricsRegistry,
+            )
+
+            def _phases(s):
+                return {
+                    k: round(s[f"{k}_p50_ms"], 4)
+                    for k in ("host_prep", "dispatch", "result_wait",
+                              "sink_write")
+                }
+
+            out = {}
+            # sink_write: inline parquet write vs bounded-queue enqueue
+            for label, asynk in (("sink_sync", False), ("sink_async", True)):
+                d = tempfile.mkdtemp(prefix=f"rtfds_bench_{label}_")
+                sink = ParquetSink(d)
+                if asynk:
+                    sink = AsyncSink(sink, max_queue=8)
+                e = ScoringEngine(ecfg, kind="forest", params=params,
+                                  scaler=scaler)
+                e.run(_RandSource(1, engine_rows, seed=3), sink=sink,
+                      trigger_seconds=0.0)
+                s = e.run(_RandSource(n_eng, engine_rows), sink=sink,
+                          trigger_seconds=0.0)
+                if asynk:
+                    sink.close()
+                shutil.rmtree(d, ignore_errors=True)
+                out[label] = {"rows_per_s": round(s["rows_per_s"], 1),
+                              **_phases(s)}
+
+            # precompile: the second bucket size first lands MID-STREAM
+            # (after the recompile detector's warmup) — precompile off
+            # pays that compile inside the loop, on dispatches a ready
+            # executable and the counter stays 0
+            small = max(256, engine_rows // 4)
+
+            class _Scripted:
+                def __init__(self, sizes, seed=2):
+                    srng = np.random.default_rng(seed)
+                    self._b = []
+                    at = 0
+                    for n in sizes:
+                        c = _make_batch_cols(srng, n)
+                        self._b.append({
+                            "tx_id": np.arange(at, at + n, dtype=np.int64),
+                            "tx_datetime_us": c["tx_datetime_us"],
+                            "customer_id": c["customer_id"],
+                            "terminal_id": c["terminal_id"],
+                            "tx_amount_cents": c["amount_cents"],
+                            "kafka_ts_ms": c["tx_datetime_us"] // 1000,
+                        })
+                        at += n
+                    self._i = 0
+
+                def poll_batch(self):
+                    if self._i >= len(self._b):
+                        return None
+                    b = self._b[self._i]
+                    self._i += 1
+                    return b
+
+                @property
+                def offsets(self):
+                    return [self._i]
+
+                def seek(self, offsets):
+                    self._i = int(offsets[0])
+
+            sizes = [engine_rows] * 5 + [small, engine_rows, small]
+            for label, pre in (("precompile_off", False),
+                               ("precompile_on", True)):
+                reg = MetricsRegistry()
+                pcfg = Config(
+                    features=ecfg.features,
+                    runtime=_pdc.replace(
+                        ecfg.runtime, batch_buckets=(small, engine_rows),
+                        precompile=pre),
+                )
+                e = ScoringEngine(pcfg, kind="forest", params=params,
+                                  scaler=scaler, metrics=reg)
+                # warmup run triggers the precompile hook (when on), so
+                # the measured stream never includes build-time compiles
+                e.run(_RandSource(1, engine_rows, seed=3),
+                      trigger_seconds=0.0)
+                s = e.run(_Scripted(sizes), trigger_seconds=0.0)
+                rc = reg.get("rtfds_xla_recompiles_total")
+                out[label] = {
+                    "rows_per_s": round(s["rows_per_s"], 1),
+                    "latency_p99_ms": round(s["latency_p99_ms"], 3),
+                    "mid_stream_recompiles": int(rc.value) if rc else 0,
+                    **_phases(s),
+                }
+            return out
+
+        try:
+            phase_p50 = _phase_p50_block()
+        except Exception as e:
+            phase_p50 = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+
         if full:
             _progress("engine loop alerts-only")
             _guarded("alerts_only", lambda: _engine_stats(
@@ -1140,6 +1255,10 @@ def _child_main(args) -> None:
         "ingest_decoder": "native" if native.native_available() else
         "python",
     }
+    if phase_p50 is not None:
+        # before/after per-phase p50 evidence: sync vs async sink,
+        # precompile off vs on (mid_stream_recompiles is the proof)
+        detail["phase_p50_ms"] = phase_p50
     if z_stats is not None:
         detail["z_mode"] = z_stats
     if train_stats is not None:

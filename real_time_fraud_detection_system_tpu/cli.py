@@ -426,6 +426,10 @@ def cmd_score(args) -> int:
             log.info("selective emission: feature columns at %s are "
                      "populated only for rows with prob >= %.3g "
                      "(zeros elsewhere)", args.out, args.emit_threshold)
+    if args.latency_slo_ms < 0:
+        log.error("--latency-slo-ms must be >= 0, got %s",
+                  args.latency_slo_ms)
+        return 2
     cfg = cfg.replace(runtime=_dc.replace(
         cfg.runtime,
         emit_features=not args.alerts_only,
@@ -434,6 +438,12 @@ def cmd_score(args) -> int:
         pipeline_depth=args.pipeline_depth,
         coalesce_rows=args.coalesce_rows,
         use_pallas=args.use_pallas,
+        precompile=args.precompile,
+        # an SLO implies the controller: the knob is the intent
+        autobatch=args.autobatch or args.latency_slo_ms > 0,
+        latency_slo_ms=args.latency_slo_ms,
+        async_sink=args.async_sink,
+        sink_queue_batches=args.sink_queue_batches,
     ))
     cpu_model = None
     if args.scorer == "cpu":
@@ -566,6 +576,15 @@ def cmd_score(args) -> int:
         raw_table = RawTransactionsTable(args.raw_table,
                                          flush_every_batches=64)
         sink = FanoutSink(sink, raw_table)
+    if cfg.runtime.async_sink and sink is not None:
+        # Wrap OUTSIDE the fanout so one writer thread serves every
+        # destination in order; the engine drains it before checkpoint
+        # saves (offsets keep trailing durable output) and at run end.
+        from real_time_fraud_detection_system_tpu.io.sink import AsyncSink
+
+        sink = AsyncSink(sink, max_queue=cfg.runtime.sink_queue_batches)
+        log.info("async sink offload on (queue depth %d)",
+                 cfg.runtime.sink_queue_batches)
     if args.max_restarts > 0 and ckpt is None:
         log.error("--max-restarts requires --checkpoint-dir "
                   "(there is nothing to recover from without checkpoints)")
@@ -677,6 +696,14 @@ def cmd_score(args) -> int:
         close = getattr(source, "close", None)
         if close is not None:
             close()
+        if cfg.runtime.async_sink and sink is not None:
+            # stop the writer thread; never mask the run's own error
+            # with a drain-time one (it was already warn-logged)
+            try:
+                sink.close()
+            except Exception as e:
+                log.warning("async sink close: %s: %s",
+                            type(e).__name__, e)
         if fb is not None:
             fb.close()
         if recorder is not None:
@@ -701,6 +728,64 @@ def cmd_score(args) -> int:
         stats["raw_tx_rows"] = len(raw_table)
     log.info("done: %s", stats)
     print(_json_line({"scorer": args.scorer, **stats}))
+    return 0
+
+
+def cmd_warmup(args) -> int:
+    """AOT-compile the serving step for every batch bucket, then exit.
+
+    Run once per deploy (or in an init container): every bucket size ×
+    step variant is ``.lower(...).compile()``d through the persistent
+    compilation cache (``utils.enable_compilation_cache``), so the
+    serving process that follows — with or without ``--precompile`` —
+    starts warm instead of paying per-bucket XLA compiles inside the
+    stream (969 ms measured vs 8 ms steady-state per first-touch
+    bucket). Pass the same serving-shape flags you will serve with
+    (``--devices``, ``--online-lr``, emission mode): they change the
+    step's compiled program."""
+    import dataclasses as _dc
+    import time as _time
+
+    from real_time_fraud_detection_system_tpu.config import Config
+    from real_time_fraud_detection_system_tpu.io.artifacts import load_model
+    from real_time_fraud_detection_system_tpu.runtime import ScoringEngine
+    from real_time_fraud_detection_system_tpu.utils import get_logger
+
+    log = get_logger("warmup")
+    model = load_model(args.model_file)
+    cfg = Config()
+    cfg = cfg.replace(runtime=_dc.replace(
+        cfg.runtime,
+        emit_features=not args.alerts_only,
+        emit_threshold=args.emit_threshold,
+        emit_dtype="bfloat16" if args.emit_bf16 else "float32",
+        use_pallas=args.use_pallas,
+        precompile=True,
+    ))
+    t0 = _time.perf_counter()
+    if args.devices > 1:
+        from real_time_fraud_detection_system_tpu.runtime import (
+            ShardedScoringEngine,
+        )
+
+        engine = ShardedScoringEngine(
+            cfg, kind=model.kind, params=model.params, scaler=model.scaler,
+            n_devices=args.devices, online_lr=args.online_lr)
+    else:
+        engine = ScoringEngine(
+            cfg, kind=model.kind, params=model.params, scaler=model.scaler,
+            online_lr=args.online_lr)
+    man = engine.precompile()
+    out = {
+        "kind": model.kind,
+        "devices": args.devices,
+        "buckets": man["buckets"],
+        "variants": man["variants"],
+        "compile_seconds": man["seconds"],
+        "total_seconds": round(_time.perf_counter() - t0, 3),
+    }
+    log.info("warmup done: %s", out)
+    print(_json_line(out))
     return 0
 
 
@@ -1401,6 +1486,31 @@ def main(argv=None) -> int:
     p.add_argument("--coalesce-rows", type=int, default=0,
                    help="merge consecutive source polls into one device "
                         "batch up to this many rows (0 = off)")
+    p.add_argument("--precompile", action="store_true",
+                   help="AOT-compile the jitted step for every batch "
+                        "bucket before the first poll, so no bucket's "
+                        "first touch pays a mid-stream XLA compile "
+                        "(rtfds_xla_recompiles_total stays 0); see also "
+                        "`rtfds warmup`")
+    p.add_argument("--autobatch", action="store_true",
+                   help="adaptive micro-batching: move the coalesce "
+                        "target between the batch buckets from observed "
+                        "latency (maximize throughput, or hold "
+                        "--latency-slo-ms when set)")
+    p.add_argument("--latency-slo-ms", type=float, default=0.0,
+                   help="p50 micro-batch latency target for the "
+                        "adaptive batch controller (implies --autobatch;"
+                        " 0 = no SLO, maximize throughput)")
+    p.add_argument("--async-sink", action="store_true",
+                   help="offload sink appends to a background writer "
+                        "thread behind a bounded queue; the loop's "
+                        "sink_write phase becomes an enqueue, and "
+                        "checkpoints drain the queue first (exactly-"
+                        "once output is preserved)")
+    p.add_argument("--sink-queue-batches", type=int, default=8,
+                   help="bounded queue depth (batch results) for "
+                        "--async-sink; a full queue backpressures the "
+                        "loop thread")
     p.add_argument("--use-pallas", action="store_true",
                    help="serve with the fused Pallas kernels where "
                         "available (tree/forest/gbt leaf-sum; logreg "
@@ -1472,6 +1582,29 @@ def main(argv=None) -> int:
                         "trace`); bounded ring buffer — safe on "
                         "unbounded streams, unlike --trace-dir")
     p.set_defaults(fn=cmd_score)
+
+    p = sub.add_parser(
+        "warmup",
+        help="AOT-compile the serving step for every batch bucket "
+             "(fills the persistent compilation cache, then exits)")
+    p.add_argument("--model-file", required=True)
+    p.add_argument("--devices", type=int, default=1,
+                   help="warm the N-device sharded step instead of the "
+                        "single-chip one")
+    p.add_argument("--online-lr", type=float, default=0.0,
+                   help="match the serving flag: online SGD changes the "
+                        "compiled step")
+    p.add_argument("--alerts-only", action="store_true",
+                   help="match the serving flag (emit_features=False "
+                        "compiles a different step tail)")
+    p.add_argument("--emit-threshold", type=float, default=0.0,
+                   help="match the serving flag (selective emission "
+                        "compiles a different step tail)")
+    p.add_argument("--emit-bf16", action="store_true",
+                   help="match the serving flag")
+    p.add_argument("--use-pallas", action="store_true",
+                   help="match the serving flag")
+    p.set_defaults(fn=cmd_warmup)
 
     p = sub.add_parser("demo",
                        help="full E2E demo: datagen → CDC → sinks → scorer")

@@ -212,6 +212,32 @@ class RuntimeConfig:
     # Pad/bucket micro-batches to these row counts to keep the jit cache warm.
     batch_buckets: Sequence[int] = (256, 1024, 4096, 16384, 65536)
     max_batch_rows: int = 65536
+    # AOT bucket precompilation: at run start, .lower(...).compile() the
+    # jitted step for EVERY batch_buckets size (× the engine's donation
+    # signature) and serve from the compiled executables — no first-touch
+    # bucket size ever pays a mid-stream XLA compile (969 ms measured vs
+    # 8 ms steady-state; rtfds_xla_recompiles_total stays 0 by
+    # construction). Composes with the persistent compilation cache, so
+    # `rtfds warmup` makes later serving restarts warm too.
+    precompile: bool = False
+    # Adaptive micro-batch controller (runtime/autobatch.py): the
+    # coalesce target moves BETWEEN the configured batch_buckets from
+    # observed per-batch latency — hold latency_slo_ms when set, else
+    # hill-climb for throughput. Overrides coalesce_rows while active.
+    autobatch: bool = False
+    # p50 micro-batch latency target in ms for the autobatch controller
+    # (0 = no SLO: maximize throughput instead).
+    latency_slo_ms: float = 0.0
+    # Async sink offload (io/sink.py::AsyncSink): sink appends run on a
+    # background writer thread behind a bounded FIFO queue; the loop
+    # thread's sink_write phase collapses to an enqueue. Checkpoint
+    # saves drain the queue first, so offsets keep trailing durable sink
+    # output (the exactly-once invariant).
+    async_sink: bool = False
+    # Bounded queue depth (batch results) for the async sink; a full
+    # queue backpressures the loop thread
+    # (rtfds_sink_backpressure_seconds_total counts the blocked time).
+    sink_queue_batches: int = 8
     checkpoint_dir: str = "checkpoints"
     checkpoint_every_batches: int = 50
     n_partitions: int = 8

@@ -53,19 +53,41 @@ def decode_decimal_bytes(raw: bytes) -> int:
 def decode_decimal_batch(raws: Sequence[bytes]) -> np.ndarray:
     """Vectorized decode of many big-endian signed byte strings to int64 cents.
 
-    Left-pads every value to 8 bytes with its sign byte, then reinterprets the
-    packed buffer as big-endian int64 — one NumPy op instead of a Python loop
-    per row.
+    One packed pass: join every value into a single byte buffer, view it
+    with ``np.frombuffer``, and scatter bytes right-aligned into an
+    ``[n, 8]`` grid by (row, column) index arithmetic — no per-row Python
+    loop (the old fallback paid a short memcpy + branch per row). Sign
+    extension fills the leading pad bytes of negative values with 0xFF in
+    one masked assignment, then the grid reinterprets as big-endian
+    int64. Bit-identical to the scalar reference decoder and the C++
+    scanner (differential-pinned in tests).
     """
     n = len(raws)
+    if n == 0:
+        return np.zeros(0, dtype=np.int64)
+    lens = np.fromiter((len(r) for r in raws), dtype=np.int64, count=n)
+    if lens.max() > 8:
+        raise ValueError(
+            f"decimal wider than 8 bytes: {int(lens.max())}")
+    flat = np.frombuffer(b"".join(raws), dtype=np.uint8)
     buf = np.zeros((n, 8), dtype=np.uint8)
-    for i, r in enumerate(raws):  # short memcpy per row; C++ path replaces this
-        L = len(r)
-        if L > 8:
-            raise ValueError(f"decimal wider than 8 bytes: {L}")
-        buf[i, 8 - L:] = np.frombuffer(r, dtype=np.uint8)
-        if L and r[0] >= 0x80:
-            buf[i, : 8 - L] = 0xFF
+    if len(flat):
+        ends = np.cumsum(lens)
+        starts = ends - lens
+        # right-aligned scatter: byte j of row i lands at column
+        # 8 - len_i + j
+        row = np.repeat(np.arange(n), lens)
+        col = (np.arange(len(flat)) - np.repeat(starts, lens)
+               + np.repeat(8 - lens, lens))
+        buf[row, col] = flat
+        # sign-extend: rows whose first byte has the sign bit set get
+        # their leading pad bytes filled with 0xFF
+        nonempty = lens > 0
+        first = np.zeros(n, dtype=np.uint8)
+        first[nonempty] = flat[starts[nonempty]]
+        neg = nonempty & (first >= 0x80)
+        pad_cols = np.arange(8)[None, :] < (8 - lens)[:, None]
+        buf[neg[:, None] & pad_cols] = 0xFF
     return buf.view(">i8").astype(np.int64).ravel()
 
 

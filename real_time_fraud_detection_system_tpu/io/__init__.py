@@ -1,4 +1,5 @@
 from real_time_fraud_detection_system_tpu.io.sink import (  # noqa: F401
+    AsyncSink,
     ConsoleSink,
     IcebergSink,
     MemorySink,

@@ -17,6 +17,8 @@ not in this image, so it is import-gated the same way KafkaSource is.
 from __future__ import annotations
 
 import os
+import queue
+import threading
 import time
 from typing import List, Optional
 
@@ -108,6 +110,164 @@ class FanoutSink:
             f = getattr(s, "truncate_after", None)
             if f is not None:
                 f(batch_index)
+
+
+class _SinkError:
+    """Box for the writer thread's first failure (kept with its batch
+    index so the re-raise on the loop thread names what was lost)."""
+
+    __slots__ = ("exc", "batch_index")
+
+    def __init__(self, exc: BaseException, batch_index: int):
+        self.exc = exc
+        self.batch_index = batch_index
+
+
+class AsyncSink:
+    """Offload ``append`` to a background writer thread — the engine
+    loop's ``sink_write`` phase collapses to one bounded-queue enqueue.
+
+    The serving loop previously paid every sink write (parquet encode +
+    fsync-ish rename, an object-store PUT, an Iceberg commit) inline on
+    the loop thread between device steps — the largest remaining
+    synchronous I/O in the hot path. This wrapper keeps the device hot:
+
+    - **Ordered**: one writer thread drains a FIFO queue, so the inner
+      sink sees appends in exactly the loop's order (part-file naming,
+      raw-table flush cadence, and fanout ordering are unchanged).
+    - **Bounded + backpressured**: the queue holds at most
+      ``max_queue`` batch results; a full queue blocks the loop thread
+      (never unbounded host memory), and the blocked time is accounted
+      in ``rtfds_sink_backpressure_seconds_total`` so a sink that can't
+      keep up is visible, not silent. Queue occupancy rides
+      ``rtfds_sink_queue_depth``.
+    - **Errors propagate**: a writer-thread failure is re-raised on the
+      loop thread at the next ``append``/``drain``/``flush`` — with its
+      ORIGINAL exception type, so the supervisor's type-based
+      ``recover_on`` policy (OSError is recoverable, a bug is not)
+      applies exactly as it would to an inline write. The stream crashes
+      (and recovery replays) instead of silently dropping output; while
+      the failure is pending the writer discards queued results (their
+      batches replay from the checkpoint anyway), and the re-raise
+      clears it so a recovered incarnation resumes writing.
+    - **Drain contract**: ``drain()`` blocks until every queued append
+      has landed in the inner sink. ``flush``/``truncate_after``/
+      ``read_all``/``concat`` drain first, and the engine drains before
+      every checkpoint save — so checkpointed offsets keep TRAILING
+      durable sink output (the exactly-once invariant in
+      ``runtime/engine.py``'s checkpoint block: a crash replays rows,
+      never skips them, and replayed ``batch_index`` parts overwrite).
+    """
+
+    _STOP = object()
+
+    def __init__(self, inner, max_queue: int = 8, registry=None):
+        if inner is None:
+            raise ValueError("AsyncSink needs an inner sink")
+        self.inner = inner
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(max_queue)))
+        self._error: Optional[_SinkError] = None
+        # injectable like the engine's registry, so per-run before/after
+        # measurements don't cross-contaminate the process-wide series
+        reg = registry if registry is not None else get_registry()
+        kind = type(inner).__name__
+        self._m_depth = reg.gauge(
+            "rtfds_sink_queue_depth",
+            "batch results queued for the async sink writer", sink=kind)
+        self._m_backpressure = reg.counter(
+            "rtfds_sink_backpressure_seconds_total",
+            "loop-thread seconds blocked on a full async sink queue",
+            sink=kind)
+        self._thread = threading.Thread(
+            target=self._writer, daemon=True, name="rtfds-sink-writer")
+        self._thread.start()
+
+    # -- writer thread -----------------------------------------------------
+
+    def _writer(self) -> None:
+        while True:
+            item = self._q.get()
+            try:
+                if item is self._STOP:
+                    return
+                if self._error is None:
+                    try:
+                        self.inner.append(item)
+                    except BaseException as e:  # propagate to loop thread
+                        self._error = _SinkError(
+                            e, int(getattr(item, "batch_index", -1)))
+                        from real_time_fraud_detection_system_tpu.utils \
+                            import get_logger
+
+                        get_logger("sink").warning(
+                            "async sink write failed on batch %d (%s: %s);"
+                            " surfacing to the serving loop",
+                            self._error.batch_index, type(e).__name__, e)
+                # while a failure is pending: keep draining (so drain()
+                # never deadlocks) but write nothing — those batches
+                # replay from the checkpoint after recovery
+            finally:
+                self._q.task_done()
+                self._m_depth.set(self._q.qsize())
+
+    def _raise_pending(self) -> None:
+        err = self._error
+        if err is not None:
+            # Clear-then-raise: the raise hands ownership to the engine/
+            # supervisor; a recovered incarnation (same sink object,
+            # replayed batches) must resume writing, not re-crash on a
+            # stale box. The ORIGINAL exception object is raised so the
+            # supervisor's recover_on type policy sees what an inline
+            # write would have thrown.
+            self._error = None
+            raise err.exc
+
+    # -- sink API (loop thread) --------------------------------------------
+
+    def append(self, res) -> None:
+        self._raise_pending()
+        t0 = time.perf_counter()
+        self._q.put(res)  # blocks when full: bounded-memory backpressure
+        waited = time.perf_counter() - t0
+        if waited > 1e-4:  # an uncontended put is ~µs; only count blocks
+            self._m_backpressure.inc(waited)
+        self._m_depth.set(self._q.qsize())
+
+    def drain(self) -> None:
+        """Block until every queued append has landed (or failed) in the
+        inner sink; re-raise any writer failure on this thread."""
+        self._q.join()
+        self._raise_pending()
+
+    def flush(self) -> None:
+        self.drain()
+        f = getattr(self.inner, "flush", None)
+        if f is not None:
+            f()
+
+    def truncate_after(self, batch_index: int) -> None:
+        # drain first: a queued part beyond the fence must land before
+        # the fence can see (and remove) it
+        self.drain()
+        f = getattr(self.inner, "truncate_after", None)
+        if f is not None:
+            f(batch_index)
+
+    def read_all(self) -> dict:
+        self.drain()
+        return self.inner.read_all()
+
+    def concat(self) -> dict:
+        self.drain()
+        return self.inner.concat()
+
+    def close(self) -> None:
+        """Drain, stop the writer thread, and surface any pending error."""
+        if self._thread.is_alive():
+            self._q.join()
+            self._q.put(self._STOP)
+            self._thread.join(timeout=30.0)
+        self._raise_pending()
 
 
 class MemorySink:

@@ -24,6 +24,9 @@ from real_time_fraud_detection_system_tpu.runtime.faults import (  # noqa: F401
     run_with_recovery,
     with_retries,
 )
+from real_time_fraud_detection_system_tpu.runtime.autobatch import (  # noqa: F401
+    AutoBatchController,
+)
 from real_time_fraud_detection_system_tpu.runtime.pipeline import (  # noqa: F401
     run_demo,
 )

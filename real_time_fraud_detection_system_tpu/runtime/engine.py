@@ -388,6 +388,134 @@ class ScoringEngine:
         install_compile_telemetry()
         self._recompile = RecompileDetector(registry=reg)
         self._devmem = DeviceMemoryTelemetry(reg)
+        # AOT-precompiled step executables (see precompile()): dispatch
+        # key -> jax Compiled. Empty = plain jit dispatch.
+        self._aot = {}
+        self._aot_params_sig = None
+        self._m_precompiled = reg.counter(
+            "rtfds_precompiled_steps_total",
+            "step executables AOT-compiled at warmup (bucket sizes x "
+            "variants)")
+        self._m_aot_fallbacks = reg.counter(
+            "rtfds_aot_fallbacks_total",
+            "dispatches that fell back from an AOT executable to jit "
+            "(input signature drifted from the precompiled one)")
+
+    # -- AOT bucket precompilation ----------------------------------------
+
+    @staticmethod
+    def _sds(tree):
+        """Pytree → ShapeDtypeStruct pytree for .lower() (shapes, dtypes
+        and — when leaves carry one — shardings; never touches buffers,
+        so donation at trace time is free)."""
+        def one(x):
+            sh = getattr(x, "sharding", None)
+            if sh is not None:
+                return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
+            a = np.asarray(x)
+            return jax.ShapeDtypeStruct(a.shape, jnp.asarray(a).dtype)
+
+        return jax.tree.map(one, tree)
+
+    @staticmethod
+    def _params_sig(params) -> tuple:
+        """(shape, dtype) fingerprint of a params tree — the facts an AOT
+        step executable was compiled against. A hot model reload that
+        changes it invalidates the AOT cache (jit would retrace; the
+        compiled executables would just reject the call)."""
+        return tuple(
+            (tuple(np.shape(leaf)), str(jnp.asarray(leaf).dtype))
+            for leaf in jax.tree.leaves(params)
+        )
+
+    def precompile(self) -> dict:
+        """AOT-compile the jitted step for EVERY configured bucket size.
+
+        ``self._step.lower(...).compile()`` per ``runtime.batch_buckets``
+        entry (shape-only templates — no step executes, no state is
+        touched), so a stream that visits a bucket size for the first
+        time mid-serve dispatches a ready executable instead of paying a
+        mid-stream XLA compile (969 ms measured vs 8 ms steady-state on
+        this hardware). Composes with the persistent compilation cache
+        (``utils.enable_compilation_cache``): a ``rtfds warmup`` run
+        leaves the cache hot for later serving processes too.
+
+        Returns a manifest (bucket sizes, variants, wall seconds) for CLI
+        printing. Idempotent — already-compiled keys are skipped.
+        """
+        t0 = time.perf_counter()
+        # Scalar leaves (python floats in some param trees) trace as weak
+        # types under jit but compile strong under an SDS; commit them to
+        # arrays once so runtime calls match the AOT signature.
+        self.state.params = jax.tree.map(jnp.asarray, self.state.params)
+        self._aot_params_sig = self._params_sig(self.state.params)
+        fstate_t = self._sds(self.state.feature_state)
+        params_t = self._sds(self.state.params)
+        scaler_t = self._sds(self.state.scaler)
+        done = []
+        with self.tracer.span("precompile"):
+            for b in sorted(set(self.cfg.runtime.batch_buckets)):
+                key = ("step", 7, int(b))
+                if key in self._aot:
+                    continue
+                batch_t = jax.ShapeDtypeStruct((7, int(b)), jnp.int32)
+                self._aot[key] = self._step.lower(
+                    fstate_t, params_t, scaler_t, batch_t).compile()
+                self._m_precompiled.inc()
+                done.append(int(b))
+        return {
+            "buckets": done,
+            "variants": 1,
+            "seconds": round(time.perf_counter() - t0, 3),
+        }
+
+    def _note_params_swap(self, params):
+        """Hot-reload hook: keep AOT serving only while the swapped-in
+        params match the precompiled shape family; otherwise drop the
+        cache (fall back to jit, which retraces — slower, correct)."""
+        if not self._aot:
+            return params
+        params = jax.tree.map(jnp.asarray, params)
+        if self._params_sig(params) != self._aot_params_sig:
+            from real_time_fraud_detection_system_tpu.utils import (
+                get_logger,
+            )
+
+            get_logger("engine").warning(
+                "model reload changed the params shape family; dropping "
+                "%d AOT step executables (dispatch falls back to jit — "
+                "rerun precompile/warmup for the new shapes)",
+                len(self._aot))
+            self._aot = {}
+            self._aot_params_sig = None
+        return params
+
+    def _dispatch_step(self, key, jit_fn, *args):
+        """Serve from the AOT executable when one exists for ``key``;
+        an input-signature rejection permanently falls back to plain jit
+        dispatch for the whole cache — correctness first, the
+        optimization second. Only PRE-EXECUTION rejections (TypeError/
+        ValueError from the compiled call's argument check) fall back:
+        they leave the donated buffers intact, so the jit retry is safe.
+        A runtime failure (e.g. an XLA OOM mid-execution) propagates
+        unwrapped — retrying it on possibly-donated inputs would mask
+        the real error behind an 'array deleted' crash."""
+        fn = self._aot.get(key) if self._aot else None
+        if fn is not None:
+            try:
+                return fn(*args)
+            except (TypeError, ValueError) as e:
+                self._m_aot_fallbacks.inc()
+                from real_time_fraud_detection_system_tpu.utils import (
+                    get_logger,
+                )
+
+                get_logger("engine").warning(
+                    "AOT step dispatch for %s rejected the call (%s: "
+                    "%s); disabling the AOT cache and falling back to "
+                    "jit", key, type(e).__name__, str(e)[:200])
+                self._aot = {}
+        return jit_fn(*args)
 
     def _maybe_use_pallas_forest(self, kind: str, params) -> None:
         """Swap the tree-ensemble scorer for the fused Pallas kernel.
@@ -526,7 +654,8 @@ class ScoringEngine:
             # window after warmup is a retrace paid in the serving loop.
             with self._recompile.step(step_signature(
                     jbatch, static=(self.kind, "donate0"))):
-                fstate, params, probs, feats = self._step(
+                fstate, params, probs, feats = self._dispatch_step(
+                    ("step",) + tuple(jbatch.shape), self._step,
                     self.state.feature_state, self.state.params,
                     self.state.scaler, jbatch,
                 )
@@ -817,6 +946,11 @@ class ScoringEngine:
         Returns run stats (rows, batches, throughput, latency percentiles).
         """
         self._ensure_layout()  # cross-width checkpoint restores convert
+        if self.cfg.runtime.precompile and not self._aot:
+            # AOT bucket precompilation: every bucket size compiles NOW,
+            # before the first poll — no first-touch compile ever lands
+            # mid-stream (rtfds_xla_recompiles_total stays 0).
+            self.precompile()
         if model_reload is not None and self.online_lr > 0.0:
             from real_time_fraud_detection_system_tpu.utils import (
                 get_logger,
@@ -846,7 +980,17 @@ class ScoringEngine:
             "host_prep": LatencyTracker(),
             "dispatch": LatencyTracker(),
             "result_wait": LatencyTracker(),
+            "sink_write": LatencyTracker(),
         }
+        auto = None
+        if self.cfg.runtime.autobatch:
+            from real_time_fraud_detection_system_tpu.runtime.autobatch \
+                import AutoBatchController
+
+            auto = AutoBatchController(
+                self.cfg.runtime.batch_buckets,
+                latency_slo_ms=self.cfg.runtime.latency_slo_ms,
+                registry=self.metrics)
         recorder = self.recorder if self.recorder is not None \
             else active_recorder()
         phase_hist = self._m_phase
@@ -890,12 +1034,19 @@ class ScoringEngine:
             self.state.offsets = handle["source_offsets"]
             sink_s = 0.0
             if sink is not None:
+                # With an AsyncSink this measures the ENQUEUE (plus any
+                # backpressure block) — the loop thread's actual cost;
+                # the write itself runs on the sink's writer thread and
+                # reports through rtfds_sink_write_seconds.
                 t_sink = time.perf_counter()
                 with self.tracer.span("sink_write",
                                       batch=handle.get("trace_id")):
                     sink.append(res)
                 sink_s = time.perf_counter() - t_sink
                 phase_hist["sink_write"].observe(sink_s)
+                trackers["sink_write"].record(sink_s)
+            if auto is not None:
+                auto.observe(len(res.tx_id), res.latency_s)
             if recorder is not None:
                 extra = {}
                 if handle.get("trace_id"):
@@ -929,10 +1080,18 @@ class ScoringEngine:
                 swap = model_reload()
                 if swap is not None:
                     new_params, new_scaler = swap
-                    self.state.params = new_params
+                    self.state.params = self._note_params_swap(new_params)
                     if new_scaler is not None:
                         self.state.scaler = new_scaler
             if checkpointer is not None and self.state.batches_done % every == 0:
+                # Drain an async sink BEFORE the state save: checkpointed
+                # offsets must TRAIL durable sink output (a crash then
+                # replays rows into parts that already landed — the
+                # exactly-once overwrite — never records progress for
+                # writes still sitting in a queue).
+                drain = getattr(sink, "drain", None)
+                if drain is not None:
+                    drain()
                 checkpointer.save(self.state)
                 # Broker-side offsets (sources that have them, e.g. Kafka)
                 # are committed only AFTER the framework checkpoint lands:
@@ -943,8 +1102,10 @@ class ScoringEngine:
                     commit()
                 if feedback is not None:
                     feedback.commit()
-            if trigger > 0:
-                time.sleep(max(0.0, trigger - res.latency_s))
+            # NOTE: trigger pacing used to sleep HERE, once per finished
+            # handle — so _drain() stacked one sleep per queued batch
+            # before every checkpoint/idle flush. Pacing now happens once
+            # per loop pass on the poll side (see the main loop).
 
         def _add_wait(dt: float) -> None:
             # Waiting for the NEXT batch to arrive is not part of any
@@ -978,12 +1139,25 @@ class ScoringEngine:
         exhausted = False
         carry = None  # (cols, offsets): a poll beyond the coalesce cap
         cap = max(self.cfg.runtime.batch_buckets)
+        t_last_start = None  # previous batch's dispatch time (pacing)
         while not exhausted:
             if heartbeat is not None:
                 heartbeat.beat()
             started = self.state.batches_done + len(q)
             if max_batches and started >= max_batches:
                 break
+            if trigger > 0 and t_last_start is not None:
+                # Trigger pacing, once per loop pass on the POLL side:
+                # batch starts stay >= trigger apart while already-
+                # dispatched batches keep computing through the sleep.
+                # (Pacing used to run inside _finish, stacking one sleep
+                # per queued handle on every drain.) The slept time is
+                # credited as wait so in-flight latencies measure the
+                # pipeline, not the pacing.
+                dt = trigger - (time.perf_counter() - t_last_start)
+                if dt > 0:
+                    time.sleep(dt)
+                    _add_wait(dt)
             if carry is not None:
                 cols, offs = carry
                 carry = None
@@ -1002,12 +1176,16 @@ class ScoringEngine:
                         time.sleep(trigger)
                     continue
                 offs = list(source.offsets)
-            if coalesce > 0:
+            # The adaptive controller overrides the static coalesce
+            # target while active (it only MERGES small polls upward —
+            # an oversized poll still bucket-pads as before).
+            assemble = auto.target_rows() if auto is not None else coalesce
+            if assemble > 0:
                 # Never assemble past the largest jit bucket: a poll that
                 # would overflow is carried into the NEXT batch, and its
                 # rows stay excluded from this batch's checkpoint offsets
                 # (a crash must replay them, not skip them).
-                target = min(coalesce, cap)
+                target = min(assemble, cap)
                 parts = [cols]
                 total = len(next(iter(cols.values())))
                 while total < target:
@@ -1036,6 +1214,7 @@ class ScoringEngine:
             idx = self.state.batches_done + len(q) + 1
             tid = self.tracer.begin_batch(idx)
             handle = self._start_batch(cols)
+            t_last_start = time.perf_counter()
             handle["index"] = idx
             handle["trace_id"] = tid
             handle["source_offsets"] = offs
@@ -1046,6 +1225,13 @@ class ScoringEngine:
                 self._m_qdepth.set(len(q))
         _drain()
         self._m_qdepth.set(0)
+        # Async sinks drain before run() returns: the caller's follow-up
+        # (final checkpoint save, offset commits, reading the output)
+        # must see fully-landed writes, and a deferred writer error must
+        # surface in THIS run, not on some later call.
+        sink_drain = getattr(sink, "drain", None)
+        if sink_drain is not None:
+            sink_drain()
         wall = time.perf_counter() - t_start
         # LatencyTracker-backed snapshots: exact percentiles over the
         # bounded recent window (identical to the old full-list math for
@@ -1063,8 +1249,12 @@ class ScoringEngine:
             "host_prep_p50_ms": snaps["host_prep"].get("p50_ms", 0.0),
             "dispatch_p50_ms": snaps["dispatch"].get("p50_ms", 0.0),
             "result_wait_p50_ms": snaps["result_wait"].get("p50_ms", 0.0),
+            "sink_write_p50_ms": snaps["sink_write"].get("p50_ms", 0.0),
             "pipeline_depth": depth,
         }
+        if auto is not None:
+            stats["autobatch_target_rows"] = auto.target_rows()
+            stats["autobatch_adjustments"] = auto.adjustments
         if self._selective:
             # per-run delta, like rows/batches — nonzero tells the
             # operator the threshold/cap calibration is sending full

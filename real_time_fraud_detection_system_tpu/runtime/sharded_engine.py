@@ -321,6 +321,60 @@ class ShardedScoringEngine(ScoringEngine):
                 self.state.feature_state, self.mesh, axis=self.axis
             )
 
+    # -- AOT precompilation over the mesh ----------------------------------
+
+    def precompile(self) -> dict:
+        """AOT-compile BOTH sharded step variants before the first poll.
+
+        The sharded step has one shape family (chunks are always
+        ``[7, n_dev * rows_per_shard]``), but TWO lazily-built variants:
+        the owner-local step and the dense-spill ROUTED step, which
+        otherwise first compiles on a hot-key overflow deep into serving
+        — a real mid-stream compile (969 ms measured vs 8 ms
+        steady-state) landing exactly when load spikes. Both compile
+        here, via the same ``.lower(...).compile()`` path as the
+        single-chip engine (shape-only templates; no step executes).
+        """
+        if self.kind == "sequence":
+            # the sequence steps are built in __init__ with a single
+            # chunk shape; their AOT path is not wired (pytree batches)
+            return {"buckets": [], "variants": 0, "seconds": 0.0,
+                    "skipped": "sequence"}
+        t0 = time.perf_counter()
+        self._ensure_layout()
+        self._ensure_sharded()
+        self.state.params = jax.tree.map(jnp.asarray, self.state.params)
+        self._aot_params_sig = self._params_sig(self.state.params)
+        fstate_t = self._sds(self.state.feature_state)
+        params_t = self._sds(self.state.params)
+        scaler_t = self._sds(self.state.scaler)
+        total = self.n_dev * self.rows_per_shard
+        batch_t = jax.ShapeDtypeStruct((7, total), jnp.int32)
+        variants = 0
+        with self.tracer.span("precompile"):
+            for routed, build in ((False, self._sharded_build),
+                                  (True, self._sharded_build_routed)):
+                key = ("sharded", routed)
+                if key in self._aot:
+                    continue
+                # templates carry pytree structure only; SDS trees serve
+                step = build(fstate_t, params_t, scaler_t, batch_t)
+                if routed and self._sharded_step_routed is None:
+                    self._m_step_builds.inc()
+                    self._sharded_step_routed = step
+                elif not routed and self._sharded_step is None:
+                    self._m_step_builds.inc()
+                    self._sharded_step = step
+                self._aot[key] = step.lower(
+                    fstate_t, params_t, scaler_t, batch_t).compile()
+                self._m_precompiled.inc()
+                variants += 1
+        return {
+            "buckets": [total],
+            "variants": variants,
+            "seconds": round(time.perf_counter() - t0, 3),
+        }
+
     # -- the sharded hot path ----------------------------------------------
 
     def _start_batch(self, cols: dict) -> dict:
@@ -422,7 +476,8 @@ class ShardedScoringEngine(ScoringEngine):
                             self.state.scaler, jbatch,
                         )
                     step = self._sharded_step
-                fstate, params, probs, feats = step(
+                fstate, params, probs, feats = self._dispatch_step(
+                    ("sharded", routed), step,
                     self.state.feature_state, self.state.params,
                     self.state.scaler, jbatch,
                 )

@@ -1,0 +1,236 @@
+"""AsyncSink: ordering, backpressure, error propagation, and the
+crash/replay drain contract (checkpoint offsets trail durable output)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from real_time_fraud_detection_system_tpu.config import (
+    Config,
+    DataConfig,
+    FeatureConfig,
+    RuntimeConfig,
+)
+from real_time_fraud_detection_system_tpu.io import Checkpointer
+from real_time_fraud_detection_system_tpu.io.sink import (
+    AsyncSink,
+    MemorySink,
+    ParquetSink,
+)
+from real_time_fraud_detection_system_tpu.models.logreg import init_logreg
+from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+from real_time_fraud_detection_system_tpu.runtime import (
+    FlakySource,
+    ReplaySource,
+    ScoringEngine,
+    run_with_recovery,
+)
+EPOCH0 = 1_743_465_600  # 2025-04-01
+
+
+def _res(i, n=4):
+    from real_time_fraud_detection_system_tpu.runtime.engine import (
+        BatchResult,
+    )
+
+    ids = np.arange(n, dtype=np.int64) + i * n
+    return BatchResult(
+        tx_id=ids,
+        tx_datetime_us=ids * 10**6,
+        customer_id=ids % 7,
+        terminal_id=ids % 5,
+        amount_cents=ids * 10 + 1,
+        features=np.zeros((n, 15), np.float32),
+        probs=np.zeros(n, np.float32),
+        latency_s=0.0,
+        batch_index=i,
+    )
+
+
+class _SlowSink(MemorySink):
+    """MemorySink with a per-append delay (forces queueing)."""
+
+    def __init__(self, delay_s=0.01):
+        super().__init__()
+        self.delay_s = delay_s
+        self.order = []
+
+    def append(self, res):
+        time.sleep(self.delay_s)
+        self.order.append(res.batch_index)
+        super().append(res)
+
+
+def test_async_sink_ordered_appends():
+    inner = _SlowSink(delay_s=0.002)
+    sink = AsyncSink(inner, max_queue=4)
+    for i in range(1, 21):
+        sink.append(_res(i))
+    sink.drain()
+    assert inner.order == list(range(1, 21))
+    out = sink.concat()  # drains, then delegates
+    assert len(out["tx_id"]) == 20 * 4
+    sink.close()
+
+
+def test_async_sink_backpressure_bounded_and_counted():
+    from real_time_fraud_detection_system_tpu.utils.metrics import (
+        MetricsRegistry,
+    )
+
+    reg = MetricsRegistry()
+    inner = _SlowSink(delay_s=0.05)
+    sink = AsyncSink(inner, max_queue=1, registry=reg)
+    for i in range(1, 5):
+        sink.append(_res(i))
+    sink.drain()
+    sink.close()
+    bp = reg.get(
+        "rtfds_sink_backpressure_seconds_total", sink="_SlowSink")
+    assert bp is not None and bp.value > 0.05  # blocked, and accounted
+    assert inner.order == [1, 2, 3, 4]
+
+
+def test_async_sink_error_propagates_with_original_type():
+    class _Failing(MemorySink):
+        def __init__(self):
+            super().__init__()
+            self.n = 0
+
+        def append(self, res):
+            self.n += 1
+            if self.n == 2:
+                raise OSError("disk on fire")
+            super().append(res)
+
+    sink = AsyncSink(_Failing(), max_queue=8)
+    sink.append(_res(1))
+    sink.append(_res(2))
+    # the failure surfaces on the LOOP thread with its original type
+    # (the supervisor's recover_on policy is type-based)
+    with pytest.raises(OSError, match="disk on fire"):
+        sink.drain()
+    # re-raise cleared the box: a recovered incarnation resumes writing
+    sink.append(_res(3))
+    sink.drain()
+    # batch 2's write failed (it replays from the checkpoint in real
+    # serving); batches 1 and 3 landed
+    assert [b["tx_id"][0] for b in sink.inner.batches] == [4, 12]
+    sink.close()
+
+
+def test_async_sink_flush_and_truncate_drain_first(tmp_path):
+    pq = ParquetSink(str(tmp_path / "parts"))
+    sink = AsyncSink(pq, max_queue=8)
+    for i in range(1, 6):
+        sink.append(_res(i))
+    # truncate must see the queued parts (drain first), then fence
+    sink.truncate_after(3)
+    names = sorted(os.listdir(pq.directory))
+    assert names == [f"part-{i:08d}.parquet" for i in (1, 2, 3)]
+    sink.close()
+
+
+def _small_setup(small_dataset, every=2):
+    _, _, _, txs = small_dataset
+    cfg = Config(
+        data=DataConfig(n_customers=50, n_terminals=100, n_days=30),
+        features=FeatureConfig(customer_capacity=128, terminal_capacity=256,
+                               cms_width=1 << 10),
+        runtime=RuntimeConfig(checkpoint_every_batches=every,
+                              batch_buckets=(256,), max_batch_rows=256),
+    )
+    params = init_logreg(15)
+    scaler = Scaler(mean=np.zeros(15, np.float32),
+                    scale=np.ones(15, np.float32))
+
+    def make_engine():
+        import jax.numpy as jnp
+
+        return ScoringEngine(
+            cfg, kind="logreg", params=params,
+            scaler=Scaler(jnp.asarray(scaler.mean),
+                          jnp.asarray(scaler.scale)),
+        )
+
+    return cfg, txs, make_engine
+
+
+def test_async_sink_crash_replay_exactly_once(small_dataset, tmp_path):
+    """Kill the stream with results still queued in the async sink,
+    recover from the checkpoint, and verify the truncate_after fence
+    leaves NO duplicated and NO missing batch_index in the parquet
+    lineage — and the rows equal a clean synchronous run's."""
+    _, txs, make_engine = _small_setup(small_dataset)
+    part = txs.slice(slice(0, 2048))
+
+    # clean synchronous reference
+    ref = ParquetSink(str(tmp_path / "ref"))
+    make_engine().run(ReplaySource(part, EPOCH0, batch_rows=256), sink=ref)
+    clean = ref.read_all()
+
+    # faulty run: slow inner writer so the queue holds results when the
+    # crash lands (the "kill mid-queue" scenario)
+    class _SlowParquet(ParquetSink):
+        def append(self, res):
+            time.sleep(0.01)
+            super().append(res)
+
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    sink = AsyncSink(_SlowParquet(str(tmp_path / "out")), max_queue=8)
+    src = FlakySource(ReplaySource(part, EPOCH0, batch_rows=256),
+                      fail_at=(3, 6))
+    stats = run_with_recovery(make_engine, src, ckpt, sink=sink,
+                              max_restarts=5)
+    assert stats["restarts"] == 2
+    sink.close()
+
+    # sink-side fence: indexed parts are exactly 1..batches, no dup/gap
+    stems = sorted(
+        int(f[len("part-"):-len(".parquet")])
+        for f in os.listdir(str(tmp_path / "out"))
+        if f.startswith("part-") and f.endswith(".parquet")
+    )
+    assert stems == list(range(1, stats["batches"] + 1))
+
+    out = sink.inner.read_all()
+    assert np.array_equal(np.sort(out["tx_id"]), np.sort(clean["tx_id"]))
+    i1, i2 = np.argsort(out["tx_id"]), np.argsort(clean["tx_id"])
+    np.testing.assert_allclose(out["prediction"][i1],
+                               clean["prediction"][i2], atol=1e-6)
+
+
+def test_checkpoint_drains_async_sink(small_dataset, tmp_path):
+    """Every checkpoint save happens with the async queue fully landed:
+    checkpointed progress never leads durable sink output."""
+    _, txs, make_engine = _small_setup(small_dataset, every=2)
+    part = txs.slice(slice(0, 1024))
+
+    landed = []
+
+    class _Probe(ParquetSink):
+        def append(self, res):
+            time.sleep(0.005)
+            super().append(res)
+            landed.append(res.batch_index)
+
+    class _CkptProbe(Checkpointer):
+        def __init__(self, d):
+            super().__init__(d)
+            self.at_save = []
+
+        def save(self, engine_state):
+            self.at_save.append(
+                (engine_state.batches_done, list(landed)))
+            return super().save(engine_state)
+
+    ck = _CkptProbe(str(tmp_path / "ck"))
+    sink = AsyncSink(_Probe(str(tmp_path / "out")), max_queue=8)
+    make_engine().run(ReplaySource(part, EPOCH0, batch_rows=256),
+                      sink=sink, checkpointer=ck)
+    sink.close()
+    assert ck.at_save  # checkpoints actually happened
+    for batches_done, landed_then in ck.at_save:
+        assert landed_then == list(range(1, batches_done + 1))

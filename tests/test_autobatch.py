@@ -1,0 +1,120 @@
+"""AutoBatchController: SLO tracking, throughput hill-climb, engine wiring."""
+
+import dataclasses
+
+import numpy as np
+
+from real_time_fraud_detection_system_tpu.runtime.autobatch import (
+    AutoBatchController,
+)
+from real_time_fraud_detection_system_tpu.utils.metrics import MetricsRegistry
+
+BUCKETS = (256, 1024, 4096)
+
+
+def test_slo_mode_steps_up_then_down():
+    reg = MetricsRegistry()
+    c = AutoBatchController(BUCKETS, latency_slo_ms=10.0, decide_every=4,
+                            registry=reg)
+    assert c.target_rows() == 256  # SLO mode starts small: meet first
+    for _ in range(4):  # comfortably under the SLO -> grow
+        c.observe(256, 0.001)
+    assert c.target_rows() == 1024
+    for _ in range(4):
+        c.observe(1024, 0.002)
+    assert c.target_rows() == 4096
+    for _ in range(4):  # blown SLO -> shrink
+        c.observe(4096, 0.050)
+    assert c.target_rows() == 1024
+    assert reg.get("rtfds_autobatch_target_rows").value == 1024
+    ups = reg.get("rtfds_autobatch_adjustments_total", direction="up")
+    downs = reg.get("rtfds_autobatch_adjustments_total", direction="down")
+    assert ups.value == 2 and downs.value == 1
+
+
+def test_slo_mode_holds_inside_band():
+    c = AutoBatchController(BUCKETS, latency_slo_ms=10.0, decide_every=4,
+                            registry=MetricsRegistry())
+    for _ in range(4):
+        c.observe(256, 0.001)
+    assert c.target_rows() == 1024
+    # p50 between headroom*SLO and SLO: stay put (no ping-pong)
+    for _ in range(12):
+        c.observe(1024, 0.008)
+    assert c.target_rows() == 1024
+    assert c.adjustments == 1
+
+
+def test_throughput_mode_converges_to_fastest_bucket():
+    c = AutoBatchController(BUCKETS, latency_slo_ms=0.0, decide_every=4,
+                            registry=MetricsRegistry())
+    assert c.target_rows() == 4096  # throughput mode starts big
+    # simulate per-batch fixed overhead: latency = 5ms + rows * 1us, so
+    # bigger buckets genuinely serve more rows/s
+    for _ in range(40):
+        rows = c.target_rows()
+        c.observe(rows, 0.005 + rows * 1e-6)
+    assert c.target_rows() == 4096  # explored, then settled on the best
+
+
+def test_throughput_mode_backs_off_when_small_is_faster():
+    c = AutoBatchController(BUCKETS, latency_slo_ms=0.0, decide_every=4,
+                            registry=MetricsRegistry())
+    # pathological device: latency grows superlinearly with rows, so the
+    # smallest bucket wins the climb
+    for _ in range(60):
+        rows = c.target_rows()
+        c.observe(rows, (rows / 256.0) ** 2 * 0.001)
+    assert c.target_rows() == 256
+
+
+def test_engine_autobatch_integration(small_dataset):
+    """The engine assembles toward the controller's target and reports
+    it; rows are conserved and scores match a static run."""
+    from real_time_fraud_detection_system_tpu.config import (
+        Config,
+        DataConfig,
+        FeatureConfig,
+        RuntimeConfig,
+    )
+    from real_time_fraud_detection_system_tpu.io import MemorySink
+    from real_time_fraud_detection_system_tpu.models.logreg import init_logreg
+    from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+    from real_time_fraud_detection_system_tpu.runtime import (
+        ReplaySource,
+        ScoringEngine,
+    )
+
+    _, _, _, txs = small_dataset
+    part = txs.slice(slice(0, 2048))
+    base = Config(
+        data=DataConfig(n_customers=50, n_terminals=100, n_days=30),
+        features=FeatureConfig(customer_capacity=128, terminal_capacity=256,
+                               cms_width=1 << 10),
+        runtime=RuntimeConfig(batch_buckets=(64, 256), max_batch_rows=256),
+    )
+    params = init_logreg(15)
+    scaler = Scaler(mean=np.zeros(15, np.float32),
+                    scale=np.ones(15, np.float32))
+
+    def run(rcfg):
+        eng = ScoringEngine(base.replace(runtime=rcfg), kind="logreg",
+                            params=params, scaler=scaler)
+        sink = MemorySink()
+        stats = eng.run(ReplaySource(part, 1_743_465_600, batch_rows=64),
+                        sink=sink)
+        return stats, sink.concat()
+
+    s_auto, out_auto = run(dataclasses.replace(
+        base.runtime, autobatch=True, latency_slo_ms=0.0))
+    s_static, out_static = run(base.runtime)
+    assert s_auto["rows"] == s_static["rows"] == 2048
+    assert s_auto["autobatch_target_rows"] in (64, 256)
+    assert "autobatch_adjustments" in s_auto
+    # the controller coalesces (fewer, larger device batches than
+    # one-poll-one-batch) but every row lands exactly once, scored
+    assert s_auto["batches"] <= s_static["batches"]
+    assert np.array_equal(np.sort(out_auto["tx_id"]),
+                          np.sort(out_static["tx_id"]))
+    assert np.all((out_auto["prediction"] >= 0)
+                  & (out_auto["prediction"] <= 1))

@@ -40,6 +40,33 @@ def test_decimal_batch_matches_scalar(rng):
     assert np.array_equal(out, cents)
 
 
+def test_decimal_batch_vectorized_edge_cases():
+    """The packed-scatter decode is bit-identical to the scalar reference
+    over every byte width 1..8, full-width int64 extremes, sign-bit
+    boundaries, and degenerate inputs (empty batch / empty value)."""
+    # local rng, NOT the session fixture: consuming shared draws would
+    # shift every later rng-using test's data
+    local = np.random.default_rng(1234)
+    vals = [0, 1, -1, 127, 128, -128, -129, 255, -256,
+            2**31 - 1, -(2**31), 2**62, -(2**62), 2**63 - 1, -(2**63)]
+    # widths 1..8 at both sign-bit edges
+    for w in range(1, 9):
+        vals += [2 ** (8 * w - 1) - 1, -(2 ** (8 * w - 1))]
+    vals += [int(v) for v in local.integers(-(2**62), 2**62, size=300)]
+    raws = [base64.b64decode(encode_decimal_cents(v)) for v in vals]
+    got = decode_decimal_batch(raws)
+    want = np.array([decode_decimal_bytes(r) for r in raws], np.int64)
+    assert np.array_equal(got, want)
+    assert decode_decimal_batch([]).shape == (0,)
+    assert decode_decimal_batch([b""])[0] == 0  # degenerate, not a crash
+    try:
+        decode_decimal_batch([b"\x00" * 9])
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("9-byte decimal must raise")
+
+
 def test_envelope_roundtrip(rng):
     n = 200
     tx_id = np.arange(n, dtype=np.int64)

@@ -284,6 +284,39 @@ def test_pipeline_depth_checkpoint_resume_identity(cfg, trained, tmp_path):
     _assert_resumed_equals_clean(sink_a, sink_b, sink_c)
 
 
+def test_trigger_pacing_once_per_pass_not_per_drained_handle(cfg, trained):
+    """Trigger pacing happens once per loop pass on the POLL side.
+
+    Regression: it used to sleep inside _finish, so a pipeline drain
+    (checkpoints, idle flushes, end of stream) stacked one
+    (trigger − latency) sleep per queued handle, inflating the later
+    handles' reported latency by their predecessors' sleeps. Now the
+    drain is sleep-free and pacing time is credited as wait — so with
+    fast batches and a deep queue, latency percentiles stay far below
+    the trigger while batch starts still space out by ≥ trigger."""
+    import dataclasses
+    import time
+
+    model, _, txs = trained
+    rcfg = dataclasses.replace(cfg.runtime, pipeline_depth=8)
+    engine = ScoringEngine(cfg.replace(runtime=rcfg), "logreg",
+                           params=model.params, scaler=model.scaler)
+    # warm the jit cache so the measured run's latencies are steady-state
+    engine.run(ReplaySource(txs.slice(slice(0, 256)), START_EPOCH_S,
+                            batch_rows=256), trigger_seconds=0.0)
+    src = ReplaySource(txs.slice(slice(256, 1536)), START_EPOCH_S,
+                       batch_rows=256)  # 5 batches, all queued (depth 8)
+    t0 = time.perf_counter()
+    stats = engine.run(src, trigger_seconds=0.2)
+    wall = time.perf_counter() - t0
+    assert stats["batches"] == 5
+    # pacing preserved: ≥ 4 inter-start gaps of ~0.2 s
+    assert wall >= 0.6
+    # drain did not stack sleeps into later handles' latency (the old
+    # behavior put ~0.2 s per predecessor there: p99 ≥ 600 ms)
+    assert stats["latency_p99_ms"] < 150.0
+
+
 def test_coalesce_never_exceeds_largest_bucket(cfg, trained):
     """A poll that would overflow the largest jit bucket is carried into
     the next batch — every row scored exactly once, no oversized batch."""

@@ -130,6 +130,48 @@ def test_sharded_engine_matches_single_chip(small_dataset):
     assert auc1 == pytest.approx(auc8, abs=1e-9)
 
 
+def test_sharded_engine_precompile_both_variants(small_dataset):
+    """AOT precompile on the mesh builds BOTH step variants (local +
+    routed spill) before the first poll, serves the stream without a
+    single counted recompile or AOT fallback, and reproduces the
+    plain-jit probabilities exactly."""
+    import dataclasses
+
+    from real_time_fraud_detection_system_tpu.utils.metrics import (
+        MetricsRegistry,
+    )
+
+    _, _, _, txs = small_dataset
+    part = txs.slice(slice(0, 4096))
+    cfg = _cfg()
+    cfg = cfg.replace(runtime=dataclasses.replace(cfg.runtime,
+                                                  precompile=True))
+    params, scaler = _model()
+
+    reg = MetricsRegistry()
+    eng = ShardedScoringEngine(cfg, kind="logreg", params=params,
+                               scaler=scaler, n_devices=N_DEV, metrics=reg)
+    man = eng.precompile()
+    assert man["variants"] == 2
+    assert set(eng._aot) == {("sharded", False), ("sharded", True)}
+    s8 = MemorySink()
+    stats = eng.run(ReplaySource(part, EPOCH0, batch_rows=1024), sink=s8)
+    assert stats["batches"] > 1
+    assert reg.get("rtfds_xla_recompiles_total").value == 0
+    assert reg.get("rtfds_aot_fallbacks_total").value == 0
+    assert eng._aot  # still serving from the executables
+
+    s1 = MemorySink()
+    ref = ShardedScoringEngine(_cfg(), kind="logreg", params=params,
+                               scaler=scaler, n_devices=N_DEV)
+    ref.run(ReplaySource(part, EPOCH0, batch_rows=1024), sink=s1)
+    out1, out8 = s1.concat(), s8.concat()
+    a, b = np.argsort(out1["tx_id"]), np.argsort(out8["tx_id"])
+    np.testing.assert_array_equal(out1["tx_id"][a], out8["tx_id"][b])
+    np.testing.assert_allclose(out1["prediction"][a],
+                               out8["prediction"][b], atol=1e-6)
+
+
 def test_sharded_engine_forest_kind(small_dataset):
     """The flagship forest scorer serves sharded too (replicated params,
     GEMM classify per shard)."""
