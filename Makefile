@@ -72,6 +72,13 @@ bench:
 perf-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_perf_smoke.py -q
 
+# chaos gate: one scripted run with flaky polls, a silent hang, and a
+# poison micro-batch must COMPLETE with exact restart/crash-loop counts,
+# the DLQ holding exactly the injected rows, and gap/dup-free sink
+# lineage (the PR-4 survive-poison-input invariants)
+chaos-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos_smoke.py -q
+
 test:
 	$(PY) -m pytest tests/ -q
 
@@ -112,4 +119,4 @@ install:
 clean:
 	rm -rf $(OUT)
 
-.PHONY: demo datagen train score run-all query dashboard connectors dryrun trace-demo bench perf-smoke test integration integration-up integration-down sqlcheck install clean
+.PHONY: demo datagen train score run-all query dashboard connectors dryrun trace-demo bench perf-smoke chaos-smoke test integration integration-up integration-down sqlcheck install clean

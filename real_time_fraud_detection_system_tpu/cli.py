@@ -370,6 +370,23 @@ def cmd_score(args) -> int:
     if args.source != "kafka" and not args.data:
         log.error("--data is required unless --source kafka")
         return 2
+    # Failure-handling flags fail fast BEFORE any artifact loads.
+    if args.nan_guard and not args.dead_letter:
+        log.error("--nan-guard needs --dead-letter: quarantined rows "
+                  "must land somewhere an operator can triage them")
+        return 2
+    if args.nan_guard and args.devices > 1:
+        log.error("--nan-guard is not wired for the sharded engine "
+                  "(--devices > 1); rely on the supervisor's crash-loop "
+                  "bisection (--dead-letter + --max-restarts) there")
+        return 2
+    if args.crash_loop_k < 1:
+        log.error("--crash-loop-k must be >= 1, got %s", args.crash_loop_k)
+        return 2
+    if args.restart_backoff_ms < 0:
+        log.error("--restart-backoff-ms must be >= 0, got %s",
+                  args.restart_backoff_ms)
+        return 2
     # replay reads a generated .npz; raw-table reads a table DIRECTORY
     txs = (load_transactions(args.data)
            if args.data and args.source == "replay" else None)
@@ -444,6 +461,10 @@ def cmd_score(args) -> int:
         latency_slo_ms=args.latency_slo_ms,
         async_sink=args.async_sink,
         sink_queue_batches=args.sink_queue_batches,
+        nan_guard=args.nan_guard,
+        dead_letter=args.dead_letter,
+        crash_loop_k=args.crash_loop_k,
+        restart_backoff_ms=args.restart_backoff_ms,
     ))
     cpu_model = None
     if args.scorer == "cpu":
@@ -498,6 +519,16 @@ def cmd_score(args) -> int:
                                     poll_timeout_s=0.0),
             )
 
+    dead_letter = None
+    if args.dead_letter:
+        from real_time_fraud_detection_system_tpu.io.sink import (
+            make_dead_letter_sink,
+        )
+
+        dead_letter = make_dead_letter_sink(args.dead_letter)
+        log.info("dead-letter queue: %s (%d row(s) already quarantined)",
+                 args.dead_letter, len(dead_letter))
+
     def make_engine():
         if args.devices > 1:
             from real_time_fraud_detection_system_tpu.runtime import (
@@ -512,6 +543,7 @@ def cmd_score(args) -> int:
                 n_devices=args.devices,
                 online_lr=args.online_lr,
                 feature_cache=feature_cache,
+                dead_letter=dead_letter,
             )
         return ScoringEngine(
             cfg,
@@ -522,6 +554,7 @@ def cmd_score(args) -> int:
             cpu_model=cpu_model,
             online_lr=args.online_lr,
             feature_cache=feature_cache,
+            dead_letter=dead_letter,
         )
 
     source_factory = None
@@ -665,15 +698,26 @@ def cmd_score(args) -> int:
                 # (the compose `restart: on-failure` + Spark checkpoint
                 # contract).
                 from real_time_fraud_detection_system_tpu.runtime.faults import (
+                    RetryPolicy,
                     run_with_recovery,
                 )
 
+                backoff = None
+                if args.restart_backoff_ms > 0:
+                    # doubling, full jitter, capped at 30 s — the
+                    # fleet-safe default curve; the knob sets the base
+                    backoff = RetryPolicy(
+                        base_delay_s=args.restart_backoff_ms / 1000.0,
+                        multiplier=2.0, max_delay_s=30.0, jitter=1.0)
                 stats = run_with_recovery(
                     make_engine, source, ckpt, sink=sink,
                     max_restarts=args.max_restarts, max_batches=args.max_batches,
                     resume=args.resume, stall_timeout_s=args.stall_timeout,
                     make_source=source_factory, make_feedback=make_feedback,
                     make_model_reload=make_reloader,
+                    crash_loop_k=args.crash_loop_k,
+                    dead_letter=dead_letter,
+                    restart_backoff=backoff,
                 )
             else:
                 engine = make_engine()
@@ -726,6 +770,11 @@ def cmd_score(args) -> int:
     if raw_table is not None:
         raw_table.flush()
         stats["raw_tx_rows"] = len(raw_table)
+    if dead_letter is not None:
+        stats["dead_letter_rows"] = len(dead_letter)
+        close_dlq = getattr(dead_letter, "close", None)
+        if close_dlq is not None:
+            close_dlq()
     log.info("done: %s", stats)
     print(_json_line({"scorer": args.scorer, **stats}))
     return 0
@@ -786,6 +835,102 @@ def cmd_warmup(args) -> int:
     }
     log.info("warmup done: %s", out)
     print(_json_line(out))
+    return 0
+
+
+def cmd_dlq(args) -> int:
+    """Inspect / replay dead-letter-queue rows (the poison quarantine).
+
+    Inspection prints a one-line summary (rows by reason/error) plus up
+    to ``--limit`` row records as JSON lines. ``--replay`` re-scores the
+    quarantined rows through a fresh engine built from ``--model-file``
+    — the post-fix triage tool: rows that now score cleanly print a
+    prediction, rows that still crash print their error and stay
+    quarantined. Replay runs against FRESH feature state (window
+    aggregates start empty), so it answers "does this row still crash?",
+    not "what would its production score have been" — re-run the stream
+    for that."""
+    from real_time_fraud_detection_system_tpu.io.sink import (
+        read_dead_letter,
+    )
+    from real_time_fraud_detection_system_tpu.utils import get_logger
+
+    log = get_logger("dlq")
+    try:
+        rows = read_dead_letter(args.path)
+    except FileNotFoundError as e:
+        print(_json_line({"error": str(e)}))
+        return 2
+    by_reason: dict = {}
+    by_error: dict = {}
+    for r in rows:
+        by_reason[r.get("reason", "?")] = \
+            by_reason.get(r.get("reason", "?"), 0) + 1
+        etype = str(r.get("error", ""))[:60].split(":")[0] or "?"
+        by_error[etype] = by_error.get(etype, 0) + 1
+    summary = {
+        "path": args.path,
+        "rows": len(rows),
+        "by_reason": by_reason,
+        "by_error_type": by_error,
+        "batches": sorted({int(r.get("batch_index", -1)) for r in rows}),
+    }
+    if not args.replay:
+        print(_json_line(summary))
+        for r in rows[: max(args.limit, 0)]:
+            print(_json_line(r))
+        if args.limit and len(rows) > args.limit:
+            print(_json_line({"truncated": True, "limit": args.limit}))
+        return 0
+    if not args.model_file:
+        log.error("--replay needs --model-file")
+        return 2
+    if not rows:
+        print(_json_line({**summary, "replayed": 0}))
+        return 0
+    # Replay runs real jax ops: apply the dead-tunnel probe the plain
+    # inspection path deliberately skips (needs_backend=False).
+    _platform_setup(getattr(args, "platform", None), needs_backend=True)
+    from real_time_fraud_detection_system_tpu.config import Config
+    from real_time_fraud_detection_system_tpu.io.artifacts import load_model
+    from real_time_fraud_detection_system_tpu.runtime import ScoringEngine
+
+    model = load_model(args.model_file)
+    need = ("tx_id", "tx_datetime_us", "customer_id", "terminal_id",
+            "tx_amount_cents", "kafka_ts_ms")
+
+    def row_cols(recs):
+        return {k: np.asarray([int(r["columns"].get(k, 0)) for r in recs],
+                              dtype=np.int64) for k in need}
+
+    def fresh_engine():
+        return ScoringEngine(Config(), kind=model.kind,
+                             params=model.params, scaler=model.scaler)
+
+    out = []
+    try:
+        res = fresh_engine().process_batch(row_cols(rows))
+        probs = {int(t): float(p) for t, p in zip(res.tx_id, res.probs)}
+        for r in rows:
+            out.append({"tx_id": r["tx_id"], "reason": r.get("reason"),
+                        "prediction": probs.get(int(r["tx_id"]))})
+    except Exception:
+        # at least one row still crashes: probe row-by-row so the clean
+        # ones still get a score and the poison names itself
+        for r in rows:
+            try:
+                res = fresh_engine().process_batch(row_cols([r]))
+                out.append({
+                    "tx_id": r["tx_id"], "reason": r.get("reason"),
+                    "prediction": float(res.probs[0]) if len(res.probs)
+                    else None})
+            except Exception as e:  # noqa: PERF203 — per-row triage
+                out.append({"tx_id": r["tx_id"], "reason": r.get("reason"),
+                            "error": f"{type(e).__name__}: {e}"[:200],
+                            "still_poison": True})
+    print(_json_line({**summary, "replayed": len(out)}))
+    for o in out:
+        print(_json_line(o))
     return 0
 
 
@@ -1547,6 +1692,30 @@ def main(argv=None) -> int:
                    help="watchdog: restart the engine if it makes no "
                         "progress for this many seconds (supervised mode "
                         "only; 0 = off)")
+    p.add_argument("--dead-letter", default="",
+                   help="dead-letter queue for poison rows (*.jsonl = "
+                        "JSONL file, else a parquet directory): the "
+                        "supervisor bisects a crash-looping micro-batch "
+                        "down to the failing rows, quarantines them here "
+                        "with envelope + error metadata, and the stream "
+                        "continues; inspect/replay with `rtfds dlq`")
+    p.add_argument("--crash-loop-k", type=int, default=2,
+                   help="consecutive supervised crashes at the SAME "
+                        "resume point before the failure is reclassified "
+                        "from transient to poison (bisect + dead-letter "
+                        "instead of burning the restart budget)")
+    p.add_argument("--restart-backoff-ms", type=float, default=0.0,
+                   help="base backoff between crash-caused restarts "
+                        "(doubles per restart, full jitter, 30 s cap; "
+                        "0 = restart hot); stall restarts never back "
+                        "off — they already waited the stall budget")
+    p.add_argument("--nan-guard", action="store_true",
+                   help="data-plane guard: rows producing NaN/Inf "
+                        "features or scores are quarantined to "
+                        "--dead-letter (reason=nonfinite) and the batch "
+                        "is re-scored without them BEFORE the running "
+                        "feature state is contaminated (serializes the "
+                        "pipeline to depth 1 while on)")
     p.add_argument("--devices", type=int, default=1,
                    help="serve on an N-device mesh (sharded engine: "
                         "customer-partitioned rows, all_to_all terminal "
@@ -1605,6 +1774,23 @@ def main(argv=None) -> int:
     p.add_argument("--use-pallas", action="store_true",
                    help="match the serving flag")
     p.set_defaults(fn=cmd_warmup)
+
+    p = sub.add_parser(
+        "dlq",
+        help="inspect / replay dead-letter-queue rows (poison quarantine)")
+    p.add_argument("--path", required=True,
+                   help="DLQ written by --dead-letter (JSONL file or "
+                        "parquet directory)")
+    p.add_argument("--limit", type=int, default=20,
+                   help="max row records printed when inspecting "
+                        "(0 = summary only)")
+    p.add_argument("--replay", action="store_true",
+                   help="re-score the quarantined rows through a fresh "
+                        "engine (post-fix triage; rows that still crash "
+                        "report their error and stay quarantined)")
+    p.add_argument("--model-file", default="",
+                   help="model artifact for --replay")
+    p.set_defaults(fn=cmd_dlq, needs_backend=False)
 
     p = sub.add_parser("demo",
                        help="full E2E demo: datagen → CDC → sinks → scorer")

@@ -241,6 +241,26 @@ class RuntimeConfig:
     checkpoint_dir: str = "checkpoints"
     checkpoint_every_batches: int = 50
     n_partitions: int = 8
+    # Data-plane non-finite guard (engine host boundary): rows whose
+    # score/feature vector crosses the boundary NaN/Inf are quarantined
+    # to the dead-letter sink and the batch is re-scored from pre-batch
+    # state without them — contamination of the running window
+    # aggregates is impossible. Opt-in: it disables step-state donation
+    # and serializes the pipeline (depth 1) while on, and it requires a
+    # dead_letter sink.
+    nan_guard: bool = False
+    # Dead-letter queue path for quarantined rows (``*.jsonl`` = JSONL
+    # file, anything else = parquet part directory; "" = no DLQ — a
+    # crash loop then fails fast instead of quarantining).
+    dead_letter: str = ""
+    # Crash-loop breaker: this many CONSECUTIVE crash-caused supervisor
+    # failures at the same resume point reclassify the failure from
+    # transient to poison (bisect + dead-letter instead of replay).
+    crash_loop_k: int = 2
+    # Backoff between crash-caused supervisor restarts (full jitter,
+    # doubling, capped; 0 = the legacy hot restart loop). Stall restarts
+    # never back off — they already waited out the stall budget.
+    restart_backoff_ms: float = 0.0
 
 
 @dataclass(frozen=True)

@@ -1,12 +1,16 @@
 from real_time_fraud_detection_system_tpu.io.sink import (  # noqa: F401
     AsyncSink,
     ConsoleSink,
+    DeadLetterSink,
     IcebergSink,
     MemorySink,
+    ParquetDeadLetterSink,
     ParquetSink,
     StoreParquetSink,
+    make_dead_letter_sink,
     make_iceberg_sink,
     make_parquet_sink,
+    read_dead_letter,
 )
 from real_time_fraud_detection_system_tpu.io.checkpoint import (  # noqa: F401
     Checkpointer,

@@ -448,6 +448,8 @@ _OPS_PHASES = ("source_poll", "host_prep", "dispatch", "result_wait",
                "sink_write")
 
 _EVENT_CLASS = {"fault": "serious", "restart": "serious",
+                "poison": "serious", "dead_letter": "serious",
+                "gave_up": "serious",
                 "checkpoint": "info", "feedback": "good"}
 
 
@@ -562,6 +564,10 @@ def render_ops_html(
                   else "—")
     n_faults = sum(1 for e in events if e.get("event") == "fault")
     n_restarts = sum(1 for e in events if e.get("event") == "restart")
+    n_dlq = sum(int(e.get("rows", 0)) for e in events
+                if e.get("event") == "dead_letter")
+    n_poison = sum(1 for e in events if e.get("event") == "poison"
+                   and e.get("phase") == "detected")
     tiles = [
         ("Batches", _compact(len(batches)), ""),
         ("Rows", _compact(rows_total), ""),
@@ -570,6 +576,9 @@ def render_ops_html(
          f"p99 {np.percentile(lat, 99) * 1e3:.2f} ms"),
         ("Faults injected", _compact(n_faults),
          f"{n_restarts} restarts" if n_restarts else ""),
+        ("Dead-letter rows", _compact(n_dlq),
+         f"{n_poison} crash loop(s)" if n_poison else
+         "quarantined (crash + nonfinite)"),
         ("Checkpoints", _compact(sum(
             1 for e in events if e.get("event") == "checkpoint"
             and e.get("op") == "save")), ""),

@@ -465,6 +465,297 @@ class StoreParquetSink(_SinkTelemetry):
         return {c: table[c].to_numpy() for c in table.column_names}
 
 
+def _dlq_row_record(cols: dict, i: int, *, reason: str, error: str,
+                    batch_index: int, offsets, trace_id: str,
+                    envelope: Optional[bytes]) -> dict:
+    """One quarantined row as a JSON-able record: decoded columns where
+    available, the raw envelope bytes when the caller still has them,
+    and the error/lineage metadata an operator needs to triage it."""
+    def scalar(v):
+        x = v[i]
+        try:
+            return x.item()
+        except AttributeError:
+            return x
+
+    rec = {
+        "tx_id": int(cols["tx_id"][i]),
+        "reason": reason,
+        "error": str(error)[:500],
+        "batch_index": int(batch_index),
+        "offsets": [int(o) for o in offsets] if offsets is not None
+        else None,
+        "trace_id": trace_id or "",
+        "t": time.time(),
+        "columns": {k: scalar(v) for k, v in cols.items()},
+    }
+    if envelope is not None:
+        import base64
+
+        rec["envelope_b64"] = base64.b64encode(bytes(envelope)).decode()
+    return rec
+
+
+class _DeadLetterTelemetry:
+    """Shared DLQ instrumentation + flight-record events. The absolute
+    row gauge (``rtfds_dead_letter_rows``) is what ``/healthz`` keys its
+    ``degraded`` state on."""
+
+    def _init_dlq_metrics(self, registry=None) -> None:
+        from real_time_fraud_detection_system_tpu.utils.metrics import (
+            active_recorder,
+        )
+
+        self._reg = registry if registry is not None else get_registry()
+        self._recorder = active_recorder
+        self._m_gauge = self._reg.gauge(
+            "rtfds_dead_letter_rows",
+            "rows currently quarantined in the dead-letter queue")
+
+    def _observe_put(self, written: int, reason: str, batch_index: int,
+                     total: int) -> None:
+        if written:
+            self._reg.counter(
+                "rtfds_dead_letter_rows_total",
+                "rows quarantined to the dead-letter queue by reason",
+                reason=reason).inc(written)
+        self._m_gauge.set(total)
+        rec = self._recorder()
+        if rec is not None and written:
+            rec.record_event("dead_letter", rows=written, reason=reason,
+                             batch=int(batch_index))
+
+
+class DeadLetterSink(_DeadLetterTelemetry):
+    """JSONL dead-letter queue — one record per quarantined row.
+
+    The quarantine side of the supervisor's poison-isolation path
+    (``runtime/faults.run_with_recovery``) and the engine's non-finite
+    guard: instead of a poison row killing the stream (or silently
+    contaminating feature state), its raw envelope bytes (when known),
+    decoded columns, error type/message, batch index, offsets, and trace
+    id land here and the stream continues past it. **Idempotent by
+    tx_id**: already-quarantined rows are skipped on write (the seen-set
+    is rebuilt from the file on open), so a crash mid-bisection followed
+    by checkpoint replay neither loses nor duplicates DLQ rows, and
+    ``read_all`` additionally dedups latest-wins. Inspect/replay with
+    ``rtfds dlq``.
+    """
+
+    def __init__(self, path: str, registry=None):
+        self.path = path
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._lock = threading.Lock()
+        self._seen: set = set()
+        self._init_dlq_metrics(registry)
+        if os.path.exists(path):
+            for rec in self._iter_file():
+                self._seen.add(int(rec["tx_id"]))
+        self._f = open(path, "a", encoding="utf-8")
+        self._m_gauge.set(len(self._seen))
+
+    def _iter_file(self):
+        import json
+
+        with open(self.path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail after a crash: skip
+                if "tx_id" in rec:
+                    yield rec
+
+    def put_rows(self, cols: dict, *, reason: str, error: str = "",
+                 errors: Optional[List[str]] = None, batch_index: int = -1,
+                 offsets=None, trace_id: str = "",
+                 envelopes: Optional[List[bytes]] = None) -> int:
+        """Quarantine every row of ``cols`` (a columnar dict as polled);
+        rows whose tx_id is already quarantined are skipped. ``errors``
+        optionally carries a per-row message (bisection knows each row's
+        exception); ``error`` is the shared fallback. Returns the number
+        of rows actually written."""
+        import json
+
+        n = len(cols["tx_id"])
+        written = 0
+        with self._lock:
+            for i in range(n):
+                tx = int(cols["tx_id"][i])
+                if tx in self._seen:
+                    continue
+                rec = _dlq_row_record(
+                    cols, i, reason=reason,
+                    error=errors[i] if errors is not None else error,
+                    batch_index=batch_index, offsets=offsets,
+                    trace_id=trace_id,
+                    envelope=envelopes[i] if envelopes is not None
+                    else None)
+                self._f.write(json.dumps(rec, separators=(",", ":"),
+                                         default=str) + "\n")
+                self._seen.add(tx)
+                written += 1
+            self._f.flush()
+        self._observe_put(written, reason, batch_index, len(self._seen))
+        return written
+
+    def read_all(self) -> List[dict]:
+        """Quarantined rows, deduped by tx_id (latest record wins),
+        ordered by (batch_index, tx_id)."""
+        with self._lock:
+            self._f.flush()
+        by_tx = {}
+        for rec in self._iter_file():
+            by_tx[int(rec["tx_id"])] = rec
+        return sorted(by_tx.values(),
+                      key=lambda r: (r.get("batch_index", -1), r["tx_id"]))
+
+    def tx_ids(self) -> List[int]:
+        return sorted(self._seen)
+
+    def __len__(self) -> int:
+        return len(self._seen)
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+
+class ParquetDeadLetterSink(_DeadLetterTelemetry):
+    """:class:`DeadLetterSink` semantics as parquet parts under a
+    directory — the variant whose output any Iceberg/Trino/DuckDB reader
+    can mount next to the analyzed table. One part per quarantine call
+    (``dlq-<batch_index>-<reason>.parquet``), so a checkpoint replay
+    that re-isolates the same batch atomically OVERWRITES its own part
+    instead of duplicating rows — the same exactly-once naming trick as
+    :class:`ParquetSink`. The tx_id seen-set is rebuilt from the parts
+    on open (write-side idempotence across restarts)."""
+
+    def __init__(self, directory: str, registry=None):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        self._seen: set = set()
+        self._init_dlq_metrics(registry)
+        for rec in self.read_all():
+            self._seen.add(int(rec["tx_id"]))
+        self._m_gauge.set(len(self._seen))
+
+    def put_rows(self, cols: dict, *, reason: str, error: str = "",
+                 errors: Optional[List[str]] = None, batch_index: int = -1,
+                 offsets=None, trace_id: str = "",
+                 envelopes: Optional[List[bytes]] = None) -> int:
+        import json
+
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        n = len(cols["tx_id"])
+        recs = []
+        with self._lock:
+            for i in range(n):
+                tx = int(cols["tx_id"][i])
+                if tx in self._seen:
+                    continue
+                recs.append(_dlq_row_record(
+                    cols, i, reason=reason,
+                    error=errors[i] if errors is not None else error,
+                    batch_index=batch_index, offsets=offsets,
+                    trace_id=trace_id,
+                    envelope=envelopes[i] if envelopes is not None
+                    else None))
+            if recs:
+                flat = [{
+                    **{k: v for k, v in r.items()
+                       if k not in ("columns", "offsets")},
+                    "columns_json": json.dumps(r["columns"], default=str),
+                    "offsets_json": json.dumps(r["offsets"]),
+                } for r in recs]
+                name = f"dlq-{max(int(batch_index), 0):08d}-{reason}.parquet"
+                path = os.path.join(self.directory, name)
+                if os.path.exists(path):
+                    # A later quarantine for the SAME (batch, reason) —
+                    # e.g. the nan-guard rescore flushing out a second
+                    # row — must MERGE with the part, not replace it:
+                    # the seen-set skips rows already on disk, so a
+                    # plain overwrite would silently drop them.
+                    new_ids = {int(r["tx_id"]) for r in flat}
+                    keys = list(flat[0])
+                    flat = [{k: row.get(k) for k in keys}
+                            for row in pq.read_table(path).to_pylist()
+                            if int(row.get("tx_id", -1)) not in new_ids
+                            ] + flat
+                table = pa.table({
+                    k: pa.array([r.get(k) for r in flat])
+                    for k in flat[0]
+                })
+                tmp = path + ".tmp"
+                pq.write_table(table, tmp)
+                os.replace(tmp, path)
+                for r in recs:
+                    self._seen.add(int(r["tx_id"]))
+        self._observe_put(len(recs), reason, batch_index, len(self._seen))
+        return len(recs)
+
+    def read_all(self) -> List[dict]:
+        import json
+
+        import pyarrow.parquet as pq
+
+        by_tx = {}
+        if not os.path.isdir(self.directory):
+            return []
+        for f in sorted(os.listdir(self.directory)):
+            if not (f.startswith("dlq-") and f.endswith(".parquet")):
+                continue
+            table = pq.read_table(os.path.join(self.directory, f))
+            for row in table.to_pylist():
+                rec = dict(row)
+                rec["columns"] = json.loads(rec.pop("columns_json", "{}"))
+                off = rec.pop("offsets_json", "null")
+                rec["offsets"] = json.loads(off) if off else None
+                by_tx[int(rec["tx_id"])] = rec
+        return sorted(by_tx.values(),
+                      key=lambda r: (r.get("batch_index", -1), r["tx_id"]))
+
+    def tx_ids(self) -> List[int]:
+        return sorted(self._seen)
+
+    def __len__(self) -> int:
+        return len(self._seen)
+
+    def close(self) -> None:
+        pass
+
+
+def make_dead_letter_sink(path: str, registry=None):
+    """``*.jsonl`` (or an existing plain file) → :class:`DeadLetterSink`;
+    anything else → :class:`ParquetDeadLetterSink` directory."""
+    if path.endswith(".jsonl") or os.path.isfile(path):
+        return DeadLetterSink(path, registry=registry)
+    return ParquetDeadLetterSink(path, registry=registry)
+
+
+def read_dead_letter(path: str) -> List[dict]:
+    """Read-only DLQ load for inspection/replay (``rtfds dlq``): never
+    creates the file/directory, raises FileNotFoundError when absent."""
+    if os.path.isfile(path):
+        s = DeadLetterSink(path)
+        try:
+            return s.read_all()
+        finally:
+            s.close()
+    if os.path.isdir(path):
+        return ParquetDeadLetterSink(path).read_all()
+    raise FileNotFoundError(f"no dead-letter queue at {path!r}")
+
+
 def make_parquet_sink(path_or_url: str, **store_kwargs):
     """``s3://bucket/prefix`` → :class:`StoreParquetSink` (via
     :func:`..io.store.make_store`, which honors ``RTFDS_S3_ENDPOINT`` for

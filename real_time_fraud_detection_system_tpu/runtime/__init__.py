@@ -17,10 +17,13 @@ from real_time_fraud_detection_system_tpu.runtime.faults import (  # noqa: F401
     FlakySource,
     HangingSource,
     Heartbeat,
+    PoisonRowError,
+    PoisonSource,
     RetryPolicy,
     StallError,
     TransientError,
     corrupt_messages,
+    poison_messages,
     run_with_recovery,
     with_retries,
 )

@@ -164,6 +164,53 @@ class BatchResult:
     batch_index: int = -1
 
 
+def empty_batch_result(batch_index: int) -> BatchResult:
+    """A zero-row result claiming ``batch_index`` — what a batch whose
+    every row was quarantined to the dead-letter queue leaves behind, so
+    the sink's ``batch_index`` lineage stays gap-free."""
+    return BatchResult(
+        tx_id=np.empty(0, np.int64),
+        tx_datetime_us=np.empty(0, np.int64),
+        customer_id=np.empty(0, np.int64),
+        terminal_id=np.empty(0, np.int64),
+        amount_cents=np.empty(0, np.int64),
+        features=np.zeros((0, N_FEATURES), np.float32),
+        probs=np.empty(0, np.float32),
+        latency_s=0.0,
+        batch_index=int(batch_index),
+    )
+
+
+def validate_ingest_rows(cols: dict, detail_fn=None) -> None:
+    """Strict-ingest boundary check: values that decoded structurally
+    but are IMPOSSIBLE (today: negative amounts — the generator, the
+    OLTP schema, and the decimal codec all make them unrepresentable on
+    the legitimate path) mean a corrupt or malicious envelope. Garbage
+    must never scatter into the feature state, so the batch crashes
+    loudly with :class:`~.faults.PoisonRowError`; under
+    :func:`~.faults.run_with_recovery` + a dead-letter sink the crash
+    loop is diagnosed and exactly these rows are quarantined while the
+    stream continues. One vectorized compare per batch (~free).
+    ``detail_fn(bad_mask) -> str`` lets callers append attribution (the
+    sharded engine names shard placements) without re-running the
+    predicate — it is invoked only on failure."""
+    amounts = np.asarray(cols["tx_amount_cents"])
+    if len(amounts) == 0:
+        return
+    bad = amounts < 0
+    if bad.any():
+        from real_time_fraud_detection_system_tpu.runtime.faults import (
+            PoisonRowError,
+        )
+
+        ids = np.asarray(cols["tx_id"])[bad]
+        detail = detail_fn(bad) if detail_fn is not None else ""
+        raise PoisonRowError(
+            f"corrupt row(s): negative amount_cents for "
+            f"{int(bad.sum())} row(s), tx_id(s) {ids[:5].tolist()}"
+            + (f" ({detail})" if detail else ""))
+
+
 class ScoringEngine:
     """Drives source → jitted step → sink.
 
@@ -183,12 +230,29 @@ class ScoringEngine:
         online_lr: float = 0.0,
         feature_cache=None,
         metrics=None,
+        dead_letter=None,
     ):
         self.cfg = cfg
         self.kind = kind
         self.scorer = scorer or cfg.runtime.scorer
         self.cpu_model = cpu_model
         self.online_lr = online_lr
+        # Data-plane guard (opt-in, runtime.nan_guard): rows whose step
+        # outputs cross the host boundary non-finite are quarantined to
+        # the dead-letter sink and the batch is re-scored from the
+        # pre-batch state WITHOUT them — a NaN never contaminates the
+        # running feature state (see _quarantine_nonfinite).
+        self.dead_letter = dead_letter
+        self._nan_guard = bool(cfg.runtime.nan_guard)
+        if self._nan_guard and dead_letter is None:
+            raise ValueError(
+                "runtime.nan_guard needs a dead-letter sink to quarantine "
+                "into — pass dead_letter=DeadLetterSink(...) "
+                "(CLI: --nan-guard requires --dead-letter)")
+        # The guard needs the PRE-batch state to stay alive across the
+        # step (it re-runs the batch from it on detection), so donation
+        # of the feature-state buffers is disabled while it is on.
+        self._donate = () if self._nan_guard else (0,)
         self._init_telemetry(metrics)
         if cfg.runtime.emit_dtype not in ("float32", "bfloat16"):
             raise ValueError(
@@ -353,7 +417,7 @@ class ScoringEngine:
                 }
             return fstate, params, probs, feats
 
-        self._step = jax.jit(step, donate_argnums=(0,))
+        self._step = jax.jit(step, donate_argnums=self._donate)
 
     def _init_telemetry(self, metrics) -> None:
         """Resolve the registry series ONCE at build time: the hot loop
@@ -604,7 +668,7 @@ class ScoringEngine:
             feats = jnp.zeros((batch.size, N_FEATURES), jnp.float32)
             return hstate, params, probs, feats
 
-        self._step = jax.jit(step, donate_argnums=(0,))
+        self._step = jax.jit(step, donate_argnums=self._donate)
 
     def _start_batch(self, cols: dict) -> dict:
         """Host prep + async device dispatch (does NOT block on results).
@@ -625,6 +689,7 @@ class ScoringEngine:
             use_native = native.hostprep_available()
             keep = latest_wins_mask_host(cols["tx_id"], cols["kafka_ts_ms"])
             cols = {k: v[keep] for k, v in cols.items()}
+            validate_ingest_rows(cols)
             n = len(cols["tx_id"])
             pad = bucket_size(n, self.cfg.runtime.batch_buckets)
             if use_native:
@@ -645,6 +710,13 @@ class ScoringEngine:
             # t1 sits after ALL host packing on both paths, so
             # prep_s/dispatch_s attribute the same stages either way
             t1 = time.perf_counter()
+        pre_state = None
+        if self._nan_guard:
+            # Donation is off under the guard, so these references stay
+            # valid after the step — the rollback anchor for a re-score
+            # without the non-finite rows.
+            pre_state = (self.state.feature_state, self.state.params,
+                         self.state.batches_done, self.state.rows_done)
         with self.tracer.span("dispatch", rows=n, pad=pad):
             jbatch = jnp.asarray(packed)
             # Steady-state recompile alarm: the signature keys on what
@@ -663,14 +735,15 @@ class ScoringEngine:
             self.state.params = params
             t2 = time.perf_counter()
         return {"cols": cols, "n": n, "probs": probs, "feats": feats,
-                "t0": t0, "prep_s": t1 - t0, "dispatch_s": t2 - t1}
+                "t0": t0, "prep_s": t1 - t0, "dispatch_s": t2 - t1,
+                "pre_state": pre_state}
 
     def _finish_batch(self, handle: dict) -> BatchResult:
         """Block on the handle's device futures; build the BatchResult."""
         n = handle["n"]
         if self._selective:
             probs_np, feats_np = self._unpack_selective(handle)
-            return self._emit_result(handle, probs_np, feats_np)
+            return self._finish_result(handle, probs_np, feats_np)
         if not self.cfg.runtime.emit_features or self.kind == "sequence":
             # alerts-only mode: the feature matrix stays in HBM. The
             # sequence scorer's matrix is definitionally zeros (raw event
@@ -690,7 +763,73 @@ class ScoringEngine:
             probs_np = fn(feats_np.astype(np.float64))
         else:
             probs_np = np.asarray(handle["probs"])[:n]
+        return self._finish_result(handle, probs_np, feats_np)
+
+    def _finish_result(self, handle: dict, probs_np: np.ndarray,
+                       feats_np: np.ndarray) -> BatchResult:
+        """Host-boundary tail shared by every materialize path: run the
+        non-finite guard (when on), then emit."""
+        if self._nan_guard:
+            res = self._quarantine_nonfinite(handle, probs_np, feats_np)
+            if res is not None:
+                return res
         return self._emit_result(handle, probs_np, feats_np)
+
+    def _quarantine_nonfinite(self, handle: dict, probs_np: np.ndarray,
+                              feats_np: np.ndarray):
+        """The opt-in data-plane guard (``runtime.nan_guard``): rows whose
+        score or emitted feature vector crossed the host boundary
+        non-finite are routed to the dead-letter queue
+        (``reason=nonfinite``) and the batch is re-scored from the
+        pre-batch state WITHOUT them — so a NaN/Inf never lands in the
+        running window aggregates, where it would silently poison every
+        later batch for that customer/terminal. Returns the clean
+        re-scored BatchResult, or None when the batch was already clean.
+        Note the guard sees only what crosses the boundary: under
+        alerts-only serving that is the scores alone."""
+        n = handle["n"]
+        bad = ~np.isfinite(probs_np[:n])
+        if feats_np is not None and feats_np.shape[0] >= n:
+            bad |= ~np.isfinite(feats_np[:n]).all(axis=1)
+        if not bad.any():
+            return None
+        cols = handle["cols"]
+        bad_idx = np.flatnonzero(bad)
+        self.dead_letter.put_rows(
+            {k: np.asarray(v)[bad_idx] for k, v in cols.items()},
+            reason="nonfinite",
+            error="non-finite feature/score at the host boundary",
+            batch_index=self.state.batches_done + 1,
+            trace_id=handle.get("trace_id") or "",
+        )
+        from real_time_fraud_detection_system_tpu.utils import get_logger
+
+        get_logger("engine").warning(
+            "nan-guard: %d/%d row(s) produced non-finite outputs; "
+            "quarantined to the dead-letter queue and re-scoring the "
+            "batch without them", len(bad_idx), n)
+        # Roll the engine back to the pre-batch anchor (donation is off
+        # under the guard, so the references are intact) and re-run.
+        fs, params, b_done, r_done = handle["pre_state"]
+        self.state.feature_state = fs
+        self.state.params = params
+        self.state.batches_done = b_done
+        self.state.rows_done = r_done
+        good = np.flatnonzero(~bad)
+        if len(good) == 0:
+            self.state.batches_done += 1
+            res = empty_batch_result(self.state.batches_done)
+            res.latency_s = time.perf_counter() - handle["t0"] \
+                - handle.get("waited", 0.0)
+            return res
+        h2 = self._start_batch(
+            {k: np.asarray(v)[good] for k, v in cols.items()})
+        for key in ("index", "trace_id", "source_offsets", "waited", "t0"):
+            if key in handle:
+                h2[key] = handle[key]
+        # recurses through the guard: terminates because each pass
+        # strictly shrinks the surviving row set
+        return self._finish_batch(h2)
 
     def _unpack_selective(self, handle: dict) -> tuple:
         """Decode the packed selective-emission transfer.
@@ -970,7 +1109,12 @@ class ScoringEngine:
             else trigger_seconds
         )
         every = self.cfg.runtime.checkpoint_every_batches
-        depth = max(1, self.cfg.runtime.pipeline_depth)
+        # The nan-guard's rollback-and-rescore is only sound when no later
+        # batch has been dispatched from the (possibly contaminated)
+        # state — the guard serializes the pipeline. Documented cost of
+        # the opt-in.
+        depth = 1 if self._nan_guard else max(
+            1, self.cfg.runtime.pipeline_depth)
         coalesce = self.cfg.runtime.coalesce_rows
         # Per-run percentile trackers (bounded reservoirs, exact within
         # the window) — the run-report twin of the process-lifetime

@@ -11,21 +11,33 @@ equivalents plus the missing injection tools:
   the ``psycopg2`` connect-loop analogue;
 - :class:`Heartbeat` — stall detection for the micro-batch loop (the
   healthcheck role: no progress for ``timeout_s`` → unhealthy);
-- :class:`FlakySource` / :func:`corrupt_messages` — deterministic fault
-  injectors: scripted transient poll failures (source wrapper) and
-  scripted envelope corruption (message transform);
+- :class:`FlakySource` / :func:`corrupt_messages` /
+  :class:`PoisonSource` / :func:`poison_messages` — deterministic fault
+  injectors: scripted transient poll failures (source wrapper), scripted
+  envelope corruption (message transform), and scripted poison pills
+  (rows that deterministically crash ingest on every replay);
 - :func:`run_with_recovery` — the ``restart: on-failure`` supervisor: on a
   crash, rebuild the engine state from the last checkpoint, seek the
   source, resume; exactly-once at micro-batch granularity because offsets
   and state are checkpointed atomically together (``io/checkpoint.py``).
+  Unlike Spark's replay contract (which only helps when failures are
+  transient), the supervisor DIAGNOSES failures: K consecutive crashes at
+  the same resume point reclassify the failure from transient to poison,
+  the offending micro-batch is bisected down to the minimal failing row
+  set against a pre-batch state snapshot, those rows land in a
+  dead-letter queue, and the stream continues past them — at-most-K
+  restarts per poison batch instead of stream death.
 """
 
 from __future__ import annotations
 
+import random
 import time
 import uuid
 from dataclasses import dataclass
 from typing import Callable, Optional, Sequence, Tuple, Type
+
+import numpy as np
 
 from real_time_fraud_detection_system_tpu.utils.logging import get_logger
 from real_time_fraud_detection_system_tpu.utils.metrics import (
@@ -56,22 +68,59 @@ class StallError(TransientError):
     """The watchdog found no engine progress within the stall budget."""
 
 
+class PoisonRowError(TransientError):
+    """A batch contained row(s) that fail ingest validation (corrupt
+    envelope values that decoded structurally but carry impossible
+    content, e.g. a negative amount).
+
+    Subclasses :class:`TransientError` deliberately: at the moment it is
+    raised, the supervisor cannot tell a corrupt record from a transient
+    infrastructure hiccup — both look like "the batch crashed". The
+    crash-loop breaker in :func:`run_with_recovery` resolves exactly that
+    ambiguity: a failure that recurs at the same resume point is
+    reclassified from transient to poison and quarantined via bisection,
+    whatever its exception type.
+    """
+
+
 @dataclass(frozen=True)
 class RetryPolicy:
-    """Exponential backoff: delay = base * multiplier^attempt (capped)."""
+    """Exponential backoff: delay = base * multiplier^attempt (capped).
+
+    ``multiplier`` defaults to 1.0 — the reference's constant-5 s connect
+    loop (``datagen/data_gen.py:72-80`` sleeps the same 5 s every try);
+    pass > 1.0 for genuine exponential growth. ``jitter`` is the fraction
+    of each delay randomized away: the slept time is uniform in
+    ``[(1 - jitter) * d, d]``, so ``jitter=1.0`` is classic full jitter —
+    the thundering-herd guard for fleet-wide reconnects (a thousand
+    workers that all lost the same broker must not all come back on the
+    same tick). ``delay()`` stays deterministic; only the slept time
+    (:meth:`sleep_s`) jitters.
+    """
 
     max_attempts: int = 4
     base_delay_s: float = 5.0
     multiplier: float = 1.0  # reference uses constant 5 s sleeps
     max_delay_s: float = 60.0
+    jitter: float = 0.0  # 0 = deterministic; 1.0 = full jitter
 
     def __post_init__(self):
         if self.max_attempts < 1:
             raise ValueError("max_attempts must be >= 1")
+        if not 0.0 <= self.jitter <= 1.0:
+            raise ValueError("jitter must be a fraction in [0, 1]")
 
     def delay(self, attempt: int) -> float:
         return min(self.base_delay_s * self.multiplier**attempt,
                    self.max_delay_s)
+
+    def sleep_s(self, attempt: int,
+                rand: Callable[[], float] = random.random) -> float:
+        """The (possibly jittered) time to actually sleep for ``attempt``."""
+        d = self.delay(attempt)
+        if self.jitter <= 0.0:
+            return d
+        return d * (1.0 - self.jitter * rand())
 
 
 def with_retries(
@@ -81,7 +130,17 @@ def with_retries(
     sleep: Callable[[float], None] = time.sleep,
 ):
     """Call ``fn()`` with up to ``max_attempts`` tries (the datagen connect
-    loop, ``data_gen.py:72-80``). Non-listed exceptions propagate at once."""
+    loop, ``data_gen.py:72-80``). Non-listed exceptions propagate at once.
+    Each retried attempt lands in ``rtfds_retry_attempts_total{outcome=
+    retried}``; a run that exhausts the budget lands one
+    ``outcome=exhausted`` sample before re-raising."""
+    reg = get_registry()
+    m_retried = reg.counter(
+        "rtfds_retry_attempts_total", "with_retries attempts by outcome",
+        outcome="retried")
+    m_exhausted = reg.counter(
+        "rtfds_retry_attempts_total", "with_retries attempts by outcome",
+        outcome="exhausted")
     last: Optional[BaseException] = None
     for attempt in range(policy.max_attempts):
         try:
@@ -89,10 +148,12 @@ def with_retries(
         except retry_on as e:  # noqa: PERF203 — retry loop by design
             last = e
             if attempt + 1 < policy.max_attempts:
-                d = policy.delay(attempt)
+                d = policy.sleep_s(attempt)
+                m_retried.inc()
                 log.warning("attempt %d/%d failed (%s); retrying in %.1fs",
                             attempt + 1, policy.max_attempts, e, d)
                 sleep(d)
+    m_exhausted.inc()
     raise last  # type: ignore[misc]
 
 
@@ -191,6 +252,97 @@ class FlakySource:
 
     def seek(self, offsets):
         self.inner.seek(offsets)
+
+
+class PoisonSource:
+    """Wraps a source; scripted ``tx_id`` rows are served CORRUPTED
+    (negated amount) on EVERY poll that contains them.
+
+    The deterministic poison-pill injector: unlike
+    :func:`corrupt_messages` (whose truncated envelopes the decoder
+    masks), a poisoned row decodes structurally fine and then fails the
+    engine's ingest validation (:class:`PoisonRowError`) — so a
+    checkpoint replay re-polls the same rows, re-corrupts them, and
+    crashes again, exactly the crash loop the supervisor's breaker +
+    bisection + dead-letter path exists to survive. Works on any
+    columnar ``poll_batch`` source.
+    """
+
+    def __init__(self, inner, poison_tx_ids: Sequence[int] = ()):
+        self.inner = inner
+        self.poison_tx_ids = frozenset(int(i) for i in poison_tx_ids)
+        self._ids = np.fromiter(sorted(self.poison_tx_ids), dtype=np.int64,
+                                count=len(self.poison_tx_ids))
+
+    def poll_batch(self):
+        cols = self.inner.poll_batch()
+        if cols is None or not len(self.poison_tx_ids):
+            return cols
+        tx = cols.get("tx_id")
+        if tx is None or len(tx) == 0:
+            return cols
+        mask = np.isin(np.asarray(tx), self._ids)
+        if mask.any():
+            cols = dict(cols)
+            amt = np.array(cols["tx_amount_cents"], copy=True)
+            amt[mask] = -np.abs(amt[mask]) - 1
+            cols["tx_amount_cents"] = amt
+            _record_fault("poison", count=int(mask.sum()))
+        return cols
+
+    @property
+    def offsets(self):
+        return self.inner.offsets
+
+    def seek(self, offsets):
+        self.inner.seek(offsets)
+
+    def commit(self) -> None:
+        commit = getattr(self.inner, "commit", None)
+        if commit is not None:
+            commit()
+
+    def close(self) -> None:
+        close = getattr(self.inner, "close", None)
+        if close is not None:
+            close()
+
+
+def poison_messages(msgs: Sequence[bytes],
+                    poison_at: Sequence[int] = ()) -> list:
+    """Envelope-level poison injection: re-encode scripted messages with
+    a negated amount.
+
+    The corrupted-producer analogue of :func:`corrupt_messages`, one
+    notch nastier: the envelope still parses (the decoder can NOT mask
+    it), so the impossible value reaches the engine's ingest validation
+    and crashes the batch deterministically on every replay. Produce the
+    result into a broker/topic to exercise the full poison path."""
+    from real_time_fraud_detection_system_tpu.core.envelope import (
+        decode_transaction_envelopes_fast,
+        encode_transaction_envelopes,
+    )
+
+    idxs = sorted(set(int(i) for i in poison_at) if poison_at else ())
+    idxs = [i for i in idxs if 0 <= i < len(msgs)]
+    out = list(msgs)
+    if not idxs:
+        return out
+    cols, invalid = decode_transaction_envelopes_fast(
+        [msgs[i] for i in idxs])
+    poisoned = encode_transaction_envelopes(
+        cols["tx_id"], cols["tx_datetime_us"], cols["customer_id"],
+        cols["terminal_id"], -np.abs(cols["tx_amount_cents"]) - 1,
+    )
+    n = 0
+    for j, i in enumerate(idxs):
+        if invalid[j]:
+            continue  # already-corrupt envelope: leave it to the decoder
+        out[i] = poisoned[j]
+        n += 1
+    if n:
+        _record_fault("poison_envelope", count=n)
+    return out
 
 
 def corrupt_messages(msgs: Sequence[bytes],
@@ -332,7 +484,8 @@ class _GuardedSource(_FenceGuard):
 
 
 def _run_watched(engine, source, sink, checkpointer, max_batches,
-                 heartbeat: Heartbeat, feedback=None, model_reload=None):
+                 heartbeat: Heartbeat, feedback=None, model_reload=None,
+                 target=None):
     """Run one engine incarnation under a stall watchdog.
 
     The engine loop runs in a worker thread beating the heartbeat each
@@ -343,6 +496,12 @@ def _run_watched(engine, source, sink, checkpointer, max_batches,
     hang eventually releases, its first touch of the shared source, sink,
     checkpointer, or heartbeat raises and the zombie dies, instead of
     corrupting the restarted incarnation's stream.
+
+    ``target`` replaces the default ``engine.run`` body with another
+    supervised workload run over the SAME guarded objects — it is called
+    as ``target(g_source, g_sink, g_checkpointer, g_heartbeat)``. Poison
+    isolation runs through this, so a batch that HANGS (instead of
+    crashing) mid-diagnosis is still bounded by the stall budget.
     """
     import threading
 
@@ -365,11 +524,15 @@ def _run_watched(engine, source, sink, checkpointer, max_batches,
 
     def _target():
         try:
-            box["stats"] = engine.run(
-                g_source, sink=g_sink, checkpointer=g_ckpt,
-                max_batches=max_batches, heartbeat=g_heartbeat,
-                feedback=g_feedback, model_reload=model_reload,
-            )
+            if target is not None:
+                box["stats"] = target(g_source, g_sink, g_ckpt,
+                                      g_heartbeat)
+            else:
+                box["stats"] = engine.run(
+                    g_source, sink=g_sink, checkpointer=g_ckpt,
+                    max_batches=max_batches, heartbeat=g_heartbeat,
+                    feedback=g_feedback, model_reload=model_reload,
+                )
         except BaseException as e:  # report into the supervisor thread
             box["err"] = e
 
@@ -392,6 +555,174 @@ def _run_watched(engine, source, sink, checkpointer, max_batches,
     return box["stats"]
 
 
+def _subset_cols(cols: dict, idx) -> dict:
+    return {k: np.asarray(v)[idx] for k, v in cols.items()}
+
+
+def _bisect_poison_rows(engine, snapshot: bytes, cols: dict,
+                        recover_on,
+                        heartbeat=None) -> Tuple[np.ndarray, dict]:
+    """Minimal failing row set of a poison batch, by recursive halving.
+
+    Every probe first restores the engine's full state from the
+    pre-batch ``snapshot`` (``io/checkpoint.state_to_bytes`` payload), so
+    probing never corrupts feature state, counters, or offsets — the
+    probes are pure questions. A subset that fails only in combination
+    (both halves pass alone, the union crashes) is quarantined whole
+    rather than looping forever. Returns ``(bad_row_indices,
+    {row_index: exception})``; the engine is left restored to the
+    pre-batch snapshot. Probe count is O(k log n) for k poison rows.
+    """
+    from real_time_fraud_detection_system_tpu.io.checkpoint import (
+        bytes_to_state,
+    )
+
+    n = len(next(iter(cols.values())))
+    bad: list = []
+    errors: dict = {}
+
+    def probe(idx) -> Optional[BaseException]:
+        if heartbeat is not None:
+            # each probe is real progress — keep the watchdog satisfied
+            # through a long bisection
+            heartbeat.beat()
+        bytes_to_state(snapshot, engine.state)
+        try:
+            engine.process_batch(_subset_cols(cols, idx))
+            return None
+        except recover_on as e:
+            return e
+
+    def rec(idx) -> None:
+        e = probe(idx)
+        if e is None:
+            return
+        if len(idx) == 1:
+            i = int(idx[0])
+            bad.append(i)
+            errors[i] = e
+            return
+        before = len(bad)
+        mid = len(idx) // 2
+        rec(idx[:mid])
+        rec(idx[mid:])
+        if len(bad) == before:
+            # interaction-dependent failure: halves pass alone, the
+            # union crashes — quarantine the whole subset (conservative,
+            # terminates)
+            for i in idx:
+                bad.append(int(i))
+                errors[int(i)] = e
+
+    rec(np.arange(n))
+    bytes_to_state(snapshot, engine.state)  # leave pre-batch state
+    return np.asarray(sorted(set(bad)), dtype=np.int64), errors
+
+
+def _run_poison_isolation(engine, source, sink, checkpointer, dead_letter,
+                          max_batches: int, recover_on,
+                          heartbeat: Optional[Heartbeat] = None) -> int:
+    """One careful incarnation: step batch-by-batch until the crash-
+    looping micro-batch is found, bisect it, quarantine the minimal
+    failing row set to the dead-letter queue, score + sink the
+    survivors, and checkpoint PAST the poison batch.
+
+    Runs unpipelined with a pre-batch state snapshot per step (the cost
+    that makes this a diagnosis mode, not the serving loop); control
+    returns to the normal supervisor loop after the first quarantine, a
+    clean-batch budget (the crash can only live within one checkpoint
+    cadence of the resume point — beyond that the classification was a
+    same-point transient after all), stream end, or ``max_batches``.
+    Failures that are NOT row-shaped (the poll itself raising) propagate
+    to the supervisor and count as ordinary crashes. Returns the number
+    of rows quarantined (0 when the suspect batch replayed clean).
+    """
+    from real_time_fraud_detection_system_tpu.io.checkpoint import (
+        bytes_to_state,
+        state_to_bytes,
+    )
+    from real_time_fraud_detection_system_tpu.runtime.engine import (
+        empty_batch_result,
+    )
+
+    every = int(getattr(engine.cfg.runtime, "checkpoint_every_batches", 50)
+                or 50)
+    clean_budget = 2 * every + 8
+    clean = 0
+    quarantined = 0
+    rec = active_recorder()
+    log.warning("poison isolation: stepping batch-by-batch from batch %d",
+                engine.state.batches_done)
+    while True:
+        if heartbeat is not None:
+            heartbeat.beat()
+        if max_batches and engine.state.batches_done >= max_batches:
+            break
+        if clean >= clean_budget:
+            # a whole checkpoint cadence replayed clean: the crash loop
+            # was a same-point transient, not poison — resume fast mode
+            log.info("poison isolation: %d clean batches, no crash — "
+                     "reclassifying as transient and resuming", clean)
+            break
+        snapshot = state_to_bytes(engine.state)
+        cols = source.poll_batch()  # a poll crash is not row-poison
+        if cols is None:
+            break
+        if len(next(iter(cols.values()), ())) == 0:
+            break  # idle live source: hand back to the paced normal loop
+        offsets = list(source.offsets)
+        try:
+            res = engine.process_batch(cols)
+        except recover_on as e:
+            bad_idx, errors = _bisect_poison_rows(
+                engine, snapshot, cols, recover_on, heartbeat=heartbeat)
+            batch_index = int(engine.state.batches_done) + 1
+            if len(bad_idx) == 0:
+                # the batch crashed once but every probe passed (a
+                # transient riding the poison window): retry it whole
+                raise
+            dead_letter.put_rows(
+                _subset_cols(cols, bad_idx), reason="crash",
+                errors=[f"{type(errors[int(i)]).__name__}: "
+                        f"{errors[int(i)]}"[:300] for i in bad_idx],
+                batch_index=batch_index, offsets=offsets)
+            quarantined += len(bad_idx)
+            log.warning(
+                "poison isolation: batch %d crashed (%s: %s); "
+                "quarantined %d/%d rows to the dead-letter queue",
+                batch_index, type(e).__name__, str(e)[:120],
+                len(bad_idx), len(next(iter(cols.values()))))
+            good = np.ones(len(next(iter(cols.values()))), dtype=bool)
+            good[bad_idx] = False
+            if good.any():
+                # survivors score from the pre-batch snapshot — feature
+                # state never sees the quarantined rows
+                res = engine.process_batch(_subset_cols(
+                    cols, np.flatnonzero(good)))
+            else:
+                engine.state.batches_done += 1
+                res = empty_batch_result(engine.state.batches_done)
+            engine.state.offsets = offsets
+            if sink is not None:
+                sink.append(res)
+            break  # checkpoint below advances PAST the poison batch
+        engine.state.offsets = offsets
+        if sink is not None:
+            sink.append(res)
+        clean += 1
+    drain = getattr(sink, "drain", None) if sink is not None else None
+    if drain is not None:
+        drain()
+    checkpointer.save(engine.state)
+    commit = getattr(source, "commit", None)
+    if commit is not None:
+        commit()
+    if rec is not None:
+        rec.record_event("poison", phase="isolated", rows=quarantined,
+                         batches_done=int(engine.state.batches_done))
+    return quarantined
+
+
 def run_with_recovery(
     make_engine: Callable[[], object],
     source=None,
@@ -408,6 +739,10 @@ def run_with_recovery(
     recover_on: Tuple[Type[BaseException], ...] = (
         TransientError, OSError, ConnectionError,
     ),
+    crash_loop_k: int = 2,
+    dead_letter=None,
+    restart_backoff: Optional[RetryPolicy] = None,
+    sleep: Callable[[float], None] = time.sleep,
 ) -> dict:
     """Supervisor loop: run → on crash OR stall, restore checkpoint, resume.
 
@@ -450,10 +785,43 @@ def run_with_recovery(
     first incarnation crashes before its first save. ``recover_on`` lists
     the exception types treated as recoverable; anything else propagates
     immediately (engine bugs should crash loudly, not restart-loop).
+
+    **Crash-loop breaker**: ``crash_loop_k`` consecutive same-typed
+    crash failures at the SAME progress point (the engine's batch
+    counter + offsets at failure time, so progress a dying incarnation
+    made before crashing resets the streak) reclassify the failure from
+    transient to poison
+    (``rtfds_crash_loops_total``, flight-record ``poison`` event) instead
+    of burning the restart budget on a deterministic replay. With a
+    ``dead_letter`` sink (:class:`~..io.sink.DeadLetterSink`) the next
+    incarnation runs :func:`_run_poison_isolation`: the offending
+    micro-batch is replayed through the engine in halves against a
+    pre-batch state snapshot down to the minimal failing row set, those
+    rows are quarantined (idempotent by tx_id, so a crash mid-bisection
+    neither loses nor duplicates them), survivors are scored and sunk
+    normally, and the stream continues with offsets advanced — at-most-K
+    restarts per poison batch, never stream death (the restart budget is
+    refunded on successful isolation). Without a dead-letter sink the
+    breaker logs the diagnosis + fires ``rtfds_crash_loops_total`` once
+    per loop but keeps the budgeted, backed-off retry — a same-point
+    transient (e.g. a broker outage) must not die earlier than it would
+    have before the breaker existed, and a true poison loop is still
+    bounded by ``max_restarts`` exactly as before.
+    Stall-caused restarts never count toward the crash streak.
+
+    **Restart backoff**: ``restart_backoff`` (a :class:`RetryPolicy`)
+    sleeps between crash-caused restarts — exponential with optional
+    full jitter, metered as ``rtfds_restart_backoff_seconds_total``.
+    Stall-caused restarts skip it (they already waited out the stall
+    budget). ``None`` (default) keeps the legacy hot restart loop.
     """
     if source is None and make_source is None:
         raise ValueError("run_with_recovery needs a source or make_source")
     restarts = 0
+    budget_used = 0  # like restarts, but refunded on poison isolation
+    fail_key: Optional[tuple] = None  # resume point of the last crash
+    fail_count = 0  # consecutive crashes at fail_key
+    poison_pending = False
     if source is None:
         source = make_source()
     initial_offsets = list(source.offsets)
@@ -499,15 +867,44 @@ def run_with_recovery(
         if truncate is not None:
             truncate(engine.state.batches_done)
         # Feedback loop binds THIS incarnation's engine (and, in
-        # production, its own consumer session).
-        feedback = make_feedback(engine) if make_feedback else None
+        # production, its own consumer session). Isolation incarnations
+        # run without feedback/reload — they exist to diagnose one batch.
+        feedback = (make_feedback(engine)
+                    if make_feedback and not poison_pending else None)
         # A FRESH reloader per incarnation: the restored checkpoint holds
         # pre-swap weights, so the new incarnation must re-apply the
         # latest artifact on its first interval instead of trusting a
         # previous incarnation's signature — and an abandoned (zombie)
         # worker keeps only ITS closure, never mutating the live one's.
-        model_reload = make_model_reload() if make_model_reload else None
+        model_reload = (make_model_reload()
+                        if make_model_reload and not poison_pending
+                        else None)
         try:
+            if poison_pending:
+                if heartbeat is not None:
+                    # Isolation under the same stall watchdog + zombie
+                    # fencing as a normal incarnation: a batch that HANGS
+                    # mid-diagnosis is bounded by the stall budget too.
+                    _run_watched(
+                        engine, source, sink, checkpointer, max_batches,
+                        heartbeat,
+                        target=lambda src, snk, ckpt, hb:
+                        _run_poison_isolation(
+                            engine, src, snk, ckpt, dead_letter,
+                            max_batches, recover_on, heartbeat=hb),
+                    )
+                else:
+                    _run_poison_isolation(
+                        engine, source, sink, checkpointer, dead_letter,
+                        max_batches, recover_on,
+                    )
+                # Progress was made past the suspect point: clear the
+                # diagnosis and REFUND the restart budget the crash loop
+                # consumed — a poison batch must never kill the stream.
+                poison_pending = False
+                fail_key, fail_count = None, 0
+                budget_used = 0
+                continue
             if heartbeat is not None:
                 stats = _run_watched(
                     engine, source, sink, checkpointer, max_batches,
@@ -544,6 +941,7 @@ def run_with_recovery(
             return stats
         except recover_on as e:
             restarts += 1
+            budget_used += 1
             last_was_stall = isinstance(e, StallError)
             if feedback is not None and not last_was_stall:
                 # Close the dead incarnation's feedback session so the
@@ -556,19 +954,90 @@ def run_with_recovery(
             log.warning("engine crashed (%s); restart %d/%d",
                         e, restarts, max_restarts)
             cause = "stall" if last_was_stall else "crash"
+            err_s = f"{type(e).__name__}: {e}"[:200]
             rec = active_recorder()
-            if restarts > max_restarts:
+            classified = False
+            if not last_was_stall and not poison_pending:
+                # Crash-loop breaker: consecutive same-typed crashes at
+                # the SAME progress point (the engine's batch counter +
+                # offsets AT failure — progress made by the dying
+                # incarnation counts, checkpointed or not) are a
+                # deterministic replay, not bad luck.
+                fail_sig = (
+                    int(getattr(engine.state, "batches_done", -1)),
+                    tuple(int(x) for x in
+                          getattr(engine.state, "offsets", ()) or ()),
+                    type(e).__name__,
+                )
+                if fail_sig == fail_key:
+                    fail_count += 1
+                else:
+                    fail_key, fail_count = fail_sig, 1
+                if fail_count == max(1, int(crash_loop_k)):
+                    # first crossing of K: the failure is now diagnosed
+                    # as poison (the metric/event fire ONCE per loop)
+                    get_registry().counter(
+                        "rtfds_crash_loops_total",
+                        "crash loops reclassified from transient to "
+                        "poison (K consecutive failures at one progress "
+                        "point)").inc()
+                    if rec is not None:
+                        rec.record_event(
+                            "poison", phase="detected",
+                            resume_batch=fail_key[0],
+                            failures=fail_count, error=err_s)
+                    if dead_letter is None:
+                        # No quarantine path configured: log the
+                        # diagnosis but keep the budgeted (backed-off)
+                        # retry — a same-point transient (broker outage)
+                        # must not die earlier than it would have before
+                        # the breaker existed; the budget bounds a true
+                        # poison loop exactly as before.
+                        log.error(
+                            "crash loop: %d consecutive failures at "
+                            "progress point %s — likely poison input; "
+                            "configure a dead-letter sink "
+                            "(--dead-letter) to quarantine it instead "
+                            "of retrying into the restart budget",
+                            fail_count, fail_key)
+                    else:
+                        classified = True
+            if classified:
+                # The classification restart rides the normal restart
+                # telemetry but skips the budget check: poison handling
+                # is bounded by construction (isolation either advances
+                # past the batch or its own failures land back here with
+                # poison_pending set, where the budget DOES apply).
+                poison_pending = True
+                fail_key, fail_count = None, 0
+            elif budget_used > max_restarts:
                 # budget exhausted: the final failure is NOT a restart —
                 # counting it would skew the baseline chaos PRs assert on
                 if rec is not None:
                     rec.record_event(
                         "gave_up", restarts=restarts - 1, cause=cause,
-                        error=f"{type(e).__name__}: {e}"[:200])
+                        error=err_s)
                 raise
             get_registry().counter(
                 "rtfds_engine_restarts_total",
                 "supervisor restarts by cause", cause=cause).inc()
             if rec is not None:
                 rec.record_event(
-                    "restart", restarts=restarts, cause=cause,
-                    error=f"{type(e).__name__}: {e}"[:200])
+                    "restart", restarts=restarts, cause=cause, error=err_s)
+            if restart_backoff is not None and not last_was_stall \
+                    and not classified:
+                # Exponential backoff + jitter between restarts — crash
+                # restarts AND failed-isolation retries (a down broker
+                # mid-diagnosis must not hot-loop); skipped for stalls
+                # (they already waited out the stall budget) and for the
+                # classification transition itself (diagnosis should
+                # start immediately).
+                d = restart_backoff.sleep_s(budget_used - 1)
+                if d > 0:
+                    get_registry().counter(
+                        "rtfds_restart_backoff_seconds_total",
+                        "seconds slept backing off between restarts",
+                    ).inc(d)
+                    log.info("backing off %.2fs before restart %d",
+                             d, restarts)
+                    sleep(d)

@@ -93,6 +93,7 @@ class ShardedScoringEngine(ScoringEngine):
         feature_state=None,
         feature_state_n_old: Optional[int] = None,
         metrics=None,
+        dead_letter=None,
     ):
         """``feature_state``: a pre-built state for elastic recovery of a
         checkpoint taken at a different device count. Pass
@@ -104,6 +105,17 @@ class ShardedScoringEngine(ScoringEngine):
         permutations that nothing else can tell apart. Omit
         ``feature_state_n_old`` only when the state is already in this
         mesh's layout. Default: fresh state."""
+        if cfg.runtime.nan_guard:
+            # The sharded step donates state inside shard_map and a batch
+            # spans several chunk steps — there is no pre-batch anchor to
+            # roll back to. Poison/non-finite isolation for mesh serving
+            # goes through the supervisor's bisection path instead
+            # (run_with_recovery --dead-letter), which replays whole
+            # batches through process_batch.
+            raise ValueError(
+                "runtime.nan_guard is not wired for the sharded engine; "
+                "serve single-chip with --nan-guard, or rely on the "
+                "supervisor's crash-loop bisection (--dead-letter)")
         mesh = mesh if mesh is not None else make_mesh(n_devices)
         n_mesh = int(mesh.devices.size)
         if feature_state is not None and feature_state_n_old is not None:
@@ -151,7 +163,7 @@ class ShardedScoringEngine(ScoringEngine):
         super().__init__(
             cfg, kind, params, scaler, feature_state=pre_state,
             online_lr=online_lr, feature_cache=feature_cache,
-            metrics=metrics,
+            metrics=metrics, dead_letter=dead_letter,
         )
         self.mesh = mesh
         self.axis = axis
@@ -377,6 +389,26 @@ class ShardedScoringEngine(ScoringEngine):
 
     # -- the sharded hot path ----------------------------------------------
 
+    def _validate_sharded(self, cols: dict) -> None:
+        """Strict-ingest check with CHUNK-level attribution: beyond the
+        single-chip engine's row facts, the PoisonRowError names the
+        shard placements (``customer_id % n_dev``) the corrupt rows were
+        headed for — so a crash-loop diagnosis on a mesh points at the
+        chunks, not just the batch. The predicate itself lives in ONE
+        place (validate_ingest_rows); only the attribution is added here
+        (computed solely on failure)."""
+        from real_time_fraud_detection_system_tpu.runtime.engine import (
+            validate_ingest_rows,
+        )
+
+        def detail(bad):
+            shards = sorted(set(
+                (np.asarray(cols["customer_id"])[bad]
+                 % self.n_dev).astype(int).tolist()))
+            return f"shard placement(s) {shards[:8]}"
+
+        validate_ingest_rows(cols, detail_fn=detail)
+
     def _start_batch(self, cols: dict) -> dict:
         """Dedup → partition (spill) → launch sharded step(s), async.
 
@@ -389,6 +421,7 @@ class ShardedScoringEngine(ScoringEngine):
         with self.tracer.span("host_prep"):
             keep = latest_wins_mask_host(cols["tx_id"], cols["kafka_ts_ms"])
             cols = {k: v[keep] for k, v in cols.items()}
+            self._validate_sharded(cols)
             n = len(cols["tx_id"])
             self._ensure_sharded()
             if n:

@@ -258,6 +258,22 @@ class MetricsRegistry:
         with self._lock:
             return self._series.get(name, {}).get(key)
 
+    def family_total(self, name: str) -> Optional[float]:
+        """Sum of a counter/gauge family's values across ALL label sets
+        (e.g. ``rtfds_engine_restarts_total`` over its ``cause`` labels),
+        or None when the family was never registered. Read-only — never
+        creates. Histogram families have no single total and return
+        None."""
+        with self._lock:
+            fam = self._series.get(name)
+            if fam is None:
+                return None
+            vals = [m.value for m in fam.values()
+                    if not isinstance(m, Histogram)]
+        if not vals:
+            return None
+        return float(sum(vals))
+
     def clear(self) -> None:
         """Drop every registered family (test isolation)."""
         with self._lock:
@@ -520,6 +536,13 @@ class MetricsServer:
       compute a backlog) is within ``max_source_lag_rows`` when that
       threshold is configured.
 
+    The body additionally reports the failure-handling counters ops
+    alert on — ``restarts`` (``rtfds_engine_restarts_total`` summed over
+    causes), ``crash_loops`` and ``dead_letter_rows`` — and a ``status``
+    field: ``"ok"``, ``"unhealthy"`` (503), or ``"degraded"`` (still
+    200: the stream is alive and making progress, but rows sit
+    quarantined in the dead-letter queue awaiting triage).
+
     ``port=0`` binds an ephemeral port (tests); the bound port is
     ``self.port`` after :meth:`start`.
     """
@@ -560,7 +583,22 @@ class MetricsServer:
         elif lag is not None:
             checks["source_lag_rows"] = {"value": lag.value, "ok": True,
                                          "note": "no threshold set"}
-        return ok, {"healthy": ok, "checks": checks}
+        # Failure-handling counters (degraded-but-alive serving): present
+        # only once their families exist, so a clean run's body stays
+        # clean.
+        extras: Dict[str, float] = {}
+        for fam, key in (("rtfds_engine_restarts_total", "restarts"),
+                         ("rtfds_crash_loops_total", "crash_loops"),
+                         ("rtfds_dead_letter_rows", "dead_letter_rows")):
+            v = self.registry.family_total(fam)
+            if v is not None:
+                extras[key] = v
+        status = "ok" if ok else "unhealthy"
+        if ok and extras.get("dead_letter_rows", 0) > 0:
+            # alive and progressing, but quarantined rows await triage
+            status = "degraded"
+        return ok, {"healthy": ok, "status": status, "checks": checks,
+                    **extras}
 
     def start(self) -> "MetricsServer":
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer

@@ -1,0 +1,187 @@
+"""`make chaos-smoke` — the tier-1 chaos gate.
+
+ONE scripted supervised run injects the full failure taxonomy the
+framework claims to survive — flaky transient polls, a silent hang, and
+a poison micro-batch — and asserts the recovery contract END TO END from
+the metrics registry, the dead-letter queue, and the sink's
+``batch_index`` lineage (never prints):
+
+- the stream COMPLETES (poison cannot kill it);
+- exact ``rtfds_engine_restarts_total`` by cause and
+  ``rtfds_crash_loops_total`` counts;
+- the DLQ row set equals exactly the injected poison rows;
+- contiguous no-dup/no-gap part lineage in the Parquet sink;
+- restart backoff fires for crash restarts only (stalls already waited
+  out the stall budget; poison isolation starts immediately).
+
+Scripted poll timeline (every wrapper counts its own polls; the hang
+wrapper is outermost so its indices are absolute):
+
+==  =======================================================
+i0  flaky poll failure                   -> restart 1 (crash)
+i1  batch 1 (rows 0-255)
+i2  batch 2 (256-511), checkpoint @2
+i3  batch 3 (512-767) contains poison   -> restart 2 (crash)
+i4  batch 3 replayed, same resume point -> crash-loop! restart 3
+i5  isolation: batch 3 bisected, 3 rows -> DLQ, checkpoint @3
+i6  batch 4 (768-1023), checkpoint @4
+i7  silent HANG                         -> restart 4 (stall)
+i8+ batches 5-6, end of stream
+==  =======================================================
+"""
+
+import numpy as np
+import pyarrow.parquet as pq
+
+from real_time_fraud_detection_system_tpu.config import (
+    Config,
+    FeatureConfig,
+    RuntimeConfig,
+)
+from real_time_fraud_detection_system_tpu.io.checkpoint import Checkpointer
+from real_time_fraud_detection_system_tpu.io.sink import (
+    DeadLetterSink,
+    ParquetSink,
+)
+from real_time_fraud_detection_system_tpu.models.logreg import init_logreg
+from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+from real_time_fraud_detection_system_tpu.runtime.engine import ScoringEngine
+from real_time_fraud_detection_system_tpu.runtime.faults import (
+    FlakySource,
+    HangingSource,
+    PoisonSource,
+    RetryPolicy,
+    run_with_recovery,
+)
+from real_time_fraud_detection_system_tpu.runtime.sources import ReplaySource
+from real_time_fraud_detection_system_tpu.utils.metrics import (
+    FlightRecorder,
+    get_registry,
+    set_active_recorder,
+)
+
+EPOCH0 = 1_743_465_600
+
+
+def _drain_zombies(release, timeout_s: float = 15.0):
+    """Wake abandoned engine-incarnation threads before teardown (a
+    daemon thread killed inside jax/XLA can abort the process)."""
+    import threading
+    import time
+
+    release.set()
+    deadline = time.time() + timeout_s
+    for t in threading.enumerate():
+        if t.name == "engine-incarnation" \
+                and t is not threading.current_thread():
+            t.join(max(0.0, deadline - time.time()))
+
+
+def test_chaos_smoke(small_dataset, tmp_path):
+    dcfg, _, _, txs = small_dataset
+    part = txs.slice(slice(0, 1536))
+    cfg = Config(
+        data=dcfg,
+        features=FeatureConfig(customer_capacity=256, terminal_capacity=512,
+                               cms_width=1 << 10),
+        runtime=RuntimeConfig(checkpoint_every_batches=2,
+                              batch_buckets=(256,), max_batch_rows=256),
+    )
+    params = init_logreg(15)
+    scaler = Scaler(mean=np.zeros(15, np.float32),
+                    scale=np.ones(15, np.float32))
+
+    def make_engine():
+        import jax.numpy as jnp
+
+        return ScoringEngine(
+            cfg, kind="logreg", params=params,
+            scaler=Scaler(jnp.asarray(scaler.mean),
+                          jnp.asarray(scaler.scale)),
+        )
+
+    poison_ids = [int(i) for i in part.tx_id[520:523]]  # inside batch 3
+    hang = HangingSource(
+        FlakySource(
+            PoisonSource(ReplaySource(part, EPOCH0, batch_rows=256),
+                         poison_tx_ids=poison_ids),
+            fail_at=(0,)),
+        hang_at=(7,), max_hang_s=120.0)
+
+    reg = get_registry()
+    m_crash = reg.counter("rtfds_engine_restarts_total", cause="crash")
+    m_stall = reg.counter("rtfds_engine_restarts_total", cause="stall")
+    m_loops = reg.counter("rtfds_crash_loops_total")
+    m_dlq = reg.counter("rtfds_dead_letter_rows_total", reason="crash")
+    base = (m_crash.value, m_stall.value, m_loops.value, m_dlq.value)
+
+    recorder = FlightRecorder(str(tmp_path / "chaos.jsonl"))
+    set_active_recorder(recorder)
+    dlq = DeadLetterSink(str(tmp_path / "dlq.jsonl"))
+    sink = ParquetSink(str(tmp_path / "analyzed"))
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    backoff_sleeps = []
+    try:
+        stats = run_with_recovery(
+            make_engine, hang, ckpt, sink=sink, max_restarts=6,
+            stall_timeout_s=6.0, crash_loop_k=2, dead_letter=dlq,
+            restart_backoff=RetryPolicy(base_delay_s=0.01, multiplier=2.0,
+                                        max_delay_s=1.0),
+            sleep=backoff_sleeps.append,
+        )
+    finally:
+        set_active_recorder(None)
+        recorder.close()
+        _drain_zombies(hang.release)
+
+    # Full-stream completion despite one flake, one hang, one poison batch.
+    assert stats["batches"] == 6
+    assert stats["rows"] == 1536 - 3
+    assert stats["restarts"] == 4
+
+    # Exact telemetry, asserted from the registry (not prints).
+    assert m_crash.value - base[0] == 3  # flake + poison + classification
+    assert m_stall.value - base[1] == 1  # the hang
+    assert m_loops.value - base[2] == 1  # exactly one crash loop
+    assert m_dlq.value - base[3] == 3  # exactly the injected rows
+    assert reg.gauge("rtfds_dead_letter_rows").value == len(dlq)
+
+    # DLQ row set == the injected poison rows, with error metadata.
+    assert dlq.tx_ids() == sorted(poison_ids)
+    for rec in dlq.read_all():
+        assert rec["reason"] == "crash"
+        assert "PoisonRowError" in rec["error"]
+        assert rec["batch_index"] == 3
+
+    # Backoff fired for the two pre-classification crash restarts ONLY:
+    # the stall already waited out its budget, and classification goes
+    # straight to isolation.
+    assert backoff_sleeps == [0.01, 0.02]
+
+    # Contiguous no-dup/no-gap batch_index lineage in the sink; every
+    # non-poison row landed exactly once.
+    parts = sorted((tmp_path / "analyzed").glob("part-*.parquet"))
+    idxs = [int(p.name[len("part-"):-len(".parquet")]) for p in parts]
+    assert idxs == [1, 2, 3, 4, 5, 6]
+    total = sum(pq.read_table(str(f)).num_rows for f in parts)
+    assert total == 1536 - 3
+    back = sink.read_all()
+    assert sorted(np.unique(back["tx_id"]).tolist()) == sorted(
+        set(part.tx_id.tolist()) - set(poison_ids))
+
+    # The flight record tells the whole story: injected faults, restarts
+    # by cause, the poison detection + isolation pair, and the DLQ write.
+    _, records = FlightRecorder.read(str(tmp_path / "chaos.jsonl"))
+    events = [r for r in records if r.get("kind") == "event"]
+    kinds = [(e.get("event"), e.get("cause") or e.get("phase") or
+              e.get("fault_kind")) for e in events]
+    assert kinds.count(("restart", "crash")) == 3
+    assert kinds.count(("restart", "stall")) == 1
+    assert ("poison", "detected") in kinds
+    assert ("poison", "isolated") in kinds
+    assert any(e.get("event") == "dead_letter" and e.get("rows") == 3
+               for e in events)
+    assert any(e.get("event") == "fault" and e.get("fault_kind") == "hang"
+               for e in events)
+    assert any(e.get("event") == "fault"
+               and e.get("fault_kind") == "flaky_poll" for e in events)

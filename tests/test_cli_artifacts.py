@@ -692,3 +692,70 @@ def test_import_model_rejects_wrong_feature_order(tmp_path):
     rc = cli.main(["import-model", "--model-pkl", str(pkl2),
                    "--out-model", str(tmp_path / "m2.npz")])
     assert rc == 0
+
+
+def test_cli_dlq_inspect_and_replay(tmp_path, capsys):
+    """`rtfds dlq`: inspect prints the summary + row records; --replay
+    re-scores quarantined rows through a fresh engine, and rows that
+    still fail validation report their error instead of a score."""
+    import jax.numpy as jnp
+
+    from real_time_fraud_detection_system_tpu.io.artifacts import save_model
+    from real_time_fraud_detection_system_tpu.io.sink import DeadLetterSink
+    from real_time_fraud_detection_system_tpu.models.logreg import (
+        LogRegParams,
+    )
+    from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+    from real_time_fraud_detection_system_tpu.models.train import (
+        TrainedModel,
+    )
+
+    dlq = DeadLetterSink(str(tmp_path / "dlq.jsonl"))
+    cols = {
+        "tx_id": np.array([41, 42], np.int64),
+        "tx_datetime_us": np.array([10**12, 10**12 + 1], np.int64),
+        "customer_id": np.array([3, 4], np.int64),
+        "terminal_id": np.array([5, 6], np.int64),
+        # row 41 was quarantined for a then-current bug and is fine now;
+        # row 42 is genuinely corrupt (negative amount) and must re-crash
+        "tx_amount_cents": np.array([1500, -200], np.int64),
+        "kafka_ts_ms": np.array([10**9, 10**9], np.int64),
+    }
+    dlq.put_rows(cols, reason="crash", error="PoisonRowError: corrupt",
+                 batch_index=2, offsets=[7])
+    dlq.close()
+
+    rc = cli_main(["--platform", "cpu", "dlq", "--path",
+                   str(tmp_path / "dlq.jsonl")])
+    assert rc == 0
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    assert lines[0]["rows"] == 2
+    assert lines[0]["by_reason"] == {"crash": 2}
+    assert {r["tx_id"] for r in lines[1:]} == {41, 42}
+
+    model_path = str(tmp_path / "m.npz")
+    save_model(model_path, TrainedModel(
+        kind="logreg",
+        scaler=Scaler(mean=jnp.zeros(15, jnp.float32),
+                      scale=jnp.ones(15, jnp.float32)),
+        params=LogRegParams(w=jnp.zeros(15, jnp.float32),
+                            b=jnp.float32(0.0))))
+    rc = cli_main(["--platform", "cpu", "dlq", "--path",
+                   str(tmp_path / "dlq.jsonl"), "--replay",
+                   "--model-file", model_path])
+    assert rc == 0
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    assert lines[0]["replayed"] == 2
+    by_tx = {r["tx_id"]: r for r in lines[1:]}
+    assert 0.0 <= by_tx[41]["prediction"] <= 1.0  # scores cleanly now
+    assert by_tx[42].get("still_poison") is True  # stays quarantined
+    assert "PoisonRowError" in by_tx[42]["error"]
+
+
+def test_cli_score_nan_guard_flag_validation(tmp_path, capsys):
+    rc = cli_main(["--platform", "cpu", "score", "--data", "x.npz",
+                   "--model-file", "m.npz", "--nan-guard"])
+    assert rc == 2  # --nan-guard without --dead-letter
+    capsys.readouterr()

@@ -264,3 +264,34 @@ def test_ops_dashboard_events_without_batches(tmp_path):
     assert "no batch records" in htm
     assert "fault" in htm and "restart" in htm
     assert "Table view" in htm
+
+
+def test_ops_dashboard_dead_letter_line(tmp_path):
+    """The ops view carries the DLQ story: a Dead-letter tile counting
+    quarantined rows and serious-class poison/dead_letter event marks."""
+    import time as _time
+
+    from real_time_fraud_detection_system_tpu.io.dashboard import (
+        render_ops_html,
+    )
+
+    t0 = _time.time()
+    records = [
+        {"kind": "batch", "t": t0 + i, "batch": i + 1, "rows": 100,
+         "phases": {"dispatch": 0.001}, "queue_depth": 0,
+         "latency_s": 0.002}
+        for i in range(4)
+    ]
+    records += [
+        {"kind": "event", "t": t0 + 1.5, "event": "poison",
+         "phase": "detected", "resume_batch": 2, "failures": 2},
+        {"kind": "event", "t": t0 + 2.0, "event": "dead_letter",
+         "rows": 3, "reason": "crash", "batch": 3},
+        {"kind": "event", "t": t0 + 2.1, "event": "poison",
+         "phase": "isolated", "rows": 3},
+    ]
+    htm = render_ops_html({"model_kind": "logreg"}, records)
+    assert "Dead-letter rows" in htm
+    assert ">3<" in htm  # the quarantined-row count rendered in the tile
+    assert "1 crash loop(s)" in htm
+    assert "dead_letter" in htm and "poison" in htm

@@ -18,18 +18,64 @@ from real_time_fraud_detection_system_tpu.io.sink import MemorySink
 from real_time_fraud_detection_system_tpu.models.logreg import init_logreg
 from real_time_fraud_detection_system_tpu.models.scaler import Scaler
 from real_time_fraud_detection_system_tpu.runtime.engine import ScoringEngine
+from real_time_fraud_detection_system_tpu.io.sink import DeadLetterSink
 from real_time_fraud_detection_system_tpu.runtime.faults import (
     FlakySource,
     Heartbeat,
+    PoisonRowError,
+    PoisonSource,
     RetryPolicy,
     TransientError,
     corrupt_messages,
+    poison_messages,
     run_with_recovery,
     with_retries,
 )
 from real_time_fraud_detection_system_tpu.runtime.sources import ReplaySource
+from real_time_fraud_detection_system_tpu.utils.metrics import get_registry
 
 EPOCH0 = 1_743_465_600
+
+
+class _ListSource:
+    """Explicit batch list behind the poll/offsets/seek protocol — for
+    tests that must hold batch BOUNDARIES fixed across a clean run and a
+    poisoned run (bit-identical score comparisons need identical
+    batching, which row-count slicing can't give once rows are removed)."""
+
+    def __init__(self, batches):
+        self.batches = [dict(b) for b in batches]
+        self._pos = 0
+
+    def poll_batch(self):
+        if self._pos >= len(self.batches):
+            return None
+        b = self.batches[self._pos]
+        self._pos += 1
+        return {k: np.array(v, copy=True) for k, v in b.items()}
+
+    @property
+    def offsets(self):
+        return [self._pos]
+
+    def seek(self, offsets):
+        self._pos = int(offsets[0])
+
+
+def _batches_from(part, batch_rows=256):
+    src = ReplaySource(part, EPOCH0, batch_rows=batch_rows)
+    out = []
+    while True:
+        cols = src.poll_batch()
+        if cols is None:
+            return out
+        out.append(cols)
+
+
+def _dedup_latest(out: dict) -> dict:
+    _, last_idx = np.unique(out["tx_id"][::-1], return_index=True)
+    keep = len(out["tx_id"]) - 1 - last_idx
+    return {k: v[keep] for k, v in out.items()}
 
 
 def test_with_retries_succeeds_after_failures():
@@ -568,3 +614,406 @@ def test_recovery_exactly_once_store_parquet_sink(small_dataset, tmp_path):
     np.testing.assert_array_equal(got["tx_id"][a], want["tx_id"][b])
     np.testing.assert_allclose(got["prediction"][a],
                                want["prediction"][b], rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# PR 4: crash-loop breaker, bisection to the dead-letter queue, backoff
+# ---------------------------------------------------------------------------
+
+
+def test_retry_policy_jitter_fraction():
+    p = RetryPolicy(base_delay_s=10.0, jitter=0.5)
+    assert p.delay(0) == 10.0  # planning value stays deterministic
+    assert p.sleep_s(0, rand=lambda: 0.0) == 10.0
+    assert p.sleep_s(0, rand=lambda: 1.0) == 5.0
+    full = RetryPolicy(base_delay_s=10.0, jitter=1.0)  # full jitter
+    assert full.sleep_s(0, rand=lambda: 0.25) == 7.5
+    assert RetryPolicy(base_delay_s=10.0).sleep_s(0) == 10.0  # default: none
+    with pytest.raises(ValueError, match="jitter"):
+        RetryPolicy(jitter=1.5)
+
+
+def test_with_retries_outcome_metrics():
+    reg = get_registry()
+    retried = reg.counter("rtfds_retry_attempts_total", outcome="retried")
+    exhausted = reg.counter("rtfds_retry_attempts_total",
+                            outcome="exhausted")
+    r0, e0 = retried.value, exhausted.value
+
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise TransientError("boom")
+        return 1
+
+    with_retries(flaky, RetryPolicy(max_attempts=4, base_delay_s=0.0),
+                 sleep=lambda _: None)
+    assert retried.value - r0 == 2
+    assert exhausted.value - e0 == 0
+
+    def always():
+        raise TransientError("nope")
+
+    with pytest.raises(TransientError):
+        with_retries(always, RetryPolicy(max_attempts=2, base_delay_s=0.0),
+                     sleep=lambda _: None)
+    assert retried.value - r0 == 3
+    assert exhausted.value - e0 == 1
+
+
+def test_restart_backoff_metered(small_dataset, tmp_path):
+    """Transient restarts back off (exponential, capped) instead of
+    re-entering the loop hot; slept time lands in
+    rtfds_restart_backoff_seconds_total."""
+    cfg, txs, make_engine = _mk(small_dataset, tmp_path)
+    part = txs.slice(slice(0, 1024))
+    ckpt = Checkpointer(str(tmp_path / "ck_bo"))
+    src = FlakySource(ReplaySource(part, EPOCH0, batch_rows=256),
+                      fail_at=(3, 6))  # two crashes at DIFFERENT offsets
+    sleeps = []
+    m = get_registry().counter("rtfds_restart_backoff_seconds_total")
+    b0 = m.value
+    stats = run_with_recovery(
+        make_engine, src, ckpt, sink=MemorySink(), max_restarts=5,
+        restart_backoff=RetryPolicy(base_delay_s=0.05, multiplier=2.0,
+                                    max_delay_s=1.0),
+        sleep=sleeps.append)
+    assert stats["restarts"] == 2
+    assert sleeps == [0.05, 0.1]  # doubling, no jitter configured
+    assert abs((m.value - b0) - 0.15) < 1e-9
+
+
+def test_poison_source_and_messages_inject_negative_amounts(small_dataset):
+    from real_time_fraud_detection_system_tpu.core.envelope import (
+        decode_transaction_envelopes_fast,
+        encode_transaction_envelopes,
+    )
+
+    _, _, _, txs = small_dataset
+    part = txs.slice(slice(0, 64))
+    ids = part.tx_id[10:12].tolist()
+    src = PoisonSource(ReplaySource(part, EPOCH0, batch_rows=64),
+                       poison_tx_ids=ids)
+    cols = src.poll_batch()
+    mask = np.isin(cols["tx_id"], ids)
+    assert (cols["tx_amount_cents"][mask] < 0).all()
+    assert (cols["tx_amount_cents"][~mask] >= 0).all()
+
+    msgs = encode_transaction_envelopes(
+        part.tx_id, part.epoch_us(EPOCH0), part.customer_id,
+        part.terminal_id, part.amount_cents)
+    bad = poison_messages(msgs, poison_at=(3, 5))
+    out, invalid = decode_transaction_envelopes_fast(bad)
+    assert not invalid.any()  # poison DECODES fine — that's the point
+    assert (out["tx_amount_cents"][[3, 5]] < 0).all()
+    keep = np.ones(len(msgs), bool)
+    keep[[3, 5]] = False
+    np.testing.assert_array_equal(out["tx_amount_cents"][keep],
+                                  part.amount_cents[keep])
+
+
+def test_poison_pill_end_to_end_exactly_once(small_dataset, tmp_path):
+    """The headline acceptance: a stream with injected always-crashing
+    rows COMPLETES; the DLQ holds exactly those rows with their error
+    metadata; every other row's score is bit-identical to a run that
+    never contained them; crash_loops == 1 and restarts are bounded by
+    the crash-loop K — all asserted from the metrics registry."""
+    cfg, txs, make_engine = _mk(small_dataset, tmp_path, every=1)
+    part = txs.slice(slice(0, 1024))
+    batches = _batches_from(part)
+    poison_ids = [int(i) for i in batches[2]["tx_id"][10:13]]
+
+    # Clean reference: the SAME batch boundaries minus the poison rows.
+    clean_batches = [
+        {k: v[~np.isin(b["tx_id"], poison_ids)] for k, v in b.items()}
+        for b in batches
+    ]
+    clean_sink = MemorySink()
+    make_engine().run(_ListSource(clean_batches), sink=clean_sink)
+    clean = clean_sink.concat()
+
+    reg = get_registry()
+    m_restarts = reg.counter("rtfds_engine_restarts_total", cause="crash")
+    m_loops = reg.counter("rtfds_crash_loops_total")
+    m_dlq = reg.counter("rtfds_dead_letter_rows_total", reason="crash")
+    r0, c0, d0 = m_restarts.value, m_loops.value, m_dlq.value
+
+    dlq = DeadLetterSink(str(tmp_path / "dlq.jsonl"))
+    sink = MemorySink()
+    ckpt = Checkpointer(str(tmp_path / "ck_poison"))
+    src = PoisonSource(_ListSource(batches), poison_tx_ids=poison_ids)
+    stats = run_with_recovery(make_engine, src, ckpt, sink=sink,
+                              max_restarts=5, crash_loop_k=2,
+                              dead_letter=dlq)
+    assert stats["batches"] == len(batches)  # the stream did NOT die
+    assert m_loops.value - c0 == 1
+    assert m_restarts.value - r0 == 2  # bounded by K=2
+    assert m_dlq.value - d0 == 3
+
+    assert dlq.tx_ids() == sorted(poison_ids)
+    for rec in dlq.read_all():
+        assert rec["reason"] == "crash"
+        assert "PoisonRowError" in rec["error"]
+        assert rec["batch_index"] == 3
+        assert rec["columns"]["tx_amount_cents"] < 0  # the envelope image
+        assert rec["offsets"] == [3]
+
+    out = _dedup_latest(sink.concat())
+    a = np.argsort(out["tx_id"])
+    b = np.argsort(clean["tx_id"])
+    np.testing.assert_array_equal(out["tx_id"][a], clean["tx_id"][b])
+    # bit-identical, not allclose: survivors scored from the identical
+    # pre-batch state through the identical padded step
+    np.testing.assert_array_equal(out["prediction"][a],
+                                  clean["prediction"][b])
+
+
+def test_crash_loop_without_dlq_diagnoses_but_keeps_budget(small_dataset,
+                                                           tmp_path):
+    """No dead-letter sink: the breaker DIAGNOSES the loop (metric +
+    log, exactly once per streak) but keeps the budgeted retry — a
+    same-point transient must not die earlier than it would have before
+    the breaker existed, and a true poison loop is still bounded by
+    max_restarts exactly as before."""
+    cfg, txs, make_engine = _mk(small_dataset, tmp_path, every=1)
+    part = txs.slice(slice(0, 512))
+    poison_ids = [int(part.tx_id[300])]
+    ckpt = Checkpointer(str(tmp_path / "ck_nodlq"))
+    src = PoisonSource(ReplaySource(part, EPOCH0, batch_rows=256),
+                       poison_tx_ids=poison_ids)
+    reg = get_registry()
+    m_loops = reg.counter("rtfds_crash_loops_total")
+    m_restarts = reg.counter("rtfds_engine_restarts_total", cause="crash")
+    c0, r0 = m_loops.value, m_restarts.value
+    with pytest.raises(PoisonRowError):
+        run_with_recovery(make_engine, src, ckpt, sink=MemorySink(),
+                          max_restarts=3, crash_loop_k=2)
+    assert m_loops.value - c0 == 1  # diagnosed once, not per restart
+    assert m_restarts.value - r0 == 3  # full budget used, as pre-breaker
+
+
+def test_dlq_idempotent_by_tx_id_across_resume(small_dataset, tmp_path):
+    """Kill-mid-bisection contract: rows already written by a dead
+    incarnation's bisection are neither lost nor duplicated when the
+    resumed supervisor re-isolates the same batch (idempotent by tx_id),
+    and a later resume of the finished stream adds nothing."""
+    cfg, txs, make_engine = _mk(small_dataset, tmp_path, every=1)
+    part = txs.slice(slice(0, 768))
+    batches = _batches_from(part)
+    poison_ids = [int(i) for i in batches[1]["tx_id"][5:7]]
+
+    path = str(tmp_path / "dlq.jsonl")
+    # Simulate the prior incarnation that died mid-bisection: it already
+    # quarantined the rows but never advanced the checkpoint.
+    pre = DeadLetterSink(path)
+    seed_cols = {k: v[np.isin(batches[1]["tx_id"], poison_ids)]
+                 for k, v in batches[1].items()}
+    seed_cols = dict(seed_cols)
+    seed_cols["tx_amount_cents"] = -np.abs(seed_cols["tx_amount_cents"]) - 1
+    pre.put_rows(seed_cols, reason="crash", error="PoisonRowError: boom",
+                 batch_index=2, offsets=[2])
+    pre.close()
+
+    dlq = DeadLetterSink(path)  # reopened: seen-set reloads from disk
+    assert len(dlq) == 2
+    ckpt = Checkpointer(str(tmp_path / "ck_idem"))
+    sink = MemorySink()
+    src = PoisonSource(_ListSource(batches), poison_tx_ids=poison_ids)
+    stats = run_with_recovery(make_engine, src, ckpt, sink=sink,
+                              max_restarts=5, crash_loop_k=2,
+                              dead_letter=dlq)
+    assert stats["batches"] == len(batches)
+    recs = dlq.read_all()
+    assert [r["tx_id"] for r in recs] == sorted(poison_ids)  # no dups
+    assert len(np.unique(sink.concat()["tx_id"])) == 768 - 2
+
+    # Resuming the finished stream: nothing replays, nothing new lands.
+    s2 = MemorySink()
+    run_with_recovery(make_engine,
+                      PoisonSource(_ListSource(batches),
+                                   poison_tx_ids=poison_ids),
+                      ckpt, sink=s2, max_restarts=2, dead_letter=dlq)
+    assert s2.concat() == {}
+    assert [r["tx_id"] for r in dlq.read_all()] == sorted(poison_ids)
+
+
+def test_nan_guard_quarantines_before_state_contamination(tmp_path):
+    """Acceptance: an injected non-finite row lands in the DLQ with
+    reason=nonfinite, and the customer's SUBSEQUENT window aggregates
+    match a run that never saw the row — the NaN never reached the
+    running feature state."""
+    import jax.numpy as jnp
+
+    from real_time_fraud_detection_system_tpu.models.logreg import (
+        LogRegParams,
+    )
+
+    def mk_batch(txs_rows):
+        tx, ts, cust, term, cents = zip(*txs_rows)
+        return {
+            "tx_id": np.array(tx, np.int64),
+            "tx_datetime_us": np.array(ts, np.int64),
+            "customer_id": np.array(cust, np.int64),
+            "terminal_id": np.array(term, np.int64),
+            "tx_amount_cents": np.array(cents, np.int64),
+            "kafka_ts_ms": np.array(ts, np.int64) // 1000,
+        }
+
+    H = 3_600_000_000  # 1h in us
+    batches = [
+        mk_batch([(1, 1 * H, 5, 9, 1000), (2, 2 * H, 6, 9, 2500)]),
+        # tx 3 is the poison: its TX_AMOUNT hits the degenerate scaler
+        # column exactly (0/0 -> NaN score)
+        mk_batch([(3, 3 * H, 5, 9, 66600), (4, 4 * H, 6, 8, 1234)]),
+        # customer 5 again: its window aggregates prove whether tx 3's
+        # amount contaminated the state
+        mk_batch([(5, 5 * H, 5, 9, 2000), (6, 6 * H, 6, 8, 700)]),
+    ]
+    clean_batches = [
+        {k: v[b["tx_id"] != 3] for k, v in b.items()} for b in batches
+    ]
+
+    cfg = Config(
+        features=FeatureConfig(customer_capacity=128, terminal_capacity=256,
+                               cms_width=1 << 10),
+        runtime=RuntimeConfig(batch_buckets=(64,), max_batch_rows=64,
+                              nan_guard=True),
+    )
+    cfg_clean = cfg.replace(runtime=RuntimeConfig(
+        batch_buckets=(64,), max_batch_rows=64))
+    # Degenerate scaler artifact: zero variance recorded for TX_AMOUNT
+    # with mean == the poison amount -> (666 - 666) / 0 = NaN for that
+    # row, +/-inf (finite sigmoid) for every other.
+    mean = np.zeros(15, np.float32)
+    scale = np.ones(15, np.float32)
+    mean[0], scale[0] = 666.0, 0.0
+    params = LogRegParams(w=jnp.full(15, 0.01, jnp.float32),
+                          b=jnp.float32(0.0))
+    scaler = Scaler(mean=jnp.asarray(mean), scale=jnp.asarray(scale))
+
+    clean_sink = MemorySink()
+    ScoringEngine(cfg_clean, kind="logreg", params=params,
+                  scaler=scaler).run(_ListSource(clean_batches),
+                                     sink=clean_sink)
+    clean = clean_sink.concat()
+
+    dlq = DeadLetterSink(str(tmp_path / "dlq_nan.jsonl"))
+    sink = MemorySink()
+    engine = ScoringEngine(cfg, kind="logreg", params=params,
+                           scaler=scaler, dead_letter=dlq)
+    engine.run(_ListSource(batches), sink=sink)
+
+    recs = dlq.read_all()
+    assert [r["tx_id"] for r in recs] == [3]
+    assert recs[0]["reason"] == "nonfinite"
+    out = sink.concat()
+    assert np.isfinite(out["prediction"]).all()  # NaN never reached sink
+    a, b = np.argsort(out["tx_id"]), np.argsort(clean["tx_id"])
+    np.testing.assert_array_equal(out["tx_id"][a], clean["tx_id"][b])
+    # predictions AND emitted window-feature columns are bit-identical
+    # to the run that never saw the row: zero state contamination
+    np.testing.assert_array_equal(out["prediction"][a],
+                                  clean["prediction"][b])
+    for col in clean:
+        if col.startswith("customer_id_") or col.startswith("terminal_id_"):
+            np.testing.assert_array_equal(out[col][a], clean[col][b], col)
+
+
+def test_nan_guard_requires_dead_letter(tmp_path):
+    cfg = Config(runtime=RuntimeConfig(batch_buckets=(64,),
+                                       max_batch_rows=64, nan_guard=True))
+    params = init_logreg(15)
+    scaler = Scaler(mean=np.zeros(15, np.float32),
+                    scale=np.ones(15, np.float32))
+    with pytest.raises(ValueError, match="dead-letter"):
+        ScoringEngine(cfg, kind="logreg", params=params, scaler=scaler)
+
+
+def test_guarded_source_post_poll_drop_kills_zombie():
+    """The zombie double-fault race (documented in _GuardedSource): a
+    poll already in flight when the watchdog abandons the incarnation
+    returns AFTER abandonment — the post-poll fence check must drop that
+    batch and kill the zombie rather than hand consumed rows to a dead
+    incarnation."""
+    import threading
+
+    from real_time_fraud_detection_system_tpu.runtime.faults import (
+        StallError,
+        _AbandonFence,
+        _GuardedSource,
+    )
+
+    class SlowInner:
+        def __init__(self):
+            self.gate = threading.Event()
+            self.in_poll = threading.Event()
+            self.consumed = 0
+
+        def poll_batch(self):
+            self.in_poll.set()
+            assert self.gate.wait(10.0)  # the hang
+            self.consumed += 1  # rows irrevocably consumed on release
+            return {"tx_id": np.array([1], np.int64)}
+
+        @property
+        def offsets(self):
+            return [self.consumed]
+
+        def seek(self, offsets):
+            pass
+
+    inner = SlowInner()
+    fence = _AbandonFence()
+    g = _GuardedSource(inner, fence)
+    box = {}
+
+    def zombie():
+        try:
+            box["out"] = g.poll_batch()
+        except BaseException as e:
+            box["err"] = e
+
+    t = threading.Thread(target=zombie, name="zombie-poll")
+    t.start()
+    assert inner.in_poll.wait(5.0)  # the poll is in flight...
+    fence.abandoned = True  # ...when the watchdog abandons it
+    inner.gate.set()  # the hang releases AFTER abandonment
+    t.join(5.0)
+    assert not t.is_alive()  # zombie died
+    assert inner.consumed == 1  # the rows WERE consumed...
+    assert isinstance(box.get("err"), StallError)  # ...but dropped
+    assert "out" not in box
+
+
+def test_shared_source_zombie_lineage_contiguous(small_dataset, tmp_path):
+    """Integration twin: shared source + hang + restart, then the hang
+    releases — the zombie's late poll dies on the post-poll fence and
+    the restarted incarnation's sink lineage stays gap/dup-free."""
+    import pyarrow.parquet as pq
+
+    from real_time_fraud_detection_system_tpu.io.sink import ParquetSink
+    from real_time_fraud_detection_system_tpu.runtime.faults import (
+        HangingSource,
+    )
+
+    cfg, txs, make_engine = _mk(small_dataset, tmp_path)
+    part = txs.slice(slice(0, 1024))
+    src = HangingSource(ReplaySource(part, EPOCH0, batch_rows=256),
+                        hang_at=(2,), max_hang_s=120.0)
+    sink = ParquetSink(str(tmp_path / "analyzed_z"))
+    ckpt = Checkpointer(str(tmp_path / "ck_z"))
+    try:
+        stats = run_with_recovery(make_engine, src, ckpt, sink=sink,
+                                  max_restarts=3, stall_timeout_s=6.0)
+        assert stats["restarts"] >= 1
+    finally:
+        # Release the hang: the zombie's in-flight poll now returns and
+        # must die on the fence instead of appending stale output.
+        _drain_zombies(src.release)
+    parts = sorted((tmp_path / "analyzed_z").glob("part-*.parquet"))
+    idxs = [int(p.name[len("part-"):-len(".parquet")]) for p in parts]
+    assert idxs == list(range(1, len(idxs) + 1))  # no dup, no gap
+    total = sum(pq.read_table(str(f)).num_rows for f in parts)
+    assert total == 1024

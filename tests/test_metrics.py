@@ -572,3 +572,107 @@ def test_kafka_style_source_never_sets_lag_gauge():
         assert reg.get("rtfds_source_lag_rows").value == 50
     finally:
         reg.clear()
+
+
+def test_family_total_sums_label_sets():
+    reg = MetricsRegistry()
+    assert reg.family_total("rtfds_engine_restarts_total") is None
+    reg.counter("rtfds_engine_restarts_total", cause="crash").inc(3)
+    reg.counter("rtfds_engine_restarts_total", cause="stall").inc()
+    assert reg.family_total("rtfds_engine_restarts_total") == 4.0
+    reg.histogram("rtfds_phase_seconds", phase="dispatch").observe(0.1)
+    assert reg.family_total("rtfds_phase_seconds") is None  # no scalar total
+
+
+def test_healthz_reports_failure_counters_and_degraded_state():
+    """/healthz carries restarts/crash_loops/dead_letter_rows for
+    degraded-but-alive alerting: rows sitting in the DLQ flip status to
+    'degraded' while the endpoint stays 200 (the stream is healthy, the
+    quarantine needs triage)."""
+    import json
+    import urllib.request
+
+    reg = MetricsRegistry()
+    server = MetricsServer(port=0, registry=reg).start()
+    try:
+        ok, body = server.health()
+        assert ok and body["status"] == "ok"
+        assert "restarts" not in body  # clean run: no failure families
+
+        reg.counter("rtfds_engine_restarts_total", cause="crash").inc(2)
+        reg.counter("rtfds_engine_restarts_total", cause="stall").inc()
+        reg.counter("rtfds_crash_loops_total").inc()
+        ok, body = server.health()
+        assert ok and body["status"] == "ok"  # restarts alone: recovered
+        assert body["restarts"] == 3.0
+        assert body["crash_loops"] == 1.0
+
+        reg.gauge("rtfds_dead_letter_rows").set(5)
+        with urllib.request.urlopen(server.url + "/healthz") as r:
+            assert r.status == 200  # alive — degraded is not unhealthy
+            body = json.loads(r.read())
+        assert body["status"] == "degraded"
+        assert body["dead_letter_rows"] == 5.0
+        assert body["healthy"] is True
+    finally:
+        server.stop()
+
+
+def test_dead_letter_sink_idempotent_and_parquet_variant(tmp_path):
+    import numpy as np
+
+    from real_time_fraud_detection_system_tpu.io.sink import (
+        DeadLetterSink,
+        ParquetDeadLetterSink,
+        make_dead_letter_sink,
+        read_dead_letter,
+    )
+
+    cols = {
+        "tx_id": np.array([7, 8], np.int64),
+        "tx_amount_cents": np.array([-100, -200], np.int64),
+        "customer_id": np.array([1, 2], np.int64),
+    }
+    reg = MetricsRegistry()
+    jl = DeadLetterSink(str(tmp_path / "dlq.jsonl"), registry=reg)
+    assert jl.put_rows(cols, reason="crash", error="E: boom",
+                       batch_index=4, offsets=[9],
+                       envelopes=[b"raw1", b"raw2"]) == 2
+    assert jl.put_rows(cols, reason="crash", error="E: boom",
+                       batch_index=4) == 0  # replay: idempotent by tx_id
+    jl.close()
+    recs = read_dead_letter(str(tmp_path / "dlq.jsonl"))
+    assert [r["tx_id"] for r in recs] == [7, 8]
+    assert recs[0]["envelope_b64"]  # raw envelope bytes preserved
+    assert recs[0]["columns"]["tx_amount_cents"] == -100
+    assert reg.counter("rtfds_dead_letter_rows_total",
+                       reason="crash").value == 2
+    assert reg.gauge("rtfds_dead_letter_rows").value == 2
+    # reopen: the seen-set reloads, so a resumed process stays idempotent
+    jl2 = DeadLetterSink(str(tmp_path / "dlq.jsonl"), registry=reg)
+    assert jl2.put_rows(cols, reason="crash", error="E") == 0
+    jl2.close()
+
+    pq_dir = str(tmp_path / "dlq_parts")
+    pqs = make_dead_letter_sink(pq_dir, registry=reg)
+    assert isinstance(pqs, ParquetDeadLetterSink)
+    assert pqs.put_rows(cols, reason="nonfinite", error="NaN",
+                        batch_index=2) == 2
+    assert pqs.put_rows(cols, reason="nonfinite", error="NaN",
+                        batch_index=2) == 0
+    recs = read_dead_letter(pq_dir)
+    assert [r["tx_id"] for r in recs] == [7, 8]
+    assert recs[0]["reason"] == "nonfinite"
+    assert recs[0]["columns"]["customer_id"] == 1
+    # same-batch replay overwrote its own part, not appended a new one
+    assert len(list((tmp_path / "dlq_parts").glob("dlq-*.parquet"))) == 1
+    # a LATER quarantine for the same (batch, reason) — e.g. the
+    # nan-guard rescore flushing out another row — must MERGE into the
+    # part, never replace it (the seen-set skips rows already on disk)
+    more = {k: v[:1] for k, v in cols.items()}
+    more = dict(more)
+    more["tx_id"] = np.array([9], np.int64)
+    assert pqs.put_rows(more, reason="nonfinite", error="NaN",
+                        batch_index=2) == 1
+    assert [r["tx_id"] for r in read_dead_letter(pq_dir)] == [7, 8, 9]
+    assert len(list((tmp_path / "dlq_parts").glob("dlq-*.parquet"))) == 1
