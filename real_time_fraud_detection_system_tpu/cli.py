@@ -447,6 +447,10 @@ def cmd_score(args) -> int:
         log.error("--latency-slo-ms must be >= 0, got %s",
                   args.latency_slo_ms)
         return 2
+    if args.decode_workers < 0 or args.prefetch_batches < 0:
+        log.error("--decode-workers and --prefetch-batches must be >= 0, "
+                  "got %s / %s", args.decode_workers, args.prefetch_batches)
+        return 2
     cfg = cfg.replace(runtime=_dc.replace(
         cfg.runtime,
         emit_features=not args.alerts_only,
@@ -461,11 +465,21 @@ def cmd_score(args) -> int:
         latency_slo_ms=args.latency_slo_ms,
         async_sink=args.async_sink,
         sink_queue_batches=args.sink_queue_batches,
+        decode_workers=args.decode_workers,
+        prefetch_batches=args.prefetch_batches,
+        fetch_overlap=not args.no_fetch_overlap,
         nan_guard=args.nan_guard,
         dead_letter=args.dead_letter,
         crash_loop_k=args.crash_loop_k,
         restart_backoff_ms=args.restart_backoff_ms,
     ))
+    # Unconditional (0 resolves to auto): publishes the
+    # rtfds_decode_workers gauge the README's host-plane reading uses,
+    # in auto mode too.
+    from real_time_fraud_detection_system_tpu.core import native
+
+    log.info("ingest decode workers: %d",
+             native.set_decode_workers(args.decode_workers))
     cpu_model = None
     if args.scorer == "cpu":
         cpu_model = model  # TrainedModel.predict_proba runs host-side numpy
@@ -597,6 +611,26 @@ def cmd_score(args) -> int:
             mode=args.mode,
             with_labels=args.online_lr > 0,
         )
+    if cfg.runtime.prefetch_batches > 0:
+        # Background source prefetch: poll + decode run ahead of the
+        # loop on a producer thread. Wrapped OUTSIDE any fault injectors
+        # the source may carry, and re-wrapped per incarnation via the
+        # factory (supervised mode) so each restart owns a fresh
+        # producer generation. Offsets commit on consumption; poison
+        # isolation flips the wrapper to synchronous serving.
+        from real_time_fraud_detection_system_tpu.runtime import (
+            PrefetchSource,
+        )
+
+        depth = cfg.runtime.prefetch_batches
+        if source_factory is not None:
+            inner_factory = source_factory
+
+            def source_factory():
+                return PrefetchSource(inner_factory(), max_batches=depth)
+
+        source = PrefetchSource(source, max_batches=depth)
+        log.info("source prefetch on (queue depth %d)", depth)
     ckpt = make_checkpointer(args.checkpoint_dir) if args.checkpoint_dir else None
     sink = make_parquet_sink(args.out) if args.out else None
     raw_table = None
@@ -1652,6 +1686,20 @@ def main(argv=None) -> int:
                         "sink_write phase becomes an enqueue, and "
                         "checkpoints drain the queue first (exactly-"
                         "once output is preserved)")
+    p.add_argument("--decode-workers", type=int, default=0,
+                   help="ingest-decode worker threads: each envelope "
+                        "byte-batch is sharded into contiguous slabs "
+                        "decoded concurrently (bit-identical to serial "
+                        "decode). 0 = auto (min(8, cores)); 1 = serial")
+    p.add_argument("--prefetch-batches", type=int, default=0,
+                   help="background source prefetch: poll + decode run "
+                        "ahead of the loop into a bounded queue of this "
+                        "many batches (offsets commit on consumption, so "
+                        "checkpoint replay semantics are unchanged; "
+                        "poison isolation runs unprefetched). 0 = off")
+    p.add_argument("--no-fetch-overlap", action="store_true",
+                   help="disable overlapped result fetch (async D2H "
+                        "copies issued at dispatch time); on by default")
     p.add_argument("--sink-queue-batches", type=int, default=8,
                    help="bounded queue depth (batch results) for "
                         "--async-sink; a full queue backpressures the "

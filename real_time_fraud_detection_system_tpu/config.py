@@ -234,6 +234,28 @@ class RuntimeConfig:
     # saves drain the queue first, so offsets keep trailing durable sink
     # output (the exactly-once invariant).
     async_sink: bool = False
+    # Ingest-decode worker threads (core/native.py): each polled
+    # envelope byte-batch is sharded into contiguous offset slabs decoded
+    # concurrently by a thread pool (the ctypes scanner releases the
+    # GIL) into disjoint slices of one columnar staging buffer —
+    # bit-identical to single-worker decode, scales with cores. 0 = auto
+    # (min(8, cores)); 1 = serial.
+    decode_workers: int = 0
+    # Background source prefetch (runtime/prefetch.py::PrefetchSource):
+    # poll + decode run ahead of the serving loop on a producer thread
+    # into a bounded queue of this many batches; the loop thread's
+    # source_poll phase collapses to a dequeue. Offsets commit only on
+    # CONSUMPTION (checkpoint/replay semantics unchanged: a crash
+    # replays prefetched-but-unconsumed batches, never skips them), and
+    # poison isolation switches the source back to synchronous polling.
+    # 0 = off.
+    prefetch_batches: int = 0
+    # Overlapped result fetch: issue device→host copies asynchronously
+    # (copy_to_host_async) the moment a step's handle resolves, so the
+    # D2H transfer runs while the loop thread preps/dispatches later
+    # batches instead of serializing into result_wait. Free on CPU; the
+    # head start is metered as rtfds_fetch_overlap_seconds_total.
+    fetch_overlap: bool = True
     # Bounded queue depth (batch results) for the async sink; a full
     # queue backpressures the loop thread
     # (rtfds_sink_backpressure_seconds_total counts the blocked time).

@@ -26,6 +26,7 @@ import ctypes
 import os
 import subprocess
 import threading
+import time
 from typing import Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -33,6 +34,21 @@ import numpy as np
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _build_error: Optional[str] = None
+
+
+def _slab_hist():
+    """Per-slab decode-time histogram. Resolved once per decode BATCH
+    (one get-or-create under the registry lock, ~µs at batch
+    granularity), not cached module-level: the process registry can be
+    cleared between runs and a cached series would go orphan."""
+    from real_time_fraud_detection_system_tpu.utils.metrics import (
+        get_registry,
+    )
+
+    return get_registry().histogram(
+        "rtfds_decode_slab_seconds",
+        "wall time of one ingest-decode slab (a contiguous envelope "
+        "range scanned by one worker)")
 
 
 def _repo_root() -> str:
@@ -91,37 +107,105 @@ def native_available() -> bool:
     return _load() is not None
 
 
-_pool = None
-_POOL_WORKERS = min(8, os.cpu_count() or 1)
+_pools: dict = {}  # worker count -> ThreadPoolExecutor
+_AUTO_WORKERS = min(8, os.cpu_count() or 1)
+_decode_workers = 0  # 0 = auto (_AUTO_WORKERS)
 _PARALLEL_MIN = 8192  # below this, thread fan-out costs more than it saves
 
 
-def _get_pool():
-    global _pool
+def set_decode_workers(n: int) -> int:
+    """Set the process-wide ingest-decode worker count (0 = auto:
+    min(8, cores); 1 = serial). Returns the resolved count. The pool is
+    rebuilt lazily on the next decode, so this is safe to call between
+    runs (the CLI calls it once at startup from --decode-workers)."""
+    global _decode_workers
+    n = max(0, int(n))
     with _lock:
-        if _pool is None:
+        _decode_workers = n
+    resolved = n or _AUTO_WORKERS
+    from real_time_fraud_detection_system_tpu.utils.metrics import (
+        get_registry,
+    )
+
+    get_registry().gauge(
+        "rtfds_decode_workers",
+        "configured ingest-decode worker threads").set(resolved)
+    return resolved
+
+
+def get_decode_workers() -> int:
+    """The resolved decode worker count (auto applied)."""
+    return _decode_workers or _AUTO_WORKERS
+
+
+def _get_pool(workers: int):
+    """Decode pool for ``workers``, one per distinct size. Never shut
+    down on a size change: another thread (a prefetch producer, a
+    concurrent bench variant) may be mid-``pool.map`` on the old pool,
+    and a shutdown there raises into ITS in-flight decode. Distinct
+    sizes in one process are a handful (explicit test/bench overrides +
+    the configured serving count), so the idle-thread cost is bounded."""
+    with _lock:
+        pool = _pools.get(workers)
+        if pool is None:
             from concurrent.futures import ThreadPoolExecutor
 
-            _pool = ThreadPoolExecutor(_POOL_WORKERS,
-                                       thread_name_prefix="envelope-decode")
-        return _pool
+            pool = ThreadPoolExecutor(workers,
+                                      thread_name_prefix="envelope-decode")
+            _pools[workers] = pool
+        return pool
+
+
+def decode_envelopes_slab(
+    buf: bytes,
+    offsets: np.ndarray,
+    a: int,
+    b: int,
+    tx_id: np.ndarray,
+    t_us: np.ndarray,
+    cust: np.ndarray,
+    term: np.ndarray,
+    cents: np.ndarray,
+    op: np.ndarray,
+    valid: np.ndarray,
+) -> None:
+    """Decode envelopes [a, b) of one packed byte-batch into rows [a, b)
+    of the output columns — the per-worker unit of the parallel decode.
+    ``offsets`` is the full absolute offset table (n+1 entries into
+    ``buf``); each slab writes a disjoint slice of the shared columnar
+    staging arrays, so concurrent slabs never contend. Public so tests
+    can pin per-slab exactness against the whole-batch decode."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native decoder unavailable: {_build_error}")
+    if b > a:
+        lib.decode_envelopes(
+            buf, offsets[a : b + 1], b - a,
+            tx_id[a:b], t_us[a:b], cust[a:b], term[a:b], cents[a:b],
+            op[a:b], valid[a:b],
+        )
 
 
 def decode_transaction_envelopes_native(
     messages: Iterable[bytes],
     kafka_timestamps_ms: Optional[Sequence[int]] = None,
+    workers: Optional[int] = None,
 ) -> Tuple[dict, np.ndarray]:
     """Columnar decode via the C++ scanner. Same contract as the Python
     decoder; raises RuntimeError if the native library is unavailable.
 
-    Large batches are chunked over a thread pool: the ctypes call
-    releases the GIL, the offset table is absolute into one shared
-    packed buffer, and each chunk writes a disjoint slice of the output
-    columns — the scan scales with cores (SURVEY's host-ingress hard
-    part: 1M txns/s of JSON would bottleneck on a single-threaded parse
-    before the TPU). The packed-buffer join beats a zero-copy pointer
-    array here: building a ctypes ``c_char_p`` array costs ~2× the join
-    (measured 108 ms vs 54 ms at 200k messages)."""
+    Large batches are sharded into contiguous offset slabs decoded
+    concurrently over a thread pool (:func:`decode_envelopes_slab`): the
+    ctypes call releases the GIL, the offset table is absolute into one
+    shared packed buffer, and each slab writes a disjoint slice of the
+    preallocated columnar staging arrays — the scan scales with cores
+    (SURVEY's host-ingress hard part: 1M txns/s of JSON would bottleneck
+    on a single-threaded parse before the TPU). ``workers`` overrides
+    the process-wide :func:`set_decode_workers` setting for this call
+    (1 = serial); per-slab wall time lands in
+    ``rtfds_decode_slab_seconds``. The packed-buffer join beats a
+    zero-copy pointer array here: building a ctypes ``c_char_p`` array
+    costs ~2× the join (measured 108 ms vs 54 ms at 200k messages)."""
     lib = _load()
     if lib is None:
         raise RuntimeError(f"native decoder unavailable: {_build_error}")
@@ -145,17 +229,18 @@ def decode_transaction_envelopes_native(
     op = np.zeros(n, dtype=np.int8)
     valid = np.zeros(n, dtype=np.uint8)
 
-    def _scan(a: int, b: int) -> None:
-        if b > a:
-            lib.decode_envelopes(
-                buf, offsets[a : b + 1], b - a,
-                tx_id[a:b], t_us[a:b], cust[a:b], term[a:b], cents[a:b],
-                op[a:b], valid[a:b],
-            )
+    n_workers = max(1, int(workers) if workers else get_decode_workers())
+    outs = (tx_id, t_us, cust, term, cents, op, valid)
+    slab_hist = _slab_hist()
 
-    if n >= _PARALLEL_MIN and _POOL_WORKERS > 1:
-        bounds = np.linspace(0, n, _POOL_WORKERS + 1, dtype=np.int64)
-        list(_get_pool().map(
+    def _scan(a: int, b: int) -> None:
+        t0 = time.perf_counter()
+        decode_envelopes_slab(buf, offsets, a, b, *outs)
+        slab_hist.observe(time.perf_counter() - t0)
+
+    if n >= _PARALLEL_MIN and n_workers > 1:
+        bounds = np.linspace(0, n, n_workers + 1, dtype=np.int64)
+        list(_get_pool(n_workers).map(
             lambda ab: _scan(int(ab[0]), int(ab[1])),
             zip(bounds[:-1], bounds[1:]),
         ))

@@ -47,4 +47,12 @@ def fit_cpu_scorer(
             n_estimators=n_trees, max_depth=max_depth, random_state=seed, n_jobs=-1
         )
     model.fit(scaled, labels)
+    # Serial predict: with n_jobs=-1 sklearn's forest predict_proba
+    # accumulates per-tree probabilities from parallel workers in
+    # nondeterministic order, so two calls on the SAME model differ by
+    # ~1 ULP on ~20% of rows (measured: 111/600 at 20 trees on 2 cores).
+    # A parity ORACLE must be bit-stable call-to-call; fitting above
+    # keeps the parallel speedup, prediction pins the summation order.
+    if hasattr(model, "n_jobs"):
+        model.n_jobs = 1
     return CpuScorer(scaler, model)

@@ -30,6 +30,9 @@ from real_time_fraud_detection_system_tpu.runtime.faults import (  # noqa: F401
 from real_time_fraud_detection_system_tpu.runtime.autobatch import (  # noqa: F401
     AutoBatchController,
 )
+from real_time_fraud_detection_system_tpu.runtime.prefetch import (  # noqa: F401
+    PrefetchSource,
+)
 from real_time_fraud_detection_system_tpu.runtime.pipeline import (  # noqa: F401
     run_demo,
 )

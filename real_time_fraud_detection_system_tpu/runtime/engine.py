@@ -464,6 +464,21 @@ class ScoringEngine:
             "rtfds_aot_fallbacks_total",
             "dispatches that fell back from an AOT executable to jit "
             "(input signature drifted from the precompiled one)")
+        # Overlapped result fetch (runtime.fetch_overlap): D2H copies are
+        # issued async the moment a step's handle resolves, so the
+        # transfer runs while the loop preps/dispatches later batches.
+        # The counter accumulates the head start each batch's transfer
+        # got before the blocking materialization — result_wait then
+        # reflects device time + residual transfer, not full transfer
+        # serialization.
+        self._fetch_overlap = bool(self.cfg.runtime.fetch_overlap)
+        self._m_fetch_overlap = reg.counter(
+            "rtfds_fetch_overlap_seconds_total",
+            "seconds of D2H head start granted by async result fetch "
+            "(copy_to_host_async issue to blocking materialization)")
+        # Per-bucket zero feature matrices, shared read-only across
+        # batches (see _zero_features).
+        self._zeros_cache: dict = {}
 
     # -- AOT bucket precompilation ----------------------------------------
 
@@ -580,6 +595,64 @@ class ScoringEngine:
                     "jit", key, type(e).__name__, str(e)[:200])
                 self._aot = {}
         return jit_fn(*args)
+
+    def _zero_features(self, n: int) -> np.ndarray:
+        """Per-bucket zero [n, 15] matrix, allocated once and shared
+        READ-ONLY across batches. Alerts-only and sequence serving emit a
+        definitionally-zero feature matrix every batch — reallocating it
+        per batch is pure host-plane overhead (every sink consumer copies
+        on use: parquet astype, memory-concat). Write-protected so an
+        accidental in-place mutation fails loudly instead of silently
+        editing an already-emitted BatchResult."""
+        buf = self._zeros_cache.get(n)
+        if buf is None:
+            buf = np.zeros((n, N_FEATURES), np.float32)
+            buf.setflags(write=False)
+            self._zeros_cache[n] = buf
+        return buf
+
+    def _issue_host_fetch(self, probs, feats) -> Optional[float]:
+        """Start device→host copies for exactly the leaves
+        ``_finish_batch`` will materialize — probs unless the cpu oracle
+        ignores them, the feature matrix only when it actually leaves
+        the device (never under alerts-only/sequence; the packed array,
+        not the full fallback matrix, under selective emission). Returns
+        the issue time for overlap metering, or None when disabled or
+        nothing was issued (an array without the async-copy API keeps
+        its blocking fetch)."""
+        if not self._fetch_overlap:
+            return None
+        targets = []
+        if isinstance(feats, dict):
+            # selective emission: the packed array ALREADY carries the
+            # probs — fetching handle["probs"] too would re-pay the very
+            # padded-batch transfer the packing exists to avoid
+            targets.append(feats["packed"])
+        else:
+            if self.scorer != "cpu":
+                targets.append(probs)
+            if (feats is not None and self.kind != "sequence"
+                    and self.cfg.runtime.emit_features):
+                targets.append(feats)
+        issued = False
+        for x in targets:
+            f = getattr(x, "copy_to_host_async", None)
+            if f is None:
+                continue
+            try:
+                f()
+                issued = True
+            except Exception:
+                # a backend without async D2H just keeps the blocking
+                # fetch — the optimization must never break the fetch
+                return None
+        return time.perf_counter() if issued else None
+
+    def _meter_fetch_overlap(self, handle: dict) -> None:
+        ti = handle.pop("fetch_issue_t", None)
+        if ti is not None:
+            self._m_fetch_overlap.inc(
+                max(0.0, time.perf_counter() - ti))
 
     def _maybe_use_pallas_forest(self, kind: str, params) -> None:
         """Swap the tree-ensemble scorer for the fused Pallas kernel.
@@ -733,22 +806,28 @@ class ScoringEngine:
                 )
             self.state.feature_state = fstate
             self.state.params = params
+            # Start the D2H copies NOW (they queue behind the step's
+            # compute): by the time _finish_batch blocks, the transfer
+            # has been running since compute finished.
+            t_fetch = self._issue_host_fetch(probs, feats)
             t2 = time.perf_counter()
         return {"cols": cols, "n": n, "probs": probs, "feats": feats,
                 "t0": t0, "prep_s": t1 - t0, "dispatch_s": t2 - t1,
-                "pre_state": pre_state}
+                "pre_state": pre_state, "fetch_issue_t": t_fetch}
 
     def _finish_batch(self, handle: dict) -> BatchResult:
         """Block on the handle's device futures; build the BatchResult."""
         n = handle["n"]
+        self._meter_fetch_overlap(handle)
         if self._selective:
             probs_np, feats_np = self._unpack_selective(handle)
             return self._finish_result(handle, probs_np, feats_np)
         if not self.cfg.runtime.emit_features or self.kind == "sequence":
             # alerts-only mode: the feature matrix stays in HBM. The
             # sequence scorer's matrix is definitionally zeros (raw event
-            # channels replace engineered features) — never worth a D2H.
-            feats_np = np.zeros((n, N_FEATURES), np.float32)
+            # channels replace engineered features) — never worth a D2H,
+            # and the host-side filler is a shared read-only buffer.
+            feats_np = self._zero_features(n)
         else:
             # astype: under emit_dtype="bfloat16" the transfer was bf16
             # (half the bytes); widen back for sinks/consumers

@@ -879,6 +879,15 @@ def run_with_recovery(
         model_reload = (make_model_reload()
                         if make_model_reload and not poison_pending
                         else None)
+        # Isolation must run UNPREFETCHED: a PrefetchSource's producer
+        # thread polling ahead during bisection would decouple the
+        # polled position from the batch under diagnosis. set_sync(True)
+        # stops the producer and rewinds the inner source to the
+        # consumed position, so isolation sees the same batch boundaries
+        # a checkpoint replay would; flipped back after isolation.
+        set_sync = getattr(source, "set_sync", None)
+        if poison_pending and set_sync is not None:
+            set_sync(True)
         try:
             if poison_pending:
                 if heartbeat is not None:
@@ -904,6 +913,8 @@ def run_with_recovery(
                 poison_pending = False
                 fail_key, fail_count = None, 0
                 budget_used = 0
+                if set_sync is not None:
+                    set_sync(False)  # fast (prefetched) mode resumes
                 continue
             if heartbeat is not None:
                 stats = _run_watched(

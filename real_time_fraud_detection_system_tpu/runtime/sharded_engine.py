@@ -443,6 +443,7 @@ class ShardedScoringEngine(ScoringEngine):
         # phase decomposition matches the single-chip engine's.
         t_prep = time.perf_counter()
         parts = []
+        t_fetch = None  # last chunk's async-fetch issue time
         for part_cols, rows, pos in chunks:
             batch = make_batch(
                 customer_id=part_cols["customer_id"],
@@ -482,6 +483,7 @@ class ShardedScoringEngine(ScoringEngine):
                         self.state.feature_state, self.state.params,
                         jbatch, jnp.asarray(okey))
                 self.state.feature_state = hstate
+                t_fetch = self._issue_host_fetch(probs, None) or t_fetch
                 # the sequence scorer has no engineered feature matrix;
                 # None skips the feats copy (_finish_batch's buffer is 0)
                 parts.append((rows, pos, probs, None))
@@ -516,6 +518,10 @@ class ShardedScoringEngine(ScoringEngine):
                 )
             self.state.feature_state = fstate
             self.state.params = params
+            # async D2H per chunk: each chunk's transfer starts the
+            # moment ITS compute finishes, overlapping later chunk
+            # dispatches and the next batch's host prep
+            t_fetch = self._issue_host_fetch(probs, feats) or t_fetch
             parts.append((rows, pos, probs, feats))
         t_disp = time.perf_counter()
         if chunks:
@@ -524,13 +530,21 @@ class ShardedScoringEngine(ScoringEngine):
             self.tracer.add_span("dispatch", t_prep, t_disp,
                                  chunks=len(chunks))
         return {"cols": cols, "n": n, "parts": parts, "t0": t0,
-                "prep_s": t_prep - t0, "dispatch_s": t_disp - t_prep}
+                "prep_s": t_prep - t0, "dispatch_s": t_disp - t_prep,
+                "fetch_issue_t": t_fetch}
 
     def _finish_batch(self, handle: dict) -> BatchResult:
         n = handle["n"]
+        self._meter_fetch_overlap(handle)
         emit = self.cfg.runtime.emit_features
         probs_np = np.zeros(n, dtype=np.float32)
-        feats_np = np.zeros((n, N_FEATURES), dtype=np.float32)
+        if self.kind == "sequence" or not emit:
+            # nothing below writes the feature matrix on these paths
+            # (sequence parts carry feats=None; alerts-only skips the
+            # per-shard feats copy) — share the read-only staging buffer
+            feats_np = self._zero_features(n)
+        else:
+            feats_np = np.zeros((n, N_FEATURES), dtype=np.float32)
         overflowed = False  # per BATCH, however many chunks overflow
         for rows, pos, probs, feats in handle["parts"]:
             if isinstance(feats, dict):

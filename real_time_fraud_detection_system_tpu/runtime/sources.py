@@ -259,11 +259,15 @@ class ReplaySource(_SourceTelemetry):
             self._offsets = list(offsets)
 
 
-class SyntheticSource:
+class SyntheticSource(_SourceTelemetry):
     """Paced live generator — the ``datagen`` container analogue.
 
     Yields batches at ``rate_tps`` transactions/second of wall-clock (or as
     fast as possible when 0), drawing from a pre-generated table.
+    Telemetry lands under ``source="synthetic"`` (poll latency includes
+    the pacing sleep — that IS this source's poll behavior); the inner
+    replay cursor is polled via ``_poll_inner`` so rows are not
+    double-counted under ``source="replay"``.
     """
 
     def __init__(
@@ -275,13 +279,15 @@ class SyntheticSource:
     ):
         self._replay = ReplaySource(txs, start_epoch_s, batch_rows, "columnar")
         self.rate_tps = rate_tps
+        self._init_source_metrics("synthetic")
 
     def poll_batch(self) -> Optional[dict]:
-        import time
-
-        cols = self._replay.poll_batch()
+        t0 = time.perf_counter()
+        cols = self._replay._poll_inner()
         if cols is not None and self.rate_tps > 0:
             time.sleep(len(cols["tx_id"]) / self.rate_tps)
+        self._observe_poll(t0, cols,
+                           lag=self._replay.txs.n - self._replay._pos)
         return cols
 
     @property
@@ -289,7 +295,10 @@ class SyntheticSource:
         return self._replay.offsets
 
     def seek(self, offsets: Sequence[int]) -> None:
-        self._replay.seek(offsets)
+        self._m_seeks.inc()
+        # inner seek counts under source="replay" too; its counter exists
+        # but stays untouched here (we never call the inner poll_batch)
+        self._replay._pos = int(offsets[0])
 
 
 class RawTableSource(_SourceTelemetry):
@@ -673,13 +682,21 @@ class KafkaSource(_SourceTelemetry):
                     ck.TopicPartition(self.topic, p, self._next[p])
                 )
 
-    def commit(self) -> None:
-        """Commit tracked next-offsets to the broker (post-checkpoint)."""
+    def commit(self, offsets: Optional[Sequence[int]] = None) -> None:
+        """Commit next-offsets to the broker (post-checkpoint).
+
+        ``offsets`` (dense list, -1 = skip, same layout as the
+        ``offsets`` property) overrides the tracked positions — the
+        prefetcher passes its CONSUMED offsets here so a broker commit
+        never records the producer's read-ahead (committed offsets must
+        trail the framework checkpoint, or a crash could skip rows)."""
         ck = self._ck
-        tps = [
-            ck.TopicPartition(self.topic, p, off)
-            for p, off in sorted(self._next.items())
-        ]
+        if offsets is not None:
+            pairs = [(p, int(off)) for p, off in enumerate(offsets)
+                     if int(off) >= 0]
+        else:
+            pairs = sorted(self._next.items())
+        tps = [ck.TopicPartition(self.topic, p, off) for p, off in pairs]
         if tps:
             self._consumer.commit(offsets=tps, asynchronous=False)
 

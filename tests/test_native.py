@@ -9,6 +9,7 @@ from real_time_fraud_detection_system_tpu.core.envelope import (
     encode_transaction_envelopes,
 )
 from real_time_fraud_detection_system_tpu.core.native import (
+    decode_envelopes_slab,
     decode_transaction_envelopes_native,
     native_available,
 )
@@ -16,6 +17,86 @@ from real_time_fraud_detection_system_tpu.core.native import (
 pytestmark = pytest.mark.skipif(
     not native_available(), reason="g++ / native build unavailable"
 )
+
+
+def _corpus(rng, n):
+    return encode_transaction_envelopes(
+        np.arange(n, dtype=np.int64),
+        rng.integers(1_700_000_000, 1_800_000_000, n) * 1_000_000,
+        rng.integers(0, 5000, n),
+        rng.integers(0, 10000, n),
+        rng.integers(-(10**9), 10**10, n),
+    )
+
+
+def test_decode_workers_bit_identical(rng):
+    """The multi-worker slab decode is the SAME columns as serial decode
+    — worker count is a throughput knob, never a semantics knob. The
+    corpus exceeds the parallel threshold so the pool path actually
+    runs."""
+    n = 10000
+    msgs = _corpus(rng, n)
+    ref_cols, ref_inv = decode_transaction_envelopes_native(
+        msgs, workers=1)
+    for w in (2, 3, 4, 8):
+        cols, inv = decode_transaction_envelopes_native(msgs, workers=w)
+        assert np.array_equal(ref_inv, inv), w
+        for k in ref_cols:
+            assert np.array_equal(ref_cols[k], cols[k]), (w, k)
+
+
+def test_decode_slab_matches_whole_batch(rng):
+    """Per-slab exactness: decoding [a, b) ranges of one packed buffer
+    into slices of shared staging columns reproduces the whole-batch
+    decode exactly, for uneven and degenerate split points."""
+    n = 257
+    msgs = _corpus(rng, n)
+    ref_cols, ref_inv = decode_transaction_envelopes_native(
+        msgs, workers=1)
+
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(np.fromiter((len(m) for m in msgs), np.int64, count=n),
+              out=offsets[1:])
+    buf = b"".join(msgs)
+    for bounds in ([0, n], [0, 1, n], [0, 100, 100, 256, n],
+                   [0, 64, 128, 192, n]):
+        outs = [np.zeros(n, np.int64) for _ in range(5)]
+        outs += [np.zeros(n, np.int8), np.zeros(n, np.uint8)]
+        for a, b in zip(bounds[:-1], bounds[1:]):
+            decode_envelopes_slab(buf, offsets, a, b, *outs)
+        tx_id, t_us, cust, term, cents, op, valid = outs
+        assert np.array_equal(ref_cols["tx_id"], tx_id), bounds
+        assert np.array_equal(ref_cols["tx_datetime_us"], t_us), bounds
+        assert np.array_equal(ref_cols["customer_id"], cust), bounds
+        assert np.array_equal(ref_cols["terminal_id"], term), bounds
+        assert np.array_equal(ref_cols["tx_amount_cents"], cents), bounds
+        assert np.array_equal(ref_cols["op"], op), bounds
+        assert np.array_equal(ref_inv, valid == 0), bounds
+
+
+def test_decode_worker_config_and_slab_metric(rng):
+    from real_time_fraud_detection_system_tpu.core import native
+    from real_time_fraud_detection_system_tpu.utils.metrics import (
+        get_registry,
+    )
+
+    before = native.get_decode_workers()
+    try:
+        assert native.set_decode_workers(3) == 3
+        assert native.get_decode_workers() == 3
+        g = get_registry().get("rtfds_decode_workers")
+        assert g is not None and g.value == 3
+        h = get_registry().histogram("rtfds_decode_slab_seconds")
+        c0 = h.count
+        # above the parallel threshold: one slab per worker
+        msgs = _corpus(rng, 8192)
+        decode_transaction_envelopes_native(msgs)
+        assert h.count == c0 + 3
+        # below it: exactly one (serial) slab
+        decode_transaction_envelopes_native(msgs[:10])
+        assert h.count == c0 + 4
+    finally:
+        native.set_decode_workers(before)
 
 
 def test_native_parity_random(rng):

@@ -1,6 +1,6 @@
 """Fast CPU perf gate (`make perf-smoke`, also tier-1).
 
-Asserts the two hot-loop invariants this PR's tentpole establishes:
+Asserts the hot-loop invariants the perf tentpoles establish:
 
 1. With ``AsyncSink`` + ``ParquetSink``, the LOOP THREAD's ``sink_write``
    phase p50 (registry ``rtfds_phase_seconds{phase=sink_write}``) is
@@ -10,9 +10,15 @@ Asserts the two hot-loop invariants this PR's tentpole establishes:
    ``rtfds_xla_recompiles_total == 0`` — and the same stream WITHOUT
    precompile pays a detectable mid-stream compile, so the zero is the
    optimization working, not the detector sleeping.
+3. Host data plane (input side): 4-worker slab decode is bit-identical
+   to serial decode and ≥ 1.5× faster (ratio gated on the box actually
+   having usable CPU parallelism — the correctness half always runs);
+   with a ``PrefetchSource`` the loop thread's ``source_poll`` phase p50
+   collapses to dequeue scale (≤ 1 ms) while rows stay identical.
 """
 
 import dataclasses
+import time
 
 import numpy as np
 
@@ -145,6 +151,182 @@ def test_precompile_zero_recompiles_across_all_buckets(small_dataset):
     assert _recompiles(reg_on) == 0
     assert reg_on.get("rtfds_aot_fallbacks_total").value == 0
     assert reg_on.get("rtfds_precompiled_steps_total").value == 2
+
+
+def _envelope_corpus(n):
+    from real_time_fraud_detection_system_tpu.core.envelope import (
+        encode_transaction_envelopes,
+    )
+
+    rng = np.random.default_rng(11)
+    return encode_transaction_envelopes(
+        np.arange(n, dtype=np.int64),
+        rng.integers(1_700_000_000, 1_800_000_000, n) * 1_000_000,
+        rng.integers(0, 5000, n),
+        rng.integers(0, 10000, n),
+        rng.integers(100, 50000, n),
+    )
+
+
+def _raw_scan_parallelism() -> float:
+    """Calibrate: two threads of the GIL-released C scan over disjoint
+    halves vs one serial scan of the same corpus → the speedup this box
+    can physically deliver. Sandboxed CI boxes sometimes report nproc=2
+    while delivering ~1 core of throughput (measured here: 1.0-1.3×) —
+    a fixed speedup gate there would only measure the scheduler. The
+    bit-identical half of the decode gate runs regardless."""
+    import threading
+
+    from real_time_fraud_detection_system_tpu.core import native
+
+    msgs = _envelope_corpus(20000)
+    n = len(msgs)
+    offsets = np.zeros(n + 1, np.int64)
+    np.cumsum(np.fromiter((len(m) for m in msgs), np.int64, count=n),
+              out=offsets[1:])
+    buf = b"".join(msgs)
+
+    def outs():
+        return ([np.zeros(n, np.int64) for _ in range(5)]
+                + [np.zeros(n, np.int8), np.zeros(n, np.uint8)])
+
+    o = outs()
+    t0 = time.perf_counter()
+    native.decode_envelopes_slab(buf, offsets, 0, n, *o)
+    serial = time.perf_counter() - t0
+    o1, o2 = outs(), outs()
+    th = [threading.Thread(target=native.decode_envelopes_slab,
+                           args=(buf, offsets, 0, n // 2, *o1)),
+          threading.Thread(target=native.decode_envelopes_slab,
+                           args=(buf, offsets, n // 2, n, *o2))]
+    t0 = time.perf_counter()
+    for t in th:
+        t.start()
+    for t in th:
+        t.join()
+    par = time.perf_counter() - t0
+    return serial / max(par, 1e-9)
+
+
+def test_parallel_decode_bit_identical_and_scales():
+    """Host-plane gate, input side: multi-worker slab decode returns the
+    EXACT columns of serial decode (always asserted), runs one slab per
+    worker (asserted from rtfds_decode_slab_seconds), and on a box with
+    real CPU parallelism is ≥ 1.5× faster at 4 workers."""
+    from real_time_fraud_detection_system_tpu.core import native
+    from real_time_fraud_detection_system_tpu.utils.metrics import (
+        get_registry,
+    )
+
+    if not native.native_available():
+        import pytest
+
+        pytest.skip("native decoder unavailable")
+    msgs = _envelope_corpus(40000)
+
+    hist = get_registry().histogram("rtfds_decode_slab_seconds")
+    c0 = hist.count
+    ref, ref_inv = native.decode_transaction_envelopes_native(
+        msgs, workers=1)
+    assert hist.count == c0 + 1  # serial: one slab
+    cols, inv = native.decode_transaction_envelopes_native(
+        msgs, workers=4)
+    assert hist.count == c0 + 5  # parallel: one slab per worker
+    assert np.array_equal(ref_inv, inv)
+    for k in ref:
+        assert np.array_equal(ref[k], cols[k]), k
+
+    raw = _raw_scan_parallelism()
+    if raw < 1.8:
+        import pytest
+
+        pytest.skip(f"box delivers only {raw:.2f}x on the raw 2-thread "
+                    "scan (needs ~2 real cores to attest the 1.5x "
+                    "gate); bit-identity asserted, speedup gate skipped")
+
+    def best(workers, reps=3):
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            native.decode_transaction_envelopes_native(
+                msgs, workers=workers)
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    t1, t4 = best(1), best(4)
+    assert t1 / t4 >= 1.5, (
+        f"4-worker decode {t4 * 1e3:.1f} ms vs serial {t1 * 1e3:.1f} ms "
+        f"({t1 / t4:.2f}x) — below the 1.5x host-plane gate")
+
+
+def test_prefetch_collapses_loop_thread_source_poll(small_dataset,
+                                                    tmp_path):
+    """Host-plane gate, loop side: with a PrefetchSource the loop
+    thread's source_poll phase p50 drops to dequeue scale (≤ 1 ms on
+    CPU smoke) while the synchronous twin pays the full per-poll decode
+    cost — and the scored rows are identical."""
+    from real_time_fraud_detection_system_tpu.io import MemorySink
+    from real_time_fraud_detection_system_tpu.runtime import (
+        PrefetchSource,
+    )
+
+    _, _, _, txs = small_dataset
+    part = txs.slice(slice(0, 5120))  # 20 batches of 256
+    cfg = _cfg()
+
+    class _CostlyPoll:
+        """ReplaySource with a fixed per-poll host cost (the stand-in
+        for envelope decode)."""
+
+        def __init__(self, cost_s=0.004):
+            self.inner = ReplaySource(part, EPOCH0, batch_rows=256)
+            self.cost_s = cost_s
+
+        def poll_batch(self):
+            cols = self.inner.poll_batch()
+            if cols is not None:
+                time.sleep(self.cost_s)
+            return cols
+
+        @property
+        def offsets(self):
+            return self.inner.offsets
+
+        def seek(self, offsets):
+            self.inner.seek(offsets)
+
+    class _SlowSink(MemorySink):
+        """Paces the loop so the producer can stay ahead (a real loop
+        is paced by the device step + sink; CPU smoke steps are ~ms)."""
+
+        def append(self, res):
+            time.sleep(0.008)
+            super().append(res)
+
+    def run(prefetch):
+        reg = MetricsRegistry()
+        src = _CostlyPoll()
+        if prefetch:
+            src = PrefetchSource(src, max_batches=4, registry=reg)
+        sink = _SlowSink()
+        _engine(cfg, reg).run(src, sink=sink)
+        if prefetch:
+            src.close()
+        hist = reg.get("rtfds_phase_seconds", phase="source_poll")
+        return hist, sink.concat()
+
+    h_sync, out_sync = run(False)
+    h_pre, out_pre = run(True)
+    assert np.array_equal(out_sync["tx_id"], out_pre["tx_id"])
+    np.testing.assert_allclose(out_sync["prediction"],
+                               out_pre["prediction"], atol=1e-7)
+    assert h_sync.percentile(50) >= 3e-3, (
+        "control run did not pay the per-poll cost; the prefetch "
+        "assertion below would be vacuous")
+    assert h_pre.percentile(50) <= 1e-3, (
+        f"loop-thread source_poll p50 "
+        f"{h_pre.percentile(50) * 1e3:.2f} ms with prefetch on is not "
+        "dequeue-scale")
 
 
 def test_precompile_preserves_scores(small_dataset):

@@ -1,0 +1,419 @@
+"""PrefetchSource: stream equivalence, consumed-offset semantics, error
+propagation, sync-mode rewind, and the crash/replay lineage contract
+(checkpointed offsets trail CONSUMPTION, never the producer's
+read-ahead) — the input-side mirror of tests/test_async_sink.py."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from real_time_fraud_detection_system_tpu.config import (
+    Config,
+    DataConfig,
+    FeatureConfig,
+    RuntimeConfig,
+)
+from real_time_fraud_detection_system_tpu.io import Checkpointer
+from real_time_fraud_detection_system_tpu.io.sink import (
+    MemorySink,
+    ParquetSink,
+)
+from real_time_fraud_detection_system_tpu.models.logreg import init_logreg
+from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+from real_time_fraud_detection_system_tpu.runtime import (
+    FlakySource,
+    PrefetchSource,
+    ReplaySource,
+    ScoringEngine,
+    TransientError,
+    run_with_recovery,
+)
+from real_time_fraud_detection_system_tpu.utils.metrics import (
+    MetricsRegistry,
+)
+
+EPOCH0 = 1_743_465_600  # 2025-04-01
+
+
+def _wait_for(pred, timeout=5.0):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            raise AssertionError("condition not reached in time")
+        time.sleep(0.01)
+
+
+def test_prefetch_stream_identical_offsets_trail(small_dataset):
+    """Prefetched batches are byte-identical to synchronous polling, and
+    `offsets` after each consume equals the synchronous source's — never
+    the producer's read-ahead position."""
+    _, _, _, txs = small_dataset
+    part = txs.slice(slice(0, 2048))
+    ref = ReplaySource(part, EPOCH0, batch_rows=256)
+    reg = MetricsRegistry()
+    src = PrefetchSource(ReplaySource(part, EPOCH0, batch_rows=256),
+                         max_batches=3, registry=reg)
+    # let the producer run ahead so read-ahead != consumption
+    _wait_for(lambda: src._q.qsize() >= 3)
+    assert list(src.offsets) == [0]  # nothing consumed yet
+    n = 0
+    while True:
+        a, b = ref.poll_batch(), src.poll_batch()
+        if a is None:
+            assert b is None
+            break
+        n += 1
+        for k in a:
+            assert np.array_equal(a[k], b[k]), k
+        assert list(src.offsets) == list(ref.offsets)
+    assert n == 8
+    assert src.poll_batch() is None  # stays exhausted
+    src.close()
+
+
+def test_prefetch_error_propagates_original_type(small_dataset):
+    """A producer-side poll failure re-raises on the consumer thread
+    with its ORIGINAL type (the supervisor's recover_on is type-based),
+    and seek() revives the source for the recovery replay."""
+    _, _, _, txs = small_dataset
+    part = txs.slice(slice(0, 1024))
+    src = PrefetchSource(
+        FlakySource(ReplaySource(part, EPOCH0, batch_rows=256),
+                    fail_at=(2,)),
+        max_batches=2)
+    got = []
+    with pytest.raises(TransientError):
+        for _ in range(10):
+            cols = src.poll_batch()
+            if cols is None:
+                break
+            got.append(cols)
+    assert len(got) == 2
+    # recovery: seek back to the consumed position and resume
+    src.seek(src.offsets)
+    more = 0
+    while src.poll_batch() is not None:
+        more += 1
+    assert len(got) + more == 4  # 1024 rows / 256
+    src.close()
+
+
+def test_prefetch_set_sync_rewinds_readahead(small_dataset):
+    """set_sync(True) must discard the queued read-ahead AND rewind the
+    inner source to the consumed position — the unprefetched (isolation)
+    mode then re-serves every unconsumed row at replay-identical batch
+    boundaries."""
+    _, _, _, txs = small_dataset
+    part = txs.slice(slice(0, 2048))
+    src = PrefetchSource(ReplaySource(part, EPOCH0, batch_rows=256),
+                         max_batches=4)
+    _wait_for(lambda: src._q.qsize() >= 4)
+    first = src.poll_batch()
+    src.set_sync(True)
+    assert list(src.inner.offsets) == list(src.offsets)
+    seen = [first["tx_id"]]
+    while True:
+        cols = src.poll_batch()
+        if cols is None:
+            break
+        seen.append(cols["tx_id"])
+    ids = np.concatenate(seen)
+    # every row exactly once, in order — no gap where the read-ahead was
+    assert np.array_equal(ids, np.sort(ids))
+    assert len(ids) == 2048 and len(np.unique(ids)) == 2048
+    src.set_sync(False)
+    src.close()
+
+
+def test_prefetch_commit_uses_consumed_offsets():
+    """A broker-side commit through the prefetcher must carry the
+    CONSUMED offsets, not the producer's read-ahead (committed offsets
+    lead → a crash skips rows)."""
+
+    class _Brokerish:
+        def __init__(self, batches=8):
+            self._n = batches
+            self._pos = 0
+            self.committed = None
+
+        def poll_batch(self):
+            if self._pos >= self._n:
+                return None
+            self._pos += 1
+            return {"tx_id": np.array([self._pos], np.int64)}
+
+        @property
+        def offsets(self):
+            return [self._pos]
+
+        def seek(self, offsets):
+            self._pos = int(offsets[0])
+
+        def commit(self, offsets=None):
+            self.committed = list(offsets) if offsets is not None \
+                else [self._pos]
+
+    inner = _Brokerish()
+    src = PrefetchSource(inner, max_batches=4)
+    _wait_for(lambda: src._q.qsize() >= 4)
+    src.poll_batch()
+    src.poll_batch()
+    src.commit()
+    assert inner.committed == [2]  # consumed, though ~6 were polled
+    src.close()
+
+
+def _small_setup(small_dataset, every=2):
+    _, _, _, txs = small_dataset
+    cfg = Config(
+        data=DataConfig(n_customers=50, n_terminals=100, n_days=30),
+        features=FeatureConfig(customer_capacity=128, terminal_capacity=256,
+                               cms_width=1 << 10),
+        runtime=RuntimeConfig(checkpoint_every_batches=every,
+                              batch_buckets=(256,), max_batch_rows=256),
+    )
+    scaler = Scaler(mean=np.zeros(15, np.float32),
+                    scale=np.ones(15, np.float32))
+    params = init_logreg(15)
+
+    def make_engine():
+        import jax.numpy as jnp
+
+        return ScoringEngine(
+            cfg, kind="logreg", params=params,
+            scaler=Scaler(jnp.asarray(scaler.mean),
+                          jnp.asarray(scaler.scale)),
+        )
+
+    return cfg, txs, make_engine
+
+
+def _lineage(out_dir: str):
+    return sorted(
+        int(f[len("part-"):-len(".parquet")])
+        for f in os.listdir(out_dir)
+        if f.startswith("part-") and f.endswith(".parquet")
+    )
+
+
+def test_prefetch_crash_replay_exactly_once_poll_fault(small_dataset,
+                                                       tmp_path):
+    """Producer-side crash (flaky poll) mid-stream with prefetch on:
+    recovery seeks the consumed position and the sink lineage stays
+    gap/dup-free with rows identical to a clean unprefetched run."""
+    _, txs, make_engine = _small_setup(small_dataset)
+    part = txs.slice(slice(0, 2048))
+
+    ref = ParquetSink(str(tmp_path / "ref"))
+    make_engine().run(ReplaySource(part, EPOCH0, batch_rows=256), sink=ref)
+    clean = ref.read_all()
+
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    sink = ParquetSink(str(tmp_path / "out"))
+    src = PrefetchSource(
+        FlakySource(ReplaySource(part, EPOCH0, batch_rows=256),
+                    fail_at=(3, 6)),
+        max_batches=3)
+    stats = run_with_recovery(make_engine, src, ckpt, sink=sink,
+                              max_restarts=5)
+    src.close()
+    # 1 or 2 restarts: the supervisor's initial seek fences the very
+    # first producer generation, so a scripted failure the producer
+    # already hit while read-ahead fires into a DISCARDED generation
+    # (its batches re-serve after the seek — no loss, no restart).
+    assert 1 <= stats["restarts"] <= 2
+    assert _lineage(str(tmp_path / "out")) == \
+        list(range(1, stats["batches"] + 1))
+    out = sink.read_all()
+    assert np.array_equal(np.sort(out["tx_id"]), np.sort(clean["tx_id"]))
+    i1, i2 = np.argsort(out["tx_id"]), np.argsort(clean["tx_id"])
+    np.testing.assert_allclose(out["prediction"][i1],
+                               clean["prediction"][i2], atol=1e-6)
+
+
+def test_prefetch_crash_replay_exactly_once_engine_kill(small_dataset,
+                                                        tmp_path):
+    """Kill the ENGINE mid-stream (sink failure) while the prefetch
+    queue holds decoded-ahead batches: the checkpoint recorded consumed
+    offsets only, so the replay re-serves the read-ahead — contiguous
+    no-dup/no-gap lineage, rows exactly once. This is the test that
+    fails if offsets ever commit at poll time."""
+    _, txs, make_engine = _small_setup(small_dataset)
+    part = txs.slice(slice(0, 2048))
+
+    ref = MemorySink()
+    make_engine().run(ReplaySource(part, EPOCH0, batch_rows=256), sink=ref)
+    clean = ref.concat()
+
+    class _KillsOnce(ParquetSink):
+        def __init__(self, d):
+            super().__init__(d)
+            self.fired = False
+
+        def append(self, res):
+            # crash with the producer demonstrably ahead of consumption
+            if not self.fired and res.batch_index == 4:
+                self.fired = True
+                raise OSError("injected sink crash at batch 4")
+            super().append(res)
+
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    sink = _KillsOnce(str(tmp_path / "out"))
+    src = PrefetchSource(ReplaySource(part, EPOCH0, batch_rows=256),
+                         max_batches=4)
+    _wait_for(lambda: src._q.qsize() >= 4)  # read-ahead exists up front
+    stats = run_with_recovery(make_engine, src, ckpt, sink=sink,
+                              max_restarts=3)
+    src.close()
+    assert stats["restarts"] == 1
+    assert stats["rows"] == 2048
+    assert _lineage(str(tmp_path / "out")) == \
+        list(range(1, stats["batches"] + 1))
+    out = sink.read_all()
+    assert np.array_equal(np.sort(out["tx_id"]),
+                          np.sort(clean["tx_id"]))
+
+
+def test_prefetch_poison_isolation_runs_unprefetched(small_dataset,
+                                                     tmp_path):
+    """Poison pills under prefetch: the supervisor flips the source to
+    synchronous serving for the isolation incarnation (set_sync rewinds
+    the read-ahead, so bisection sees replay-identical batch
+    boundaries), quarantines exactly the poison rows, and flips back —
+    survivors score bit-identical to a never-poisoned stream."""
+    from real_time_fraud_detection_system_tpu.io.sink import (
+        DeadLetterSink,
+    )
+    from real_time_fraud_detection_system_tpu.runtime import PoisonSource
+
+    _, txs, make_engine = _small_setup(small_dataset, every=1)
+    part = txs.slice(slice(0, 1024))
+    src_b = ReplaySource(part, EPOCH0, batch_rows=256)
+    batches = []
+    while True:
+        cols = src_b.poll_batch()
+        if cols is None:
+            break
+        batches.append(cols)
+    poison_ids = [int(i) for i in batches[2]["tx_id"][10:13]]
+
+    class _ListSource:
+        def __init__(self, bs):
+            self.bs = bs
+            self._pos = 0
+
+        def poll_batch(self):
+            if self._pos >= len(self.bs):
+                return None
+            b = self.bs[self._pos]
+            self._pos += 1
+            return {k: np.array(v, copy=True) for k, v in b.items()}
+
+        @property
+        def offsets(self):
+            return [self._pos]
+
+        def seek(self, offsets):
+            self._pos = int(offsets[0])
+
+    clean_batches = [
+        {k: v[~np.isin(b["tx_id"], poison_ids)] for k, v in b.items()}
+        for b in batches
+    ]
+    clean_sink = MemorySink()
+    make_engine().run(_ListSource(clean_batches), sink=clean_sink)
+    clean = clean_sink.concat()
+
+    dlq = DeadLetterSink(str(tmp_path / "dlq.jsonl"))
+    sink = MemorySink()
+    ckpt = Checkpointer(str(tmp_path / "ck_poison"))
+    src = PrefetchSource(
+        PoisonSource(_ListSource(batches), poison_tx_ids=poison_ids),
+        max_batches=3)
+    stats = run_with_recovery(make_engine, src, ckpt, sink=sink,
+                              max_restarts=5, crash_loop_k=2,
+                              dead_letter=dlq)
+    assert stats["batches"] == len(batches)  # the stream did NOT die
+    assert not src._sync  # fast (prefetched) mode resumed after isolation
+    assert dlq.tx_ids() == sorted(poison_ids)
+    src.close()
+
+    out = sink.concat()
+    _, last = np.unique(out["tx_id"][::-1], return_index=True)
+    keep = len(out["tx_id"]) - 1 - last
+    out = {k: v[keep] for k, v in out.items()}
+    a, b = np.argsort(out["tx_id"]), np.argsort(clean["tx_id"])
+    np.testing.assert_array_equal(out["tx_id"][a], clean["tx_id"][b])
+    np.testing.assert_array_equal(out["prediction"][a],
+                                  clean["prediction"][b])
+
+
+def test_prefetch_wait_metric_counts_blocked_time():
+    """A slow producer makes the consumer block on the queue — the
+    blocked time must land in rtfds_prefetch_wait_seconds_total."""
+
+    class _Slow:
+        def __init__(self):
+            self._i = 0
+
+        def poll_batch(self):
+            if self._i >= 3:
+                return None
+            time.sleep(0.05)
+            self._i += 1
+            return {"tx_id": np.array([self._i], np.int64)}
+
+        @property
+        def offsets(self):
+            return [self._i]
+
+        def seek(self, offsets):
+            self._i = int(offsets[0])
+
+    reg = MetricsRegistry()
+    src = PrefetchSource(_Slow(), max_batches=2, registry=reg)
+    while src.poll_batch() is not None:
+        pass
+    src.close()
+    wait = reg.get("rtfds_prefetch_wait_seconds_total")
+    assert wait is not None and wait.value > 0.04
+
+
+def test_synthetic_source_emits_telemetry(small_dataset):
+    """Satellite: SyntheticSource (the datagen analogue) now carries the
+    shared source telemetry — poll latency, rows ingested, and the lag
+    gauge under source="synthetic"."""
+    from real_time_fraud_detection_system_tpu.runtime import (
+        SyntheticSource,
+    )
+    from real_time_fraud_detection_system_tpu.utils.metrics import (
+        get_registry,
+    )
+
+    _, _, _, txs = small_dataset
+    part = txs.slice(slice(0, 512))
+    reg = get_registry()
+    src = SyntheticSource(part, EPOCH0, rate_tps=0.0, batch_rows=256)
+    polls0 = reg.get("rtfds_source_poll_seconds", source="synthetic")
+    n0 = polls0.count if polls0 is not None else 0
+    rows = 0
+    while True:
+        cols = src.poll_batch()
+        if cols is None:
+            break
+        rows += len(cols["tx_id"])
+    assert rows == 512
+    polls = reg.get("rtfds_source_poll_seconds", source="synthetic")
+    assert polls is not None and polls.count >= n0 + 2
+    ingested = reg.get("rtfds_source_rows_total", source="synthetic")
+    assert ingested is not None and ingested.value >= 512
+    lag = reg.get("rtfds_source_lag_rows")
+    assert lag is not None and lag.value == 0  # drained
+    # seek counts under the synthetic seek counter
+    seeks = reg.get("rtfds_source_seeks_total", source="synthetic")
+    s0 = seeks.value if seeks is not None else 0
+    src.seek([0])
+    assert reg.get("rtfds_source_seeks_total",
+                   source="synthetic").value == s0 + 1
