@@ -79,6 +79,14 @@ perf-smoke:
 chaos-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos_smoke.py -q
 
+# dirty-recovery gate: every durable-state failure mode — kill-during-
+# save, byte-flip, truncation, flaky store, torn PUT, broken delta
+# chain — across BOTH checkpoint planes (local + object store) must
+# recover to a COMPLETE stream with exact corrupt/fallback counters
+# from the registry and gap/dup-free sink lineage
+recovery-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_recovery_smoke.py -q
+
 test:
 	$(PY) -m pytest tests/ -q
 
@@ -119,4 +127,4 @@ install:
 clean:
 	rm -rf $(OUT)
 
-.PHONY: demo datagen train score run-all query dashboard connectors dryrun trace-demo bench perf-smoke chaos-smoke test integration integration-up integration-down sqlcheck install clean
+.PHONY: demo datagen train score run-all query dashboard connectors dryrun trace-demo bench perf-smoke chaos-smoke recovery-smoke test integration integration-up integration-down sqlcheck install clean

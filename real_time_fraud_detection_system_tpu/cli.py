@@ -387,6 +387,15 @@ def cmd_score(args) -> int:
         log.error("--restart-backoff-ms must be >= 0, got %s",
                   args.restart_backoff_ms)
         return 2
+    if args.checkpoint_full_every < 1:
+        log.error("--checkpoint-full-every must be >= 1, got %s",
+                  args.checkpoint_full_every)
+        return 2
+    if args.checkpoint_op_attempts < 1 or args.checkpoint_op_timeout < 0:
+        log.error("--checkpoint-op-attempts must be >= 1 and "
+                  "--checkpoint-op-timeout >= 0, got %s / %s",
+                  args.checkpoint_op_attempts, args.checkpoint_op_timeout)
+        return 2
     # replay reads a generated .npz; raw-table reads a table DIRECTORY
     txs = (load_transactions(args.data)
            if args.data and args.source == "replay" else None)
@@ -472,6 +481,9 @@ def cmd_score(args) -> int:
         dead_letter=args.dead_letter,
         crash_loop_k=args.crash_loop_k,
         restart_backoff_ms=args.restart_backoff_ms,
+        checkpoint_full_every=args.checkpoint_full_every,
+        checkpoint_op_timeout_s=args.checkpoint_op_timeout,
+        checkpoint_op_attempts=args.checkpoint_op_attempts,
     ))
     # Unconditional (0 resolves to auto): publishes the
     # rtfds_decode_workers gauge the README's host-plane reading uses,
@@ -631,7 +643,12 @@ def cmd_score(args) -> int:
 
         source = PrefetchSource(source, max_batches=depth)
         log.info("source prefetch on (queue depth %d)", depth)
-    ckpt = make_checkpointer(args.checkpoint_dir) if args.checkpoint_dir else None
+    ckpt = make_checkpointer(
+        args.checkpoint_dir,
+        full_every=cfg.runtime.checkpoint_full_every,
+        op_timeout_s=cfg.runtime.checkpoint_op_timeout_s,
+        op_attempts=cfg.runtime.checkpoint_op_attempts,
+    ) if args.checkpoint_dir else None
     sink = make_parquet_sink(args.out) if args.out else None
     raw_table = None
     if args.raw_table:
@@ -965,6 +982,64 @@ def cmd_dlq(args) -> int:
     print(_json_line({**summary, "replayed": len(out)}))
     for o in out:
         print(_json_line(o))
+    return 0
+
+
+def cmd_ckpt(args) -> int:
+    """Inspect / verify the checkpoint lineage (the durable-state plane).
+
+    Default: list every live checkpoint with kind (full/delta/v1), size,
+    age, batch counter, and a cheap validity verdict. ``--verify``
+    re-checksums every live checkpoint AND its delta chain (the deploy
+    preflight: exit 1 on any corruption, so a rollout gates on a
+    restorable lineage). ``--inspect NAME`` dumps one checkpoint's
+    manifest (per-leaf CRCs, fingerprint, incarnation, chain link).
+    """
+    from real_time_fraud_detection_system_tpu.io.checkpoint import (
+        make_checkpointer,
+    )
+    from real_time_fraud_detection_system_tpu.utils import get_logger
+
+    log = get_logger("ckpt")
+    try:
+        ck = make_checkpointer(args.path)
+    except Exception as e:  # noqa: BLE001 — bad URL/creds → usage error
+        log.error("cannot open checkpoint lineage at %s: %s", args.path, e)
+        return 2
+    if args.inspect:
+        try:
+            man = ck.manifest(args.inspect)
+        except KeyError:
+            log.error("no checkpoint named %s under %s", args.inspect,
+                      args.path)
+            return 2
+        except Exception as e:  # corrupt manifest is a finding, not a crash
+            print(_json_line({"path": args.inspect, "valid": False,
+                              "error": f"{type(e).__name__}: {e}"[:300]}))
+            return 1
+        print(_json_line({"path": args.inspect, **man}))
+        return 0
+    # listing stays cheap (one read per entry); only --verify pays for
+    # the full chain re-checksum
+    report = ck.verify_all(deep=bool(args.verify))
+    n_bad = sum(1 for e in report if not e.get("valid"))
+    summary = {
+        "path": args.path,
+        "checkpoints": len(report),
+        "corrupt": n_bad,
+        "latest": ck.latest(),
+    }
+    print(_json_line(summary))
+    for e in report:
+        if not args.verify:
+            # listing mode: drop the verbose corruption detail
+            e = {k: v for k, v in e.items() if k != "detail"}
+        print(_json_line(e))
+    if args.verify and n_bad:
+        log.error("%d corrupt checkpoint(s) in the lineage — restore "
+                  "would fall back past them; quarantine or rebuild "
+                  "before deploying", n_bad)
+        return 1
     return 0
 
 
@@ -1730,6 +1805,22 @@ def main(argv=None) -> int:
                         "(0 = off)")
     p.add_argument("--start-date", default="2025-04-01")
     p.add_argument("--checkpoint-dir", default="")
+    p.add_argument("--checkpoint-full-every", type=int, default=1,
+                   help="write a FULL checkpoint every K saves and "
+                        "cheap deltas (changed leaves only, checksum-"
+                        "chained to their base) in between; restore "
+                        "composes and verifies the chain, falling back "
+                        "to the last valid full on any broken link "
+                        "(1 = every save full)")
+    p.add_argument("--checkpoint-op-timeout", type=float, default=0.0,
+                   help="per-op timeout in seconds for object-store "
+                        "checkpoint PUT/GET/LIST (a hung call surfaces "
+                        "as a retryable transient instead of wedging "
+                        "the supervisor; 0 = wait indefinitely)")
+    p.add_argument("--checkpoint-op-attempts", type=int, default=3,
+                   help="retry attempts per object-store checkpoint op "
+                        "(original-typed error propagation after "
+                        "exhaustion; 1 = no retry)")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--max-batches", type=int, default=0)
     p.add_argument("--online-lr", type=float, default=0.0)
@@ -1839,6 +1930,21 @@ def main(argv=None) -> int:
     p.add_argument("--model-file", default="",
                    help="model artifact for --replay")
     p.set_defaults(fn=cmd_dlq, needs_backend=False)
+
+    p = sub.add_parser(
+        "ckpt",
+        help="inspect / verify the checkpoint lineage (durable state)")
+    p.add_argument("--path", required=True,
+                   help="checkpoint directory or s3:// prefix "
+                        "(the --checkpoint-dir of the serving run)")
+    p.add_argument("--verify", action="store_true",
+                   help="re-checksum every live checkpoint + delta "
+                        "chain; exit 1 on any corruption (deploy "
+                        "preflight)")
+    p.add_argument("--inspect", default="",
+                   help="dump one checkpoint's manifest (name or full "
+                        "path, e.g. ckpt-0000000004.npz)")
+    p.set_defaults(fn=cmd_ckpt, needs_backend=False)
 
     p = sub.add_parser("demo",
                        help="full E2E demo: datagen → CDC → sinks → scorer")

@@ -262,6 +262,19 @@ class RuntimeConfig:
     sink_queue_batches: int = 8
     checkpoint_dir: str = "checkpoints"
     checkpoint_every_batches: int = 50
+    # Incremental checkpoints: write a FULL snapshot every K saves and
+    # deltas (only the leaves whose bytes changed — feature state churns
+    # every batch, params/scaler are static between hot-reloads) in
+    # between, chained to their base by checksum. 1 = every save full
+    # (the v1 cost model). Restore composes full + verified chain and is
+    # bit-identical to a full restore or it falls back.
+    checkpoint_full_every: int = 1
+    # Flaky-store hardening for object-store checkpointers: per-op
+    # timeout in seconds (a hung S3 GET/PUT surfaces as a retryable
+    # transient instead of wedging the supervisor; 0 = wait) and retry
+    # attempts per op (1 = no retry).
+    checkpoint_op_timeout_s: float = 0.0
+    checkpoint_op_attempts: int = 3
     n_partitions: int = 8
     # Data-plane non-finite guard (engine host boundary): rows whose
     # score/feature vector crosses the boundary NaN/Inf are quarantined

@@ -1,23 +1,71 @@
-"""Atomic checkpoint/resume of the full streaming state.
+"""Verified, atomic checkpoint/resume of the full streaming state.
 
 The reference's recovery story is Spark's ``checkpointLocation`` (Kafka
 offsets + commit log per job, ``fraud_detection.py:63``) plus pickled model
 artifacts. Here ONE checkpoint captures everything the step function closes
 over — (source offsets, feature-state pytree, model params, scaler, batch
-counter) — written atomically (tmp file + rename) so a crash mid-write
-leaves the previous checkpoint intact. Restore rebuilds the exact pytree
-structure from a template, so replay resumes with identical state
-(exactly-once at micro-batch granularity: offsets and state are saved
+counter) — written atomically (tmp file + rename / atomic object PUT) so a
+crash mid-write leaves the previous checkpoint intact. Restore rebuilds the
+exact pytree structure from a template, so replay resumes with identical
+state (exactly-once at micro-batch granularity: offsets and state are saved
 together).
+
+Format v2 — trust nothing on restore
+------------------------------------
+A v1 checkpoint was trusted blindly: a torn write, a bit-flip, or a flaky
+GET either killed the stream or silently resurrected bad state. v2 embeds a
+**verified manifest** next to the arrays (``__manifest__`` npz entry):
+
+- a CRC32 per logical-state leaf (the npz arrays ``fs_i``/``p_i``/``s_i``);
+- a **structural fingerprint** (sha256 over every leaf's key/shape/dtype —
+  the materialized feature-spec + model-shape contract a restore template
+  must match);
+- the writer's **incarnation token** (which process wrote this lineage);
+- for **delta** checkpoints: the base entry's name and the CRC32 of the
+  base's manifest — the chain link that makes a delta restorable only
+  against the exact object it was built from.
+
+``restore()`` verifies checksums and structural compatibility and, on ANY
+mismatch, quarantines the corrupt checkpoint (the same ``stale-…`` stash
+the fresh-start fence uses) and **falls back down the lineage** to the
+newest valid entry — ``rtfds_checkpoint_corrupt_total{reason=checksum|
+truncated|incompatible}`` counts why, a ``checkpoint_fallback`` flight
+event records what was skipped, and the supervisor replays from the older
+fence instead of dying. v1 (pre-manifest) checkpoints still restore —
+existing deployments upgrade in place.
+
+Delta checkpoints — bounded save cost
+-------------------------------------
+With ``full_every=K > 1``, a full snapshot is written every K saves and the
+saves between carry only the leaves whose bytes changed since the previous
+save (params/scaler are static between hot-reloads; feature_state churns
+every batch). Restore composes newest-valid-full + the verified delta
+chain and re-checksums the COMPOSED state against the tip manifest, so a
+delta restore is bit-identical to a full one or it is rejected; any broken
+link falls back to the last valid full. ``rtfds_checkpoint_bytes{kind=
+full|delta}`` meters the save-size win.
+
+Flaky-store hardening
+---------------------
+``StoreCheckpointer`` ops (PUT/GET/LIST/DELETE/HEAD) run through
+:func:`~..runtime.faults.with_retries` with original-typed error
+propagation and an optional per-op timeout — a flaky S3 GET retries
+instead of killing the stream, and a hung one surfaces as a transient
+within the timeout instead of wedging the supervisor.
 """
 
 from __future__ import annotations
 
+import hashlib
 import io as _io
 import json
 import os
+import threading
 import time
-from typing import Optional
+import uuid
+import zipfile
+import zlib
+from typing import List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -27,9 +75,28 @@ from real_time_fraud_detection_system_tpu.utils.metrics import (
     get_registry,
 )
 
+CORRUPT_REASONS = ("checksum", "truncated", "incompatible")
+
+
+class CorruptCheckpointError(Exception):
+    """A checkpoint (or its delta chain) failed restore verification.
+
+    ``reason`` is one of :data:`CORRUPT_REASONS`: ``checksum`` (bytes
+    present but wrong — bit-flip, tampering, broken chain link),
+    ``truncated`` (bytes missing/unreadable — torn write, partial PUT,
+    missing base), ``incompatible`` (readable but structurally wrong for
+    the restore template — config/feature-spec drift).
+    """
+
+    def __init__(self, reason: str, detail: str = ""):
+        assert reason in CORRUPT_REASONS, reason
+        self.reason = reason
+        self.detail = detail
+        super().__init__(f"{reason}: {detail}" if detail else reason)
+
 
 def _observe_checkpoint(op: str, backend: str, t0: float, nbytes: int,
-                        batches_done: int) -> None:
+                        batches_done: int, kind: str = "full") -> None:
     """Shared save/restore instrumentation + the flight-record event a
     checkpoint IS (the exactly-once fence every replay reasons from)."""
     dt = time.perf_counter() - t0
@@ -42,15 +109,24 @@ def _observe_checkpoint(op: str, backend: str, t0: float, nbytes: int,
     if nbytes:
         reg.gauge("rtfds_checkpoint_bytes",
                   "size of the last checkpoint").set(nbytes)
+        reg.gauge("rtfds_checkpoint_bytes",
+                  "size of the last checkpoint", kind=kind).set(nbytes)
     rec = active_recorder()
     if rec is not None:
+        # NB: "kind" is the flight recorder's own record discriminator
         rec.record_event("checkpoint", op=op, batches_done=batches_done,
-                         bytes=nbytes, seconds=round(dt, 6))
+                         bytes=nbytes, seconds=round(dt, 6),
+                         ckpt_kind=kind)
 
 
-def write_state_npz(fileobj, engine_state) -> None:
-    """Stream an EngineState (or any object with feature_state/params/
-    scaler/offsets/batches_done/rows_done) as npz into a file object."""
+# ---------------------------------------------------------------------------
+# State (de)serialization
+# ---------------------------------------------------------------------------
+
+
+def _state_arrays(engine_state) -> Tuple[dict, dict]:
+    """Flatten an EngineState into the npz array dict + meta dict — the
+    ONE place the on-disk leaf naming (``fs_i``/``p_i``/``s_i``) lives."""
     leaves_fs, _ = jax.tree_util.tree_flatten(engine_state.feature_state)
     leaves_p, _ = jax.tree_util.tree_flatten(engine_state.params)
     leaves_s, _ = jax.tree_util.tree_flatten(engine_state.scaler)
@@ -73,30 +149,15 @@ def write_state_npz(fileobj, engine_state) -> None:
         "layout_devices": int(
             getattr(engine_state, "layout_devices", 1) or 1),
     }
-    np.savez(fileobj, __meta__=json.dumps(meta), **arrays)
+    return arrays, meta
 
 
-def state_to_bytes(engine_state) -> bytes:
-    """npz bytes of an EngineState (object-store PUT payload)."""
-    buf = _io.BytesIO()
-    write_state_npz(buf, engine_state)
-    return buf.getvalue()
-
-
-def bytes_to_state(data: bytes, engine_state):
-    """Restore npz bytes into an EngineState template (same shapes);
-    returns the mutated engine_state."""
-    return read_state_npz(_io.BytesIO(data), engine_state)
-
-
-def read_state_npz(fileobj, engine_state):
-    """Restore npz from a file object into an EngineState template —
-    streaming (np.load reads arrays directly; no whole-file bytes copy)."""
-    with np.load(fileobj, allow_pickle=False) as z:
-        meta = json.loads(str(z["__meta__"]))
-        fs_leaves = [z[f"fs_{i}"] for i in range(meta["n_fs"])]
-        p_leaves = [z[f"p_{i}"] for i in range(meta["n_p"])]
-        s_leaves = [z[f"s_{i}"] for i in range(meta["n_s"])]
+def _apply_arrays(engine_state, meta: dict, arrays: dict):
+    """Rebuild an EngineState template from the (composed) array dict —
+    the restore tail shared by v1 files and v2 full/delta chains."""
+    fs_leaves = [arrays[f"fs_{i}"] for i in range(meta["n_fs"])]
+    p_leaves = [arrays[f"p_{i}"] for i in range(meta["n_p"])]
+    s_leaves = [arrays[f"s_{i}"] for i in range(meta["n_s"])]
     _, fs_def = jax.tree_util.tree_flatten(engine_state.feature_state)
     _, p_def = jax.tree_util.tree_flatten(engine_state.params)
     _, s_def = jax.tree_util.tree_flatten(engine_state.scaler)
@@ -119,170 +180,744 @@ def read_state_npz(fileobj, engine_state):
     return engine_state
 
 
-class Checkpointer:
-    def __init__(self, directory: str, keep: int = 3):
+def write_state_npz(fileobj, engine_state) -> None:
+    """Stream an EngineState (or any object with feature_state/params/
+    scaler/offsets/batches_done/rows_done) as npz into a file object.
+
+    This is the RAW (v1-shaped) payload — no manifest — used for
+    in-memory snapshots (poison-isolation probes) and object-store PUT
+    bodies where the manifest is added by the checkpointer."""
+    arrays, meta = _state_arrays(engine_state)
+    np.savez(fileobj, __meta__=json.dumps(meta), **arrays)
+
+
+def state_to_bytes(engine_state) -> bytes:
+    """npz bytes of an EngineState (object-store PUT payload)."""
+    buf = _io.BytesIO()
+    write_state_npz(buf, engine_state)
+    return buf.getvalue()
+
+
+def bytes_to_state(data: bytes, engine_state):
+    """Restore npz bytes into an EngineState template (same shapes);
+    returns the mutated engine_state."""
+    return read_state_npz(_io.BytesIO(data), engine_state)
+
+
+def read_state_npz(fileobj, engine_state):
+    """Restore npz from a file object into an EngineState template —
+    streaming (np.load reads arrays directly; no whole-file bytes copy).
+    No verification: this is the trusting raw reader (snapshots, v1)."""
+    with np.load(fileobj, allow_pickle=False) as z:
+        meta = json.loads(str(z["__meta__"]))
+        arrays = {k: z[k] for k in z.files if k != "__meta__"
+                  and k != "__manifest__"}
+    return _apply_arrays(engine_state, meta, arrays)
+
+
+# ---------------------------------------------------------------------------
+# v2 manifest
+# ---------------------------------------------------------------------------
+
+
+def _crc(arr: np.ndarray) -> int:
+    # buffer-protocol view, not .tobytes(): no per-leaf bytes copy on
+    # the save path (feature state can be the bulk of host memory)
+    return zlib.crc32(memoryview(np.ascontiguousarray(arr)).cast("B"))
+
+
+def _spec_of_arrays(arrays: dict) -> dict:
+    return {k: [list(np.shape(a)), str(np.asarray(a).dtype)]
+            for k, a in sorted(arrays.items())}
+
+
+def _fingerprint(spec: dict) -> str:
+    """Structural fingerprint: sha256 over every leaf's key/shape/dtype.
+    This IS the materialized config/feature-spec contract — a window
+    count, capacity, model width, or dtype change all change it."""
+    blob = json.dumps(spec, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def _template_spec(engine_state) -> dict:
+    """Leaf spec of a restore template WITHOUT materializing device
+    arrays to host (shape/dtype attributes only)."""
+    out = {}
+    for prefix, tree in (("fs", engine_state.feature_state),
+                         ("p", engine_state.params),
+                         ("s", engine_state.scaler)):
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(tree)):
+            dt = getattr(leaf, "dtype", None)
+            if dt is None:
+                dt = np.asarray(leaf).dtype
+            out[f"{prefix}_{i}"] = [list(np.shape(leaf)), str(dt)]
+    return dict(sorted(out.items()))
+
+
+def _parse_entry(data: bytes):
+    """npz bytes → (meta, manifest|None, manifest_raw|None, arrays).
+
+    Raises :class:`CorruptCheckpointError` with reason ``truncated`` for
+    unreadable/partial bytes and ``checksum`` when the zip layer's own
+    entry CRC catches a bit-flip."""
+    try:
+        with np.load(_io.BytesIO(data), allow_pickle=False) as z:
+            files = set(z.files)
+            meta = json.loads(str(z["__meta__"]))
+            man_raw = (str(z["__manifest__"])
+                       if "__manifest__" in files else None)
+            arrays = {k: z[k] for k in files
+                      if k not in ("__meta__", "__manifest__")}
+    except zipfile.BadZipFile as e:
+        reason = "checksum" if "CRC-32" in str(e) else "truncated"
+        raise CorruptCheckpointError(reason, str(e)) from None
+    except (KeyError, EOFError, OSError, ValueError) as e:
+        raise CorruptCheckpointError(
+            "truncated", f"{type(e).__name__}: {e}") from None
+    manifest = None
+    if man_raw is not None:
+        try:
+            manifest = json.loads(man_raw)
+        except ValueError as e:
+            raise CorruptCheckpointError(
+                "truncated", f"manifest unparseable: {e}") from None
+    return meta, manifest, man_raw, arrays
+
+
+def _write_checkpoint_npz(fileobj, arrays: dict, meta: dict,
+                          manifest: dict) -> None:
+    """Stream the checkpoint npz into ``fileobj`` (np.savez writes one
+    zip entry per array — peak memory stays one leaf, not the whole
+    checkpoint)."""
+    np.savez(fileobj,
+             __meta__=json.dumps(meta),
+             __manifest__=json.dumps(manifest, sort_keys=True,
+                                     separators=(",", ":")),
+             **arrays)
+
+
+# ---------------------------------------------------------------------------
+# Storage backends
+# ---------------------------------------------------------------------------
+
+
+class _LocalBackend:
+    """Flat-directory file storage for the checkpoint lineage. Names are
+    bare filenames; the lineage API exposes full paths."""
+
+    kind = "local"
+
+    def __init__(self, directory: str):
         self.directory = directory
-        self.keep = keep
         os.makedirs(directory, exist_ok=True)
 
-    def _path(self, step: int) -> str:
-        return os.path.join(self.directory, f"ckpt-{step:010d}.npz")
+    def path_of(self, name: str) -> str:
+        return os.path.join(self.directory, name)
 
-    def save(self, engine_state) -> str:
-        t0 = time.perf_counter()
-        path = self._path(engine_state.batches_done)
+    def name_of(self, path: str) -> str:
+        return os.path.basename(path)
+
+    def read(self, name: str) -> bytes:
+        try:
+            with open(self.path_of(name), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise KeyError(name) from None
+
+    def write(self, name: str, data: bytes) -> None:
+        self.write_via(name, lambda f: f.write(data))
+
+    def write_via(self, name: str, writer) -> int:
+        """tmp-write + atomic rename around a streaming ``writer(f)``
+        callback; returns the committed byte size."""
+        path = self.path_of(name)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
-            write_state_npz(f, engine_state)  # streamed, no bytes copy
-        nbytes = os.path.getsize(tmp)
+            writer(f)
         os.replace(tmp, path)  # atomic on POSIX
-        self._gc()
-        _observe_checkpoint("save", "local", t0, nbytes,
-                            int(engine_state.batches_done))
-        return path
+        return os.path.getsize(path)
 
-    def list_checkpoints(self) -> list:
-        """Live checkpoint paths, oldest → newest (lineage API used by the
-        crash-recovery fence, ``runtime/faults._FencedCheckpointer``)."""
+    def delete(self, name: str) -> None:
+        try:
+            os.remove(self.path_of(name))
+        except FileNotFoundError:
+            pass
+
+    def move(self, name: str, new_name: str) -> None:
+        os.replace(self.path_of(name), self.path_of(new_name))
+
+    def exists(self, name: str) -> bool:
+        return os.path.exists(self.path_of(name))
+
+    def list_names(self) -> List[str]:
+        return sorted(os.listdir(self.directory))
+
+    def info(self, name: str) -> dict:
+        try:
+            st = os.stat(self.path_of(name))
+            return {"size": st.st_size, "mtime": st.st_mtime}
+        except OSError:
+            return {"size": None, "mtime": None}
+
+    def sweep_orphan_tmps(self) -> List[str]:
+        """Crash hygiene: a crash between the tmp write and os.replace
+        leaks ``ckpt-*.npz.tmp`` forever — remove them at construction
+        (they are by definition not part of the committed lineage)."""
+        swept = []
+        for f in self.list_names():
+            if f.startswith("ckpt-") and f.endswith(".tmp"):
+                self.delete(f)
+                swept.append(f)
+        return swept
+
+
+class _StoreBackend:
+    """Object-store storage with flaky-store hardening: every op runs
+    through ``with_retries`` (original-typed error propagation — a
+    KeyError for a missing key is NOT retried) and an optional per-op
+    timeout that surfaces a hung call as a transient within the budget
+    instead of wedging the caller. Object PUTs are atomic, so no
+    tmp+rename dance is needed."""
+
+    kind = "store"
+
+    def __init__(self, store, prefix: str, op_timeout_s: float = 0.0,
+                 op_attempts: int = 3):
+        self.store = store
+        self.prefix = prefix.strip("/")
+        self.op_timeout_s = float(op_timeout_s)
+        self.op_attempts = max(1, int(op_attempts))
+
+    def _retrying(self, fn):
+        from real_time_fraud_detection_system_tpu.runtime.faults import (
+            RetryPolicy,
+            TransientError,
+            with_retries,
+        )
+
+        def attempt():
+            if self.op_timeout_s <= 0:
+                return fn()
+            box: dict = {}
+
+            def run():
+                try:
+                    box["v"] = fn()
+                except BaseException as e:  # reported to the caller thread
+                    box["e"] = e
+
+            t = threading.Thread(target=run, daemon=True,
+                                 name="ckpt-store-op")
+            t.start()
+            t.join(self.op_timeout_s)
+            if t.is_alive():
+                # the op keeps running in its abandoned thread — the
+                # retry opens a fresh attempt rather than waiting forever
+                raise TransientError(
+                    f"store op timed out after {self.op_timeout_s:.1f}s")
+            if "e" in box:
+                raise box["e"]
+            return box.get("v")
+
+        return with_retries(
+            attempt,
+            RetryPolicy(max_attempts=self.op_attempts, base_delay_s=0.1,
+                        multiplier=2.0, max_delay_s=2.0),
+            retry_on=(TransientError, ConnectionError, TimeoutError,
+                      OSError),
+        )
+
+    def path_of(self, name: str) -> str:
+        return f"{self.prefix}/{name}" if self.prefix else name
+
+    def name_of(self, path: str) -> str:
+        pre = self.prefix + "/" if self.prefix else ""
+        return path[len(pre):] if path.startswith(pre) else path
+
+    def read(self, name: str) -> bytes:
+        return self._retrying(lambda: self.store.get(self.path_of(name)))
+
+    def write(self, name: str, data: bytes) -> None:
+        self._retrying(lambda: self.store.put(self.path_of(name), data))
+
+    def write_via(self, name: str, writer) -> int:
+        # an object PUT needs the whole body up front, so the store
+        # plane buffers; only the local plane gets true streaming
+        buf = _io.BytesIO()
+        writer(buf)
+        data = buf.getvalue()
+        self.write(name, data)
+        return len(data)
+
+    def delete(self, name: str) -> None:
+        self._retrying(lambda: self.store.delete(self.path_of(name)))
+
+    def move(self, name: str, new_name: str) -> None:
+        src, dst = self.path_of(name), self.path_of(new_name)
+        move = getattr(self.store, "move", None)
+        if move is not None:
+            self._retrying(lambda: move(src, dst))
+        else:  # duck-typed store without move: copy-then-delete
+            data = self._retrying(lambda: self.store.get(src))
+            self._retrying(lambda: self.store.put(dst, data))
+            self._retrying(lambda: self.store.delete(src))
+
+    def exists(self, name: str) -> bool:
+        return self._retrying(
+            lambda: self.store.exists(self.path_of(name)))
+
+    def list_names(self) -> List[str]:
+        pre = self.prefix + "/" if self.prefix else ""
+        keys = self._retrying(lambda: self.store.list(pre))
+        # Flat-directory semantics (matching _LocalBackend's listdir):
+        # keys nested deeper under the prefix belong to OTHER lineages
+        # (e.g. a sibling job's prefix) and must not be GC'd/restored.
+        return sorted(k[len(pre):] for k in keys
+                      if "/" not in k[len(pre):])
+
+    def info(self, name: str) -> dict:
+        head = getattr(self.store, "head", None)
+        if head is None:
+            return {"size": None, "mtime": None}
+        try:
+            h = self._retrying(lambda: head(self.path_of(name)))
+        except KeyError:
+            return {"size": None, "mtime": None}
+        mtime = None
+        etag = str(h.get("etag", ""))
+        if etag.isdigit():  # LocalStore etag = mtime_ns
+            mtime = int(etag) / 1e9
+        return {"size": h.get("size"), "mtime": mtime}
+
+
+# ---------------------------------------------------------------------------
+# Checkpointers
+# ---------------------------------------------------------------------------
+
+
+class _CheckpointerBase:
+    """Shared lineage logic over a storage backend: v2 manifests, delta
+    chains, verified restore with quarantine + fallback, chain-aware
+    retention GC. Subclasses bind the backend and keep their historical
+    constructor signatures."""
+
+    def __init__(self, backend, keep: int = 3, full_every: int = 1):
+        self._backend = backend
+        self.keep = keep
+        self.full_every = max(1, int(full_every))
+        self.incarnation = uuid.uuid4().hex[:12]
+        # (name, manifest_raw, manifest) of the last save THIS writer
+        # made — the delta base. A fresh process always starts full.
+        self._last: Optional[Tuple[str, str, dict]] = None
+        self._since_full = 0
+        self._manifest_cache: dict = {}
+
+    # -- lineage API ------------------------------------------------------
+
+    def _live_names(self) -> List[str]:
         return [
-            os.path.join(self.directory, f)
-            for f in sorted(os.listdir(self.directory))
+            f for f in self._backend.list_names()
             if f.startswith("ckpt-") and f.endswith(".npz")
+            and ".tmp" not in f
         ]
 
-    def exists(self, path: str) -> bool:
-        return os.path.exists(path)
-
-    def quarantine(self, paths, token: str) -> None:
-        """Hide a previous run's lineage from ``latest()``/GC: rename to
-        ``stale-<token>-…`` (bytes preserved). Clears any earlier stash
-        first so repeated fresh runs keep one quarantine, not a pile."""
-        for old in os.listdir(self.directory):
-            if old.startswith("stale-") and old.endswith(".npz"):
-                os.remove(os.path.join(self.directory, old))
-        for p in paths:
-            if os.path.exists(p):
-                d, f = os.path.split(p)
-                os.replace(p, os.path.join(d, f"stale-{token}-{f}"))
+    def list_checkpoints(self) -> list:
+        """Live checkpoint paths, oldest → newest (lineage API used by
+        the crash-recovery fence, ``runtime/faults._FencedCheckpointer``)."""
+        return [self._backend.path_of(n) for n in self._live_names()]
 
     def latest(self) -> Optional[str]:
         ckpts = self.list_checkpoints()
         return ckpts[-1] if ckpts else None
 
-    def restore(self, engine_state, path: Optional[str] = None):
-        """Restore into an EngineState template (same model/config shapes).
+    def exists(self, path: str) -> bool:
+        return self._backend.exists(self._backend.name_of(path))
 
-        Returns the mutated engine_state, or None if no checkpoint exists.
-        """
-        path = path or self.latest()
-        if path is None:
-            return None
-        t0 = time.perf_counter()
-        nbytes = os.path.getsize(path)
-        with open(path, "rb") as f:
-            out = read_state_npz(f, engine_state)
-        _observe_checkpoint("restore", "local", t0, nbytes,
-                            int(out.batches_done))
-        return out
+    def quarantine(self, paths, token: str,
+                   clear_previous: bool = True) -> None:
+        """Hide checkpoints from ``latest()``/GC: rename to
+        ``stale-<token>-…`` (bytes preserved — forensics, not deletion).
+        The fresh-start fence clears any earlier stash first so repeated
+        fresh runs keep one quarantine, not a pile; the corruption path
+        passes ``clear_previous=False`` so a fallback cascade never
+        destroys the evidence it just stashed."""
+        if clear_previous:
+            for old in self._backend.list_names():
+                if old.startswith("stale-") and old.endswith(".npz"):
+                    self._backend.delete(old)
+        for p in paths:
+            name = self._backend.name_of(p)
+            if self._backend.exists(name):
+                self._backend.move(name, f"stale-{token}-{name}")
+            self._manifest_cache.pop(name, None)
+            if self._last is not None and self._last[0] == name:
+                # the writer's delta base just left the lineage — the
+                # next save must be a full, never a delta chained to a
+                # quarantined entry
+                self._last = None
 
-    def _gc(self) -> None:
-        for p in self.list_checkpoints()[: -self.keep]:
-            os.remove(p)
-
-
-class StoreCheckpointer:
-    """Checkpointer over an object store — the reference's
-    ``checkpointLocation`` on s3a (``fraud_detection.py:63``,
-    ``kafka_s3_sink_*.py:11``): streaming state durable in MinIO/S3, not
-    on an ephemeral host disk. Object PUTs are atomic, so no tmp+rename
-    dance is needed. Same save/restore/latest contract as
-    :class:`Checkpointer`; ``store`` is any :mod:`..io.store` object.
-    """
-
-    def __init__(self, store, prefix: str = "checkpoints", keep: int = 3):
-        self.store = store
-        self.prefix = prefix.strip("/")
-        self.keep = keep
-
-    def _key(self, step: int) -> str:
-        name = f"ckpt-{step:010d}.npz"
-        return f"{self.prefix}/{name}" if self.prefix else name
-
-    def _list(self):
-        # Flat-directory semantics (matching Checkpointer's os.listdir):
-        # keys nested deeper under the prefix belong to OTHER lineages
-        # (e.g. a sibling job's prefix) and must not be GC'd/restored.
-        pre = self.prefix + "/" if self.prefix else ""
-        return [
-            k for k in self.store.list(pre)
-            if k[len(pre):].startswith("ckpt-") and k.endswith(".npz")
-            and "/" not in k[len(pre):]
-        ]
+    # -- save -------------------------------------------------------------
 
     def save(self, engine_state) -> str:
         t0 = time.perf_counter()
-        key = self._key(engine_state.batches_done)
-        data = state_to_bytes(engine_state)
-        self.store.put(key, data)
-        for old in sorted(self._list())[: -self.keep]:
-            self.store.delete(old)
-        _observe_checkpoint("save", "store", t0, len(data),
-                            int(engine_state.batches_done))
-        return key
+        arrays, meta = _state_arrays(engine_state)
+        crcs = {k: _crc(a) for k, a in arrays.items()}
+        spec = _spec_of_arrays(arrays)
+        fp = _fingerprint(spec)
+        step = meta["batches_done"]
+        kind = "full"
+        name = f"ckpt-{step:010d}.npz"
+        stored = arrays
+        base = base_crc = None
+        if (self.full_every > 1 and self._last is not None
+                and self._since_full + 1 < self.full_every):
+            last_name, last_raw, last_man = self._last
+            dname = f"ckpt-{step:010d}-delta.npz"
+            if (last_man.get("fingerprint") == fp
+                    and dname != last_name
+                    and not self._backend.exists(dname)
+                    # the base may have been quarantined/GC'd since the
+                    # writer last saw it (fallback restore in the same
+                    # process); chaining to a gone base would make every
+                    # later delta unrestorable until the next full
+                    and self._backend.exists(last_name)):
+                kind = "delta"
+                name = dname
+                base = last_name
+                base_crc = zlib.crc32(last_raw.encode())
+                last_crcs = last_man.get("crcs", {})
+                stored = {k: a for k, a in arrays.items()
+                          if crcs[k] != last_crcs.get(k)}
+        manifest = {
+            "format": 2,
+            "kind": kind,
+            "incarnation": self.incarnation,
+            "batches_done": step,
+            "fingerprint": fp,
+            "spec": spec,
+            "crcs": crcs,
+            "stored": sorted(stored),
+            "base": base,
+            "base_manifest_crc": base_crc,
+        }
+        nbytes = self._backend.write_via(
+            name, lambda f: _write_checkpoint_npz(f, stored, meta,
+                                                  manifest))
+        man_raw = json.dumps(manifest, sort_keys=True,
+                             separators=(",", ":"))
+        self._last = (name, man_raw, manifest)
+        self._since_full = 0 if kind == "full" else self._since_full + 1
+        self._manifest_cache[name] = manifest
+        self._gc()
+        reg = get_registry()
+        reg.gauge("rtfds_last_checkpoint_unix_seconds",
+                  "wall-clock time of the last checkpoint save").set(
+            time.time())
+        reg.gauge("rtfds_checkpoint_lineage_depth",
+                  "live checkpoints in the lineage").set(
+            len(self._live_names()))
+        # a fresh save supersedes any fallback restore: the durable
+        # plane is healthy again (healthz drops "degraded")
+        reg.gauge("rtfds_checkpoint_serving_fallback",
+                  "1 while the engine serves off a fallback (non-newest) "
+                  "checkpoint restore").set(0)
+        _observe_checkpoint("save", self._backend.kind, t0, nbytes,
+                            step, kind=kind)
+        return self._backend.path_of(name)
 
-    def list_checkpoints(self) -> list:
-        return sorted(self._list())
+    # -- restore ----------------------------------------------------------
 
-    def exists(self, key: str) -> bool:
-        return self.store.exists(key)
+    def _manifest_of(self, name: str) -> Optional[dict]:
+        man = self._manifest_cache.get(name)
+        if man is not None:
+            return man
+        try:
+            _, man, _, _ = _parse_entry(self._backend.read(name))
+        except (KeyError, CorruptCheckpointError):
+            return None
+        if man is not None:
+            self._manifest_cache[name] = man
+        return man
 
-    def quarantine(self, keys, token: str) -> None:
-        """Hide a previous run's lineage (fresh-start fence): move keys to
-        ``stale-<token>-…`` names, invisible to ``_list``'s ``ckpt-``
-        filter — so this run's retention GC can't be tricked into deleting
-        its own saves by stale higher-numbered checkpoints, and
-        ``latest()`` never resurrects them. Clears earlier stashes first;
-        live bytes are moved (server-side copy on S3), never deleted
-        before the copy lands."""
-        pre = self.prefix + "/" if self.prefix else ""
-        for k in self.store.list(pre):
-            name = k[len(pre):]
-            if name.startswith("stale-") and "/" not in name:
-                self.store.delete(k)
-        for k in keys:
-            if not self.store.exists(k):
-                continue
-            head, _, name = k.rpartition("/")
-            stale = (f"{head}/" if head else "") + f"stale-{token}-{name}"
-            move = getattr(self.store, "move", None)
-            if move is not None:
-                move(k, stale)
-            else:  # duck-typed store without move: copy-then-delete
-                self.store.put(stale, self.store.get(k))
-                self.store.delete(k)
+    def _resolve_chain(self, name: str, template=None) -> Tuple[dict, dict]:
+        """Load + verify the checkpoint at ``name`` (following its delta
+        chain) → (meta, composed arrays). Raises
+        :class:`CorruptCheckpointError` on any broken invariant."""
+        entries = []  # tip-first: (name, meta, manifest, arrays)
+        seen = set()
+        cur: Optional[str] = name
+        expect_crc: Optional[int] = None
+        while cur is not None:
+            if cur in seen:
+                raise CorruptCheckpointError(
+                    "checksum", f"delta chain cycle at {cur}")
+            seen.add(cur)
+            try:
+                data = self._backend.read(cur)
+            except KeyError:
+                raise CorruptCheckpointError(
+                    "truncated", f"chain entry {cur} is missing") from None
+            meta, man, man_raw, arrays = _parse_entry(data)
+            if expect_crc is not None:
+                if man_raw is None or zlib.crc32(
+                        man_raw.encode()) != expect_crc:
+                    raise CorruptCheckpointError(
+                        "checksum",
+                        f"chain link mismatch: {cur} is not the base its "
+                        f"delta was built from")
+            entries.append((cur, meta, man, arrays))
+            if man is not None and man.get("kind") == "delta":
+                base = man.get("base")
+                if not base:
+                    raise CorruptCheckpointError(
+                        "truncated", f"delta {cur} names no base")
+                expect_crc = man.get("base_manifest_crc")
+                cur = base
+            else:
+                cur = None
+        tip_name, tip_meta, tip_man, _ = entries[0]
+        # compose oldest → newest: the full provides every leaf, deltas
+        # overlay the leaves they stored
+        composed: dict = {}
+        for _, _, _, arrays in reversed(entries):
+            composed.update(arrays)
+        if tip_man is not None:
+            crcs = tip_man.get("crcs", {})
+            missing = [k for k in crcs if k not in composed]
+            if missing:
+                raise CorruptCheckpointError(
+                    "truncated",
+                    f"composed state is missing leaves {missing[:4]}")
+            for k, want in crcs.items():
+                if _crc(composed[k]) != int(want):
+                    raise CorruptCheckpointError(
+                        "checksum", f"leaf {k} fails its manifest CRC32")
+        if template is not None:
+            self._check_template(tip_name, tip_meta, tip_man, composed,
+                                 template)
+        return tip_meta, composed
 
-    def latest(self) -> Optional[str]:
-        keys = sorted(self._list())
-        return keys[-1] if keys else None
+    @staticmethod
+    def _check_template(name, meta, manifest, arrays, template) -> None:
+        """Structural compatibility vs the restore template: leaf counts
+        and shapes always; dtypes + the config/feature-spec fingerprint
+        for v2 entries (v1 keeps its historical trusting shape check)."""
+        spec = _template_spec(template)
+        n_fs = sum(1 for k in spec if k.startswith("fs_"))
+        n_p = sum(1 for k in spec if k.startswith("p_"))
+        n_s = sum(1 for k in spec if k.startswith("s_"))
+        if (meta.get("n_fs"), meta.get("n_p"), meta.get("n_s")) != (
+                n_fs, n_p, n_s):
+            raise CorruptCheckpointError(
+                "incompatible",
+                f"{name}: leaf counts {meta.get('n_fs')}/{meta.get('n_p')}"
+                f"/{meta.get('n_s')} vs template {n_fs}/{n_p}/{n_s}")
+        for k, (shape, dtype) in spec.items():
+            a = arrays.get(k)
+            if a is None:
+                raise CorruptCheckpointError(
+                    "truncated", f"{name}: leaf {k} absent")
+            if list(np.shape(a)) != list(shape):
+                raise CorruptCheckpointError(
+                    "incompatible",
+                    f"{name}: leaf {k} shape {list(np.shape(a))} vs "
+                    f"template {list(shape)}")
+            if manifest is not None and str(a.dtype) != str(dtype):
+                raise CorruptCheckpointError(
+                    "incompatible",
+                    f"{name}: leaf {k} dtype {a.dtype} vs template "
+                    f"{dtype}")
+
+    def _note_corrupt(self, name: str, err: CorruptCheckpointError) -> None:
+        reg = get_registry()
+        reg.counter(
+            "rtfds_checkpoint_corrupt_total",
+            "checkpoints that failed restore verification, by reason",
+            reason=err.reason).inc()
+        rec = active_recorder()
+        if rec is not None:
+            rec.record_event("checkpoint_fallback", path=name,
+                             reason=err.reason, detail=err.detail[:200])
+        from real_time_fraud_detection_system_tpu.utils.logging import (
+            get_logger,
+        )
+
+        get_logger("checkpoint").error(
+            "corrupt checkpoint %s (%s: %s) — quarantining and falling "
+            "back down the lineage", name, err.reason, err.detail[:200])
+        self.quarantine([self._backend.path_of(name)],
+                        uuid.uuid4().hex[:8], clear_previous=False)
 
     def restore(self, engine_state, path: Optional[str] = None):
-        key = path or self.latest()
-        if key is None:
+        """Restore into an EngineState template (same model/config
+        shapes). Verifies the manifest (checksums + structural
+        compatibility + delta chain) and, on any mismatch, quarantines
+        the corrupt entry and falls back to the next-newest valid one.
+
+        Returns the mutated engine_state, or None when no (valid)
+        checkpoint exists.
+        """
+        names = self._live_names()
+        if path is not None:
+            want = self._backend.name_of(path)
+            names = [n for n in names if n <= want]
+            if want not in names and self._backend.exists(want):
+                names.append(want)
+        if not names:
             return None
-        t0 = time.perf_counter()
-        data = self.store.get(key)
-        out = bytes_to_state(data, engine_state)
-        _observe_checkpoint("restore", "store", t0, len(data),
-                            int(out.batches_done))
+        tip = names[-1]
+        corrupt = 0
+        for n in reversed(names):
+            t0 = time.perf_counter()
+            try:
+                meta, arrays = self._resolve_chain(n, template=engine_state)
+            except CorruptCheckpointError as e:
+                corrupt += 1
+                self._note_corrupt(n, e)
+                continue
+            out = _apply_arrays(engine_state, meta, arrays)
+            nbytes = sum(a.nbytes for a in arrays.values())
+            _observe_checkpoint("restore", self._backend.kind, t0, nbytes,
+                                int(out.batches_done))
+            if corrupt:
+                reg = get_registry()
+                reg.counter(
+                    "rtfds_checkpoint_fallbacks_total",
+                    "restores that fell back past corrupt checkpoints"
+                ).inc()
+                reg.gauge(
+                    "rtfds_checkpoint_serving_fallback",
+                    "1 while the engine serves off a fallback "
+                    "(non-newest) checkpoint restore").set(1)
+                rec = active_recorder()
+                if rec is not None:
+                    rec.record_event(
+                        "checkpoint_fallback", restored=n, skipped=corrupt,
+                        from_tip=tip,
+                        batches_done=int(out.batches_done))
+            return out
+        return None  # every lineage entry failed verification
+
+    # -- verification (CLI preflight) -------------------------------------
+
+    def verify_all(self, deep: bool = True) -> List[dict]:
+        """Report on every live checkpoint WITHOUT quarantining or
+        counting metrics. ``deep=True`` re-checksums each entry AND its
+        composed delta chain (the deploy preflight behind ``rtfds ckpt
+        --verify``: O(chain) reads per tip). ``deep=False`` is the cheap
+        listing verdict: one read per entry — the zip layer's own entry
+        CRCs still catch bit-flips in the entry itself, but a broken
+        chain link only surfaces under ``deep``."""
+        now = time.time()
+        out = []
+        for n in self._live_names():
+            info = self._backend.info(n)
+            entry = {
+                "path": self._backend.path_of(n),
+                "size": info.get("size"),
+                "age_s": (round(now - info["mtime"], 1)
+                          if info.get("mtime") else None),
+            }
+            try:
+                meta, man, _, _ = _parse_entry(self._backend.read(n))
+                entry["kind"] = (man.get("kind", "full") if man else "v1")
+                entry["batches_done"] = meta.get("batches_done")
+                entry["incarnation"] = (man or {}).get("incarnation")
+                if deep:
+                    self._resolve_chain(n)
+                entry["valid"] = True
+            except CorruptCheckpointError as e:
+                entry["valid"] = False
+                entry["reason"] = e.reason
+                entry["detail"] = e.detail[:200]
+            except KeyError:
+                entry["valid"] = False
+                entry["reason"] = "truncated"
+                entry["detail"] = "entry vanished mid-verify"
+            out.append(entry)
         return out
 
+    def manifest(self, path: str) -> dict:
+        """Meta + manifest of one checkpoint (``rtfds ckpt --inspect``).
+        v1 entries return their meta under ``{"format": 1}``."""
+        name = self._backend.name_of(path)
+        meta, man, _, _ = _parse_entry(self._backend.read(name))
+        if man is None:
+            return {"format": 1, "meta": meta}
+        return {**man, "meta": meta}
 
-def make_checkpointer(path_or_url: str, keep: int = 3):
+    # -- retention --------------------------------------------------------
+
+    def _gc(self) -> None:
+        names = self._live_names()
+        if len(names) <= self.keep:
+            return
+        keep_set = set(names[-self.keep:])
+        # chain-aware: never GC a base some kept delta still composes
+        # from — deleting it would break every restore of that delta
+        frontier = list(keep_set)
+        live = set(names)
+        while frontier:
+            n = frontier.pop()
+            man = self._manifest_of(n)
+            base = (man or {}).get("base") if (man or {}).get(
+                "kind") == "delta" else None
+            if base and base in live and base not in keep_set:
+                keep_set.add(base)
+                frontier.append(base)
+        for n in names:
+            if n not in keep_set:
+                self._backend.delete(n)
+                self._manifest_cache.pop(n, None)
+
+
+class Checkpointer(_CheckpointerBase):
+    """Filesystem checkpointer (tmp write + atomic rename). Construction
+    sweeps ``ckpt-*.npz.tmp`` orphans a crash between the tmp write and
+    ``os.replace`` would otherwise leak forever."""
+
+    def __init__(self, directory: str, keep: int = 3, full_every: int = 1):
+        self.directory = directory
+        super().__init__(_LocalBackend(directory), keep=keep,
+                         full_every=full_every)
+        self._backend.sweep_orphan_tmps()
+
+
+class StoreCheckpointer(_CheckpointerBase):
+    """Checkpointer over an object store — the reference's
+    ``checkpointLocation`` on s3a (``fraud_detection.py:63``,
+    ``kafka_s3_sink_*.py:11``): streaming state durable in MinIO/S3, not
+    on an ephemeral host disk. Object PUTs are atomic. Same
+    save/restore/latest contract as :class:`Checkpointer`; ``store`` is
+    any :mod:`..io.store` object. Store ops are hardened: retried with
+    original-typed error propagation, optional per-op timeout
+    (``op_timeout_s``; 0 = wait)."""
+
+    def __init__(self, store, prefix: str = "checkpoints", keep: int = 3,
+                 full_every: int = 1, op_timeout_s: float = 0.0,
+                 op_attempts: int = 3):
+        self.store = store
+        self.prefix = prefix.strip("/")
+        super().__init__(
+            _StoreBackend(store, prefix, op_timeout_s=op_timeout_s,
+                          op_attempts=op_attempts),
+            keep=keep, full_every=full_every)
+
+    def _list(self):
+        """Historical internal API (tests + retention introspection):
+        live checkpoint KEYS under the prefix."""
+        return [self._backend.path_of(n) for n in self._live_names()]
+
+
+def make_checkpointer(path_or_url: str, keep: int = 3, full_every: int = 1,
+                      op_timeout_s: float = 0.0, op_attempts: int = 3):
     """``s3://bucket/prefix`` → :class:`StoreCheckpointer`; local path →
     :class:`Checkpointer`."""
     if path_or_url.startswith("s3://"):
         from real_time_fraud_detection_system_tpu.io.store import make_store
 
         return StoreCheckpointer(make_store(path_or_url), prefix="",
-                                 keep=keep)
-    return Checkpointer(path_or_url, keep=keep)
+                                 keep=keep, full_every=full_every,
+                                 op_timeout_s=op_timeout_s,
+                                 op_attempts=op_attempts)
+    return Checkpointer(path_or_url, keep=keep, full_every=full_every)

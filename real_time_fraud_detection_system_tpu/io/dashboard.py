@@ -449,7 +449,7 @@ _OPS_PHASES = ("source_poll", "host_prep", "dispatch", "result_wait",
 
 _EVENT_CLASS = {"fault": "serious", "restart": "serious",
                 "poison": "serious", "dead_letter": "serious",
-                "gave_up": "serious",
+                "gave_up": "serious", "checkpoint_fallback": "serious",
                 "checkpoint": "info", "feedback": "good"}
 
 
@@ -583,6 +583,21 @@ def render_ops_html(
             1 for e in events if e.get("event") == "checkpoint"
             and e.get("op") == "save")), ""),
     ]
+    # Durable-state tile: corrupt checkpoints stepped over on restore.
+    # A clean run earns a quiet "verified" tile; any fallback paints the
+    # count of quarantined entries plus what finally served.
+    ck_fallbacks = [e for e in events
+                    if e.get("event") == "checkpoint_fallback"]
+    n_quarantined = sum(1 for e in ck_fallbacks if e.get("path"))
+    restored = [e for e in ck_fallbacks if e.get("restored")]
+    if ck_fallbacks:
+        sub = (f"restored {restored[-1]['restored']}"
+               if restored else "no valid checkpoint survived")
+        tiles.append(("Durable state",
+                      f"{_compact(n_quarantined)} corrupt", sub))
+    else:
+        tiles.append(("Durable state", "verified",
+                      "restores re-checksummed, no fallback"))
     tile_html = []
     for label, value, sub in tiles:
         subdiv = f"<div class='sub'>{_esc(sub)}</div>" if sub else ""

@@ -15,12 +15,14 @@ from real_time_fraud_detection_system_tpu.runtime.sharded_engine import (  # noq
 )
 from real_time_fraud_detection_system_tpu.runtime.faults import (  # noqa: F401
     FlakySource,
+    FlakyStore,
     HangingSource,
     Heartbeat,
     PoisonRowError,
     PoisonSource,
     RetryPolicy,
     StallError,
+    TornStore,
     TransientError,
     corrupt_messages,
     poison_messages,

@@ -1092,9 +1092,21 @@ class ScoringEngine:
             loss = self._loss
 
             def fb(params, scaler, x_raw, y, valid, lr):
+                # Backtracking step: the raw serving features can carry
+                # large magnitudes (amounts in cents), so a fixed lr can
+                # OVERSHOOT — one step that makes the loss worse, and
+                # re-deliveries would compound it. Returning the loss at
+                # both ends lets the host halve lr until the step
+                # CONTRACTS (classic Armijo-style backtracking); a step
+                # that cannot contract is skipped entirely, so the
+                # feedback loop is monotone non-increasing by
+                # construction.
                 x = transform(scaler, x_raw)
+                l0 = loss(params, x, y, valid)
                 g = jax.grad(loss)(params, x, y, valid)
-                return jax.tree.map(lambda p, gi: p - lr * gi, params, g)
+                new = jax.tree.map(lambda p, gi: p - lr * gi, params, g)
+                l1 = loss(new, x, y, valid)
+                return new, l0, l1
 
             self._feedback_step = jax.jit(fb)
         labels = np.asarray(labels)
@@ -1118,11 +1130,20 @@ class ScoringEngine:
             valid[:n] = lab >= 0
             if not valid.any():
                 continue
-            self.state.params = self._feedback_step(
-                self.state.params, self.state.scaler,
-                jnp.asarray(x), jnp.asarray(y), jnp.asarray(valid),
-                jnp.float32(lr),
-            )
+            jx, jy, jv = jnp.asarray(x), jnp.asarray(y), jnp.asarray(valid)
+            step_lr = float(lr)
+            for _ in range(8):  # halvings; lr is a traced arg: no retrace
+                new_params, l0, l1 = self._feedback_step(
+                    self.state.params, self.state.scaler, jx, jy, jv,
+                    jnp.float32(step_lr),
+                )
+                if bool(l1 <= l0):
+                    self.state.params = new_params
+                    break
+                step_lr *= 0.5
+            # 8 failed halvings: the chunk cannot contract from here
+            # (already at a minimum for these labels) — skip it rather
+            # than apply a step that provably makes the model worse
 
     def run(
         self,

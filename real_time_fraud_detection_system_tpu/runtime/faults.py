@@ -308,6 +308,79 @@ class PoisonSource:
             close()
 
 
+class FlakyStore:
+    """Wraps an :mod:`..io.store` object; scripted PUT/GET op indices
+    raise ``ConnectionError`` instead of touching the store.
+
+    The durable-state twin of :class:`FlakySource`: a checkpoint save or
+    restore that hits a scripted failure looks exactly like a flaky
+    S3/MinIO endpoint (same exception family the hardened
+    ``StoreCheckpointer`` retries on), and the underlying store is only
+    touched on success — so a retried op performs the work the failure
+    swallowed, never half of it. ``fail_puts``/``fail_gets`` are 0-based
+    per-verb op indices.
+    """
+
+    def __init__(self, inner, fail_puts: Sequence[int] = (),
+                 fail_gets: Sequence[int] = ()):
+        self.inner = inner
+        self.fail_puts = set(int(i) for i in fail_puts)
+        self.fail_gets = set(int(i) for i in fail_gets)
+        self._puts = 0
+        self._gets = 0
+
+    def put(self, key: str, data: bytes) -> None:
+        i = self._puts
+        self._puts += 1
+        if i in self.fail_puts:
+            _record_fault("flaky_store_put", op=i, key=key)
+            raise ConnectionError(f"injected store PUT failure #{i}")
+        self.inner.put(key, data)
+
+    def get(self, key: str) -> bytes:
+        i = self._gets
+        self._gets += 1
+        if i in self.fail_gets:
+            _record_fault("flaky_store_get", op=i, key=key)
+            raise ConnectionError(f"injected store GET failure #{i}")
+        return self.inner.get(key)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+class TornStore:
+    """Wraps a store; the scripted PUT lands TRUNCATED — and succeeds.
+
+    The torn-write injector: unlike :class:`FlakyStore` (whose failures
+    the caller can see and retry), a torn PUT reports success while
+    storing only the first ``keep_bytes`` of the payload — the
+    silent-partial-write failure mode only restore-time verification
+    (checkpoint format v2 manifests) can catch. ``tear_at`` is the
+    0-based PUT op index to tear; every other op passes through.
+    """
+
+    def __init__(self, inner, tear_at: int = 0, keep_bytes: int = 64):
+        self.inner = inner
+        self.tear_at = int(tear_at)
+        self.keep_bytes = int(keep_bytes)
+        self._puts = 0
+
+    def put(self, key: str, data: bytes) -> None:
+        i = self._puts
+        self._puts += 1
+        if i == self.tear_at:
+            _record_fault("torn_store_put", op=i, key=key,
+                          kept=min(self.keep_bytes, len(data)),
+                          dropped=max(0, len(data) - self.keep_bytes))
+            self.inner.put(key, data[: self.keep_bytes])
+            return  # reports success: the tear is silent by design
+        self.inner.put(key, data)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
 def poison_messages(msgs: Sequence[bytes],
                     poison_at: Sequence[int] = ()) -> list:
     """Envelope-level poison injection: re-encode scripted messages with

@@ -583,13 +583,27 @@ class MetricsServer:
         elif lag is not None:
             checks["source_lag_rows"] = {"value": lag.value, "ok": True,
                                          "note": "no threshold set"}
+        # Durable-state plane: age of the last checkpoint save, lineage
+        # depth, and corruption/fallback counters — present only once
+        # the serving loop checkpoints, so a checkpoint-less run's body
+        # stays clean.
+        last_ck = self.registry.get("rtfds_last_checkpoint_unix_seconds")
+        if last_ck is not None and last_ck.value > 0:
+            checks["last_checkpoint_age_s"] = {
+                "value": round(time.time() - last_ck.value, 3), "ok": True}
         # Failure-handling counters (degraded-but-alive serving): present
         # only once their families exist, so a clean run's body stays
         # clean.
         extras: Dict[str, float] = {}
         for fam, key in (("rtfds_engine_restarts_total", "restarts"),
                          ("rtfds_crash_loops_total", "crash_loops"),
-                         ("rtfds_dead_letter_rows", "dead_letter_rows")):
+                         ("rtfds_dead_letter_rows", "dead_letter_rows"),
+                         ("rtfds_checkpoint_corrupt_total",
+                          "checkpoint_corrupt_total"),
+                         ("rtfds_checkpoint_fallbacks_total",
+                          "checkpoint_fallbacks"),
+                         ("rtfds_checkpoint_lineage_depth",
+                          "checkpoint_lineage_depth")):
             v = self.registry.family_total(fam)
             if v is not None:
                 extras[key] = v
@@ -597,6 +611,14 @@ class MetricsServer:
         if ok and extras.get("dead_letter_rows", 0) > 0:
             # alive and progressing, but quarantined rows await triage
             status = "degraded"
+        fb = self.registry.get("rtfds_checkpoint_serving_fallback")
+        if ok and fb is not None and fb.value > 0:
+            # the engine restored PAST a corrupt checkpoint and is
+            # serving off an older fence — alive (200) but an operator
+            # should look at the quarantined lineage before the next
+            # incident eats the remaining fallback depth
+            status = "degraded"
+            extras["serving_off_fallback_restore"] = True
         return ok, {"healthy": ok, "status": status, "checks": checks,
                     **extras}
 

@@ -295,3 +295,42 @@ def test_ops_dashboard_dead_letter_line(tmp_path):
     assert ">3<" in htm  # the quarantined-row count rendered in the tile
     assert "1 crash loop(s)" in htm
     assert "dead_letter" in htm and "poison" in htm
+
+
+def test_ops_dashboard_durable_state_tile(tmp_path):
+    """The ops view tells the durable-state story: a clean run shows a
+    quiet 'verified' tile; a run that fell back past corrupt checkpoints
+    shows the quarantine count, what finally restored, and serious-class
+    checkpoint_fallback event marks."""
+    import time as _time
+
+    from real_time_fraud_detection_system_tpu.io.dashboard import (
+        _EVENT_CLASS,
+        render_ops_html,
+    )
+
+    assert _EVENT_CLASS["checkpoint_fallback"] == "serious"
+    t0 = _time.time()
+    batches = [
+        {"kind": "batch", "t": t0 + i, "batch": i + 1, "rows": 100,
+         "phases": {"dispatch": 0.001}, "queue_depth": 0,
+         "latency_s": 0.002}
+        for i in range(4)
+    ]
+    clean = render_ops_html({"model_kind": "logreg"}, batches)
+    assert "Durable state" in clean and "verified" in clean
+
+    records = batches + [
+        {"kind": "event", "t": t0 + 1.2, "event": "checkpoint_fallback",
+         "path": "ckpt-0000000006-delta.npz", "reason": "checksum"},
+        {"kind": "event", "t": t0 + 1.3, "event": "checkpoint_fallback",
+         "path": "ckpt-0000000005-delta.npz", "reason": "truncated"},
+        {"kind": "event", "t": t0 + 1.4, "event": "checkpoint_fallback",
+         "restored": "ckpt-0000000004.npz", "skipped": 2,
+         "from_tip": "ckpt-0000000006-delta.npz", "batches_done": 4},
+    ]
+    htm = render_ops_html({"model_kind": "logreg"}, records)
+    assert "Durable state" in htm
+    assert "2 corrupt" in htm
+    assert "restored ckpt-0000000004.npz" in htm
+    assert "checkpoint_fallback" in htm

@@ -618,6 +618,47 @@ def test_healthz_reports_failure_counters_and_degraded_state():
         server.stop()
 
 
+def test_healthz_durable_state_fields_and_fallback_degraded():
+    """/healthz carries the durable-state plane: last-checkpoint age,
+    lineage depth, corruption/fallback counters — and flips to
+    'degraded' (still 200) while the engine serves off a fallback
+    restore, recovering to 'ok' once a fresh save lands."""
+    import time as _time
+
+    reg = MetricsRegistry()
+    server = MetricsServer(port=0, registry=reg).start()
+    try:
+        ok, body = server.health()
+        assert ok and "checkpoint_corrupt_total" not in body
+        assert "last_checkpoint_age_s" not in body["checks"]
+
+        reg.gauge("rtfds_last_checkpoint_unix_seconds").set(
+            _time.time() - 12.0)
+        reg.gauge("rtfds_checkpoint_lineage_depth").set(3)
+        reg.counter("rtfds_checkpoint_corrupt_total",
+                    reason="checksum").inc()
+        reg.counter("rtfds_checkpoint_corrupt_total",
+                    reason="truncated").inc(2)
+        reg.counter("rtfds_checkpoint_fallbacks_total").inc()
+        reg.gauge("rtfds_checkpoint_serving_fallback").set(1)
+        ok, body = server.health()
+        assert ok  # alive: fallback restore is degraded, not unhealthy
+        assert body["status"] == "degraded"
+        assert body["serving_off_fallback_restore"] is True
+        assert body["checkpoint_corrupt_total"] == 3.0
+        assert body["checkpoint_fallbacks"] == 1.0
+        assert body["checkpoint_lineage_depth"] == 3.0
+        age = body["checks"]["last_checkpoint_age_s"]["value"]
+        assert 11.0 < age < 60.0
+
+        # the next successful save clears the fallback condition
+        reg.gauge("rtfds_checkpoint_serving_fallback").set(0)
+        ok, body = server.health()
+        assert ok and body["status"] == "ok"
+    finally:
+        server.stop()
+
+
 def test_dead_letter_sink_idempotent_and_parquet_variant(tmp_path):
     import numpy as np
 

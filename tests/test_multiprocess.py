@@ -46,14 +46,28 @@ _WORKER = textwrap.dedent("""
         make_tp_step,
     )
 
-    mesh = Mesh(mesh_utils.create_device_mesh((2,)), ("data",))
-    params = init_mlp(15, hidden=(32, 16), seed=7)
-    rng = np.random.default_rng(5)
-    x = jnp.asarray(rng.normal(0, 1, (64, 15)), jnp.float32)
-    y = jnp.asarray((rng.random(64) < 0.3).astype(np.int32))
+    try:
+        mesh = Mesh(mesh_utils.create_device_mesh((2,)), ("data",))
+        params = init_mlp(15, hidden=(32, 16), seed=7)
+        rng = np.random.default_rng(5)
+        x = jnp.asarray(rng.normal(0, 1, (64, 15)), jnp.float32)
+        y = jnp.asarray((rng.random(64) < 0.3).astype(np.int32))
 
-    sharded, step = make_tp_step(mesh, params, lr=1.0)
-    new, loss = step(sharded, x, y)
+        sharded, step = make_tp_step(mesh, params, lr=1.0)
+        new, loss = step(sharded, x, y)
+    except Exception as e:
+        # jaxlib builds without cross-process CPU collectives (no Gloo/
+        # MPI) refuse ANY multi-process computation with exactly this
+        # capability error. That is an environment limit, not a
+        # regression in this repo's TP code — report it as a skip
+        # sentinel so the test can skip with a precise reason, while
+        # every other failure still propagates as a real failure.
+        if "Multiprocess computations aren't implemented" in str(e):
+            print("MPSKIP this jaxlib's CPU backend has no cross-process "
+                  "collectives (Gloo/MPI not built in): "
+                  + str(e).splitlines()[-1][:160], flush=True)
+            sys.exit(0)
+        raise
 
     def ref_loss(p):
         per = optax.sigmoid_binary_cross_entropy(
@@ -74,10 +88,30 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_tp_step(tmp_path):
+@pytest.fixture()
+def mp_env():
+    """Probe the pieces a 2-process run needs BEFORE paying for worker
+    launches, and skip with a precise reason where the environment
+    genuinely cannot run it (the capability probe for cross-process
+    collectives happens inside the worker — it is only discoverable by
+    running one)."""
+    try:
+        port = _free_port()
+    except OSError as e:
+        pytest.skip(f"cannot bind a loopback port for the coordinator: {e}")
+    try:
+        p = subprocess.run([sys.executable, "-c", "print('spawn-ok')"],
+                           capture_output=True, text=True, timeout=60)
+        assert "spawn-ok" in p.stdout
+    except Exception as e:  # noqa: BLE001 — any spawn failure is a skip
+        pytest.skip(f"cannot spawn worker subprocesses: {e}")
+    return port
+
+
+def test_two_process_tp_step(tmp_path, mp_env):
     worker = tmp_path / "worker.py"
     worker.write_text(_WORKER)
-    port = str(_free_port())
+    port = str(mp_env)
     # the worker strips XLA_FLAGS itself (single env owner)
     env = dict(os.environ)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -99,6 +133,13 @@ def test_two_process_tp_step(tmp_path):
                 q.kill()
             pytest.fail("multi-process worker timed out")
         outs.append(out)
+    skips = [ln for out in outs for ln in out.splitlines()
+             if ln.startswith("MPSKIP")]
+    if skips:
+        # fix-or-pin: the jaxlib build genuinely cannot run multiprocess
+        # CPU computations — skip with the worker's precise reason so a
+        # capable box still runs (and can regress) the real test
+        pytest.skip(skips[0][len("MPSKIP "):])
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} rc={p.returncode}:\n{out}"
         assert f"MPOK {pid}" in out, out
