@@ -87,6 +87,15 @@ chaos-smoke:
 recovery-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_recovery_smoke.py -q
 
+# continuous-learning gate: champion serves, the streaming learner
+# trains a candidate on injected labeled feedback, the shadow's live
+# recall overtakes the champion's, promotion fires, an injected
+# regression rolls it back — zero mid-stream recompiles under
+# precompile, every claim asserted from rtfds_* registry metrics, and
+# a corrupt candidate artifact can never be promoted
+learn-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_learn_smoke.py -q
+
 test:
 	$(PY) -m pytest tests/ -q
 
@@ -127,4 +136,4 @@ install:
 clean:
 	rm -rf $(OUT)
 
-.PHONY: demo datagen train score run-all query dashboard connectors dryrun trace-demo bench perf-smoke chaos-smoke recovery-smoke test integration integration-up integration-down sqlcheck install clean
+.PHONY: demo datagen train score run-all query dashboard connectors dryrun trace-demo bench perf-smoke chaos-smoke recovery-smoke learn-smoke test integration integration-up integration-down sqlcheck install clean

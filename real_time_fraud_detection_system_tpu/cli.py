@@ -250,7 +250,8 @@ def cmd_train(args) -> int:
     return 0
 
 
-def _make_model_reloader(path: str, kind: str, every_batches: int, log):
+def _make_model_reloader(path: str, kind: str, every_batches: int, log,
+                         seed_initial: bool = False, sig_state=None):
     """Hot model reload for serving: every N batches, re-read the model
     artifact and swap weights into the live engine between device steps
     (the reference picks up a retrained pickle only by restarting the
@@ -258,27 +259,71 @@ def _make_model_reloader(path: str, kind: str, every_batches: int, log):
     ``s3://`` artifacts on HEAD metadata (ETag + size), so an unchanged
     artifact costs one stat/HEAD per interval — the body is downloaded
     only when the metadata changed (stores without ``head()``, or with
-    degenerate metadata, fall back to a GET + content digest gate). The
-    FIRST due interval
-    always reloads: a fresh reloader is built per supervisor incarnation
-    (crash recovery restores pre-swap weights from the checkpoint, so the
-    new incarnation must re-apply the latest artifact rather than trust a
-    stale signature). The serving kind is pinned — an artifact of a
-    different kind is refused (the jitted step's shape family would
-    change under the engine)."""
+    degenerate metadata, fall back to a GET + content digest gate).
+
+    ``seed_initial=False`` (plain serving): the FIRST due interval
+    always reloads — a fresh reloader is built per supervisor
+    incarnation, and crash recovery restores pre-swap weights from the
+    checkpoint, so the new incarnation must re-apply the latest artifact
+    rather than trust a stale signature. ``seed_initial=True``
+    (``--learn-registry`` active): the file's signature is captured and
+    only a CHANGE after startup triggers a reload — the registry's
+    champion pointer, not the bootstrap file, is the record of what
+    should serve, and the forced first reload would silently clobber an
+    adopted promotion with the stale file params. In that mode the
+    caller passes ``sig_state`` (one dict shared across supervisor
+    incarnations, seeded ONCE): re-baselining per incarnation would
+    silently drop a file update that landed between the previous
+    incarnation's last poll and its crash.
+
+    The serving kind is pinned — an artifact of a different kind is
+    refused (the jitted step's shape family would change under the
+    engine)."""
     import hashlib
     import os as _os
 
     from real_time_fraud_detection_system_tpu.io.artifacts import (
+        _split_s3_url,
         load_model,
         load_model_bytes,
     )
+    from real_time_fraud_detection_system_tpu.io.store import make_store
     from real_time_fraud_detection_system_tpu.runtime.engine import (
         device_params_for,
     )
 
-    state = {"n": 0, "sig": None}
+    # "n" (poll cadence) is per-incarnation; "sig" lives in sig_state
+    # when the caller shares one across incarnations.
+    state = sig_state if sig_state is not None else {}
+    state.setdefault("sig", None)
+    state["n"] = 0
     is_local = not path.startswith("s3://")
+    url = key = None
+    if not is_local:
+        url, key = _split_s3_url(path)
+
+    def _meta_sig(md):
+        # the ONE signature format for store artifacts (ETag + size, or
+        # None to force the GET+digest fallback) — the seed baseline and
+        # poll's change gate must always agree on it
+        if md.get("etag") or md.get("size") is not None:
+            return f"{md.get('etag')}:{md.get('size')}"
+        return None
+
+    if seed_initial and state["sig"] is None:
+        try:
+            if is_local:
+                state["sig"] = _os.stat(path).st_mtime_ns
+            else:
+                store = make_store(url)
+                head = getattr(store, "head", None)
+                md = head(key) if head is not None else {}
+                state["sig"] = _meta_sig(md) or hashlib.sha256(
+                    store.get(key)).hexdigest()
+        except Exception as e:  # noqa: BLE001 — fall back to forced reload
+            log.warning("could not baseline %s for change-gated reload "
+                        "(%s); the first interval will reload it", path, e)
+            state["sig"] = None
 
     def poll():
         state["n"] += 1
@@ -291,14 +336,6 @@ def _make_model_reloader(path: str, kind: str, every_batches: int, log):
                     return None
                 m = load_model(path)
             else:
-                from real_time_fraud_detection_system_tpu.io.artifacts import (
-                    _split_s3_url,
-                )
-                from real_time_fraud_detection_system_tpu.io.store import (
-                    make_store,
-                )
-
-                url, key = _split_s3_url(path)
                 store = make_store(url)
                 # Change-gate on HEAD metadata (ETag/size) so an
                 # unchanged artifact costs one HEAD per interval, not a
@@ -313,12 +350,6 @@ def _make_model_reloader(path: str, kind: str, every_batches: int, log):
                 head = getattr(store, "head", None)
                 get_with_meta = getattr(store, "get_with_meta", None)
                 meta = head(key) if head is not None else {}
-
-                def _meta_sig(md):
-                    if md.get("etag") or md.get("size") is not None:
-                        return f"{md.get('etag')}:{md.get('size')}"
-                    return None
-
                 sig = _meta_sig(meta)
                 if sig is not None:
                     if state["sig"] is not None and sig == state["sig"]:
@@ -349,6 +380,10 @@ def _make_model_reloader(path: str, kind: str, every_batches: int, log):
         log.info("hot-swapped model weights from %s", path)
         return device_params_for(kind, m.params), m.scaler
 
+    # Shared-baseline mode: expose the dict so the supervisor's zombie
+    # fence can roll back a signature a fenced-off incarnation committed
+    # for a swap that can never land (faults._run_watched).
+    poll.sig_state = state if sig_state is not None else None
     return poll
 
 
@@ -407,9 +442,20 @@ def cmd_score(args) -> int:
         log.error("--reload-model-every does not compose with "
                   "--scorer cpu (the oracle model is fixed at startup)")
         return 2
+    # With --learn-registry the registry's champion pointer, not the
+    # bootstrap file, is the record of what should serve: seed the
+    # reloader's signature baseline so only a file CHANGE after startup
+    # triggers a swap — the forced first reload would silently clobber
+    # an adopted promotion with stale file params. The signature dict is
+    # shared across supervisor incarnations (seeded once): a fresh
+    # baseline per incarnation would silently drop a file update landing
+    # in the last-poll→crash window.
+    _reload_sig: dict = {}
     make_reloader = (
-        (lambda: _make_model_reloader(args.model_file, model.kind,
-                                      args.reload_model_every, log))
+        (lambda: _make_model_reloader(
+            args.model_file, model.kind, args.reload_model_every, log,
+            seed_initial=bool(args.learn_registry),
+            sig_state=_reload_sig if args.learn_registry else None))
         if args.reload_model_every > 0 else None)
     import dataclasses as _dc
 
@@ -485,6 +531,40 @@ def cmd_score(args) -> int:
         checkpoint_op_timeout_s=args.checkpoint_op_timeout,
         checkpoint_op_attempts=args.checkpoint_op_attempts,
     ))
+    cfg = cfg.replace(learn=_dc.replace(
+        cfg.learn,
+        registry_path=args.learn_registry,
+        publish_every_labels=args.publish_every_labels,
+        promote_min_labels=args.promote_min_labels,
+        promote_margin=args.promote_margin,
+        rollback_min_labels=args.rollback_min_labels,
+        rollback_margin=args.rollback_margin,
+    ))
+    if args.learn_registry:
+        bad = None
+        if args.devices > 1:
+            bad = ("--learn-registry is not wired for the sharded "
+                   "engine (--devices > 1)")
+        elif args.scorer == "cpu":
+            bad = ("--learn-registry promotes by swapping on-device "
+                   "params; --scorer cpu classifies host-side with a "
+                   "model fixed at startup")
+        elif model.kind == "sequence":
+            bad = ("shadow scoring is not wired for kind='sequence' "
+                   "(no host-side feature matrix to dual-score)")
+        elif args.alerts_only or args.emit_threshold > 0 or args.emit_bf16:
+            bad = ("shadow scoring re-consumes every row's features "
+                   "host-side; it does not compose with --alerts-only, "
+                   "--emit-threshold or --emit-bf16")
+        if bad:
+            log.error(bad)
+            return 2
+        if not args.feedback_bootstrap:
+            log.warning(
+                "continuous learning without --feedback-bootstrap: no "
+                "live labels arrive, so the shadow's live precision/"
+                "recall windows stay empty and promotion never fires "
+                "(the registry lineage still records reloads)")
     # Unconditional (0 resolves to auto): publishes the
     # rtfds_decode_workers gauge the README's host-plane reading uses,
     # in auto mode too.
@@ -554,6 +634,78 @@ def cmd_score(args) -> int:
         dead_letter = make_dead_letter_sink(args.dead_letter)
         log.info("dead-letter queue: %s (%d row(s) already quarantined)",
                  args.dead_letter, len(dead_letter))
+
+    learning = None
+    if args.learn_registry:
+        from real_time_fraud_detection_system_tpu.io.registry import (
+            make_model_registry,
+        )
+        from real_time_fraud_detection_system_tpu.runtime.engine import (
+            loss_fn_for,
+        )
+        from real_time_fraud_detection_system_tpu.runtime.learner import (
+            LearningLoop,
+            StreamingLearner,
+        )
+
+        model_registry = make_model_registry(
+            args.learn_registry,
+            op_timeout_s=cfg.runtime.checkpoint_op_timeout_s,
+            op_attempts=cfg.runtime.checkpoint_op_attempts)
+        # Restart continuity: a registry with a champion pointer is the
+        # record of what should be serving — a promotion must survive a
+        # process restart, so the champion artifact supersedes the
+        # (bootstrap-era) --model-file params. Without this the lineage,
+        # metrics and rollback baselines would all describe a model that
+        # is not actually serving.
+        champ_v = model_registry.champion_version()
+        # False when a champion exists but could not be adopted: the
+        # engines then serve --model-file params, and the learning
+        # loop's version stamp must not claim they are the champion's.
+        model_is_champion = True
+        if champ_v is not None:
+            model_is_champion = False
+            try:
+                champ = model_registry.champion()
+            except Exception as e:  # noqa: BLE001 — corrupt/missing champion
+                log.warning(
+                    "registry champion v%s failed verification (%s: %s); "
+                    "serving the --model-file params instead — repair "
+                    "with `rtfds registry --verify` / --rollback",
+                    champ_v, type(e).__name__, e)
+            else:
+                if champ.kind != model.kind:
+                    log.error(
+                        "registry champion v%s is kind=%r but "
+                        "--model-file is kind=%r; point --learn-registry "
+                        "at this model's registry or retrain",
+                        champ_v, champ.kind, model.kind)
+                    return 2
+                log.info("serving registry champion v%s (supersedes "
+                         "--model-file)", champ_v)
+                model = champ
+                model_is_champion = True
+        learner = None
+        if loss_fn_for(model.kind) is not None:
+            learner = StreamingLearner(
+                model.kind, model.params, model.scaler, cfg,
+                model_registry,
+                publish_every_labels=cfg.learn.publish_every_labels,
+                window_rows=cfg.learn.window_rows,
+                epochs=cfg.learn.epochs,
+                max_queue=cfg.learn.queue_chunks,
+                learning_rate=cfg.learn.learning_rate or None)
+        else:
+            log.info("model kind %r has no gradient path: the registry "
+                     "records lineage and shadow-scores externally "
+                     "published candidates, but no streaming learner "
+                     "runs (tree ensembles retrain offline and publish "
+                     "via `rtfds registry`)", model.kind)
+        learning = LearningLoop(model_registry, cfg, model.kind,
+                                model=model, learner=learner,
+                                model_is_champion=model_is_champion)
+        log.info("continuous learning on: registry %s (champion v%s)",
+                 args.learn_registry, learning.champion_version)
 
     def make_engine():
         if args.devices > 1:
@@ -766,6 +918,7 @@ def cmd_score(args) -> int:
                     resume=args.resume, stall_timeout_s=args.stall_timeout,
                     make_source=source_factory, make_feedback=make_feedback,
                     make_model_reload=make_reloader,
+                    learning=learning,
                     crash_loop_k=args.crash_loop_k,
                     dead_letter=dead_letter,
                     restart_backoff=backoff,
@@ -786,6 +939,7 @@ def cmd_score(args) -> int:
                     source, sink=sink, checkpointer=ckpt,
                     max_batches=args.max_batches, feedback=fb,
                     model_reload=make_reloader() if make_reloader else None,
+                    learning=learning,
                 )
     finally:
         close = getattr(source, "close", None)
@@ -801,6 +955,8 @@ def cmd_score(args) -> int:
                             type(e).__name__, e)
         if fb is not None:
             fb.close()
+        if learning is not None:
+            learning.close()
         if recorder is not None:
             set_active_recorder(None)
             recorder.close()
@@ -1040,6 +1196,108 @@ def cmd_ckpt(args) -> int:
                   "would fall back past them; quarantine or rebuild "
                   "before deploying", n_bad)
         return 1
+    return 0
+
+
+def cmd_registry(args) -> int:
+    """Inspect / verify / roll back the versioned model registry (the
+    continuous-learning artifact plane — `rtfds ckpt`'s model twin).
+
+    Default: one row per live version (kind, size, parent lineage,
+    source, labels trained, champion flag). ``--verify`` re-hashes every
+    artifact against its manifest AND its internal content hash (deploy
+    preflight: exit 1 on any corruption — a corrupt candidate must never
+    reach a promotion gate). ``--inspect N`` dumps one version's
+    manifest. ``--promote N`` verifies THEN moves the champion pointer;
+    ``--rollback`` pops it back to the previous champion.
+    """
+    from real_time_fraud_detection_system_tpu.io.artifacts import (
+        CorruptModelError,
+    )
+    from real_time_fraud_detection_system_tpu.io.registry import (
+        make_model_registry,
+    )
+    from real_time_fraud_detection_system_tpu.utils import get_logger
+
+    log = get_logger("registry")
+    try:
+        reg = make_model_registry(args.path)
+    except Exception as e:  # noqa: BLE001 — bad URL/creds → usage error
+        log.error("cannot open model registry at %s: %s", args.path, e)
+        return 2
+    if args.publish:
+        from real_time_fraud_detection_system_tpu.io.artifacts import (
+            load_model,
+        )
+
+        try:
+            m = load_model(args.publish)  # content-hash verified
+        except CorruptModelError as e:
+            log.error("refusing to publish %s: artifact failed "
+                      "verification (%s)", args.publish, e.reason)
+            return 1
+        except Exception as e:  # noqa: BLE001 — missing file, bad npz
+            log.error("cannot load model artifact %s: %s",
+                      args.publish, e)
+            return 2
+        v = reg.publish(m, parent=reg.champion_version(), source="cli",
+                        note=args.publish)
+        print(_json_line({"published": v, "kind": m.kind,
+                          "parent": reg.champion_version()}))
+        return 0
+    if args.rollback:
+        prev = reg.rollback()
+        if prev is None:
+            log.error("no promotion history to roll back to")
+            return 1
+        print(_json_line({"champion": prev, "by": "rollback"}))
+        return 0
+    if args.promote:
+        try:
+            reg.get(args.promote)  # verify AT the gate, like the loop
+        except KeyError:
+            log.error("no version %d in the registry", args.promote)
+            return 2
+        except CorruptModelError as e:
+            log.error("version %d failed verification (%s) and was "
+                      "quarantined — it can never be promoted",
+                      args.promote, e.reason)
+            return 1
+        ptr = reg.promote(args.promote, by="cli")
+        print(_json_line(ptr))
+        return 0
+    if args.inspect:
+        try:
+            man = reg.meta(args.inspect)
+        except KeyError:
+            log.error("no version %d in the registry", args.inspect)
+            return 2
+        except CorruptModelError as e:
+            log.error("manifest for version %d is corrupt (%s)",
+                      args.inspect, e.reason)
+            return 1
+        print(_json_line(man))
+        return 0
+    if args.verify:
+        report = reg.verify_all()
+        n_bad = sum(1 for e in report if not e.get("valid"))
+        print(_json_line({"path": args.path, "versions": len(report),
+                          "corrupt": n_bad,
+                          "champion": reg.champion_version()}))
+        for e in report:
+            print(_json_line(e))
+        if n_bad:
+            log.error("%d corrupt artifact(s) still listed in the "
+                      "registry (the preflight never quarantines; each "
+                      "will be quarantined on its first read and can "
+                      "never be promoted) — republish or roll back "
+                      "before deploying", n_bad)
+            return 1
+        return 0
+    print(_json_line({"path": args.path,
+                      "champion": reg.champion_version()}))
+    for row in reg.list_versions():
+        print(_json_line(row))
     return 0
 
 
@@ -1889,6 +2147,30 @@ def main(argv=None) -> int:
                         "ui.perfetto.dev or summarize with `rtfds "
                         "trace`); bounded ring buffer — safe on "
                         "unbounded streams, unlike --trace-dir")
+    p.add_argument("--learn-registry", default="",
+                   help="continuous learning: versioned model registry "
+                        "at this path (directory or s3:// prefix). The "
+                        "serving model bootstraps as v1; a streaming "
+                        "learner trains a candidate on labeled feedback "
+                        "(needs --feedback-bootstrap for live labels), "
+                        "shadow-scores it beside the champion, and "
+                        "promotes/rolls back on live precision-recall. "
+                        "Inspect with `rtfds registry`")
+    p.add_argument("--publish-every-labels", type=int, default=512,
+                   help="publish a candidate version after this many new "
+                        "labeled rows trained since the last publish")
+    p.add_argument("--promote-min-labels", type=int, default=256,
+                   help="labeled rows BOTH models need in the live "
+                        "comparison window before promotion can fire")
+    p.add_argument("--promote-margin", type=float, default=0.01,
+                   help="live recall improvement the candidate must show "
+                        "over the champion to be promoted")
+    p.add_argument("--rollback-min-labels", type=int, default=256,
+                   help="labeled rows after a promotion before the "
+                        "canary verdict (hold baseline or roll back)")
+    p.add_argument("--rollback-margin", type=float, default=0.05,
+                   help="live recall drop below the promotion baseline "
+                        "that triggers automatic rollback")
     p.set_defaults(fn=cmd_score)
 
     p = sub.add_parser(
@@ -1945,6 +2227,35 @@ def main(argv=None) -> int:
                    help="dump one checkpoint's manifest (name or full "
                         "path, e.g. ckpt-0000000004.npz)")
     p.set_defaults(fn=cmd_ckpt, needs_backend=False)
+
+    p = sub.add_parser(
+        "registry",
+        help="inspect / verify / promote / roll back the versioned "
+             "model registry (continuous learning)")
+    p.add_argument("--path", required=True,
+                   help="registry directory or s3:// prefix (the "
+                        "--learn-registry of the serving run)")
+    p.add_argument("--verify", action="store_true",
+                   help="re-hash every artifact against its manifest + "
+                        "internal content hash; exit 1 on any corruption "
+                        "(deploy preflight)")
+    p.add_argument("--inspect", type=int, default=0,
+                   help="dump one version's manifest (versions start "
+                        "at 1)")
+    p.add_argument("--promote", type=int, default=0,
+                   help="verify, then move the champion pointer to this "
+                        "version (manual canary override)")
+    p.add_argument("--rollback", action="store_true",
+                   help="pop the champion pointer back to the previous "
+                        "champion (one pointer move; no artifact bytes "
+                        "change)")
+    p.add_argument("--publish", default="",
+                   help="register a model artifact (.npz, e.g. an "
+                        "offline-retrained forest/GBT) as a new "
+                        "candidate version; a serving run with "
+                        "--learn-registry picks it up for shadow "
+                        "scoring on its next registry poll")
+    p.set_defaults(fn=cmd_registry, needs_backend=False)
 
     p = sub.add_parser("demo",
                        help="full E2E demo: datagen → CDC → sinks → scorer")

@@ -5,12 +5,28 @@ boto3 upload in ``load_initial_data.py:269-287``, ``scaler.pkl`` via joblib,
 daily ``data/raw/transaction/*.pkl``). Pickle executes arbitrary code at
 load time; this framework stores plain ``.npz`` arrays plus a JSON header —
 loadable anywhere, no code execution, and directly mmap-friendly.
+
+Artifact format v1 — verified content
+-------------------------------------
+``dump_model_bytes`` stamps every artifact with a **content hash**
+(sha256 over each array's key/shape/dtype/bytes plus the kind metadata)
+and a format version; ``load_model_bytes`` recomputes the hash over what
+it actually read and raises :class:`CorruptModelError` on any mismatch —
+a bit-flipped or torn artifact can never be silently served (the same
+trust-nothing-on-restore contract checkpoint format v2 gives the state
+plane). v0 artifacts (pre-hash) still load — existing deployments
+upgrade in place on their next save. Local-file loads quarantine the
+corrupt artifact (``stale-…`` rename, bytes preserved for forensics)
+before raising, mirroring the checkpoint lineage's quarantine.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import uuid
+import zipfile
 from typing import Tuple
 
 import jax.numpy as jnp
@@ -21,6 +37,53 @@ from real_time_fraud_detection_system_tpu.models.forest import TreeEnsemble
 from real_time_fraud_detection_system_tpu.models.logreg import LogRegParams
 from real_time_fraud_detection_system_tpu.models.scaler import Scaler
 from real_time_fraud_detection_system_tpu.models.train import TrainedModel
+
+ARTIFACT_FORMAT = 1
+
+ARTIFACT_CORRUPT_REASONS = ("checksum", "truncated")
+
+
+class CorruptModelError(Exception):
+    """A model artifact failed load-time verification.
+
+    ``reason`` is ``checksum`` (bytes present but the content hash does
+    not match what the writer stamped — bit-flip, tampering) or
+    ``truncated`` (bytes missing/unreadable — torn write, partial PUT).
+    """
+
+    def __init__(self, reason: str, detail: str = ""):
+        assert reason in ARTIFACT_CORRUPT_REASONS, reason
+        self.reason = reason
+        self.detail = detail
+        super().__init__(f"{reason}: {detail}" if detail else reason)
+
+
+def _corrupt_from_badzip(e: zipfile.BadZipFile) -> CorruptModelError:
+    """One classification of the zip layer's failure modes: an entry CRC
+    mismatch is bit-rot (``checksum``); anything else — bad magic, short
+    central directory — is missing bytes (``truncated``)."""
+    reason = "checksum" if "CRC-32" in str(e) else "truncated"
+    return CorruptModelError(reason, str(e))
+
+
+def _content_sha256(meta: dict, arrays: dict) -> str:
+    """Content hash over everything that defines the model: the kind
+    metadata (minus the hash/format fields themselves) and each array's
+    key, shape, dtype and raw bytes, in sorted key order. Recomputable
+    from a LOADED artifact, so verification checks what was read, not
+    what the zip container claims."""
+    h = hashlib.sha256()
+    clean = {k: v for k, v in sorted(meta.items())
+             if k not in ("content_sha256", "format")}
+    h.update(json.dumps(clean, sort_keys=True,
+                        separators=(",", ":")).encode())
+    for k in sorted(arrays):
+        a = np.ascontiguousarray(arrays[k])
+        h.update(k.encode())
+        h.update(str(a.shape).encode())
+        h.update(str(a.dtype).encode())
+        h.update(memoryview(a).cast("B"))
+    return h.hexdigest()
 
 
 def dump_model_bytes(model: TrainedModel) -> bytes:
@@ -71,6 +134,8 @@ def dump_model_bytes(model: TrainedModel) -> bytes:
             arrays[f"seq{i}"] = np.asarray(leaf)
     else:
         raise ValueError(f"unknown model kind {model.kind}")
+    meta["format"] = ARTIFACT_FORMAT
+    meta["content_sha256"] = _content_sha256(meta, arrays)
     buf = _io.BytesIO()
     np.savez(buf, __meta__=json.dumps(meta), **arrays)
     return buf.getvalue()
@@ -110,20 +175,79 @@ def save_model(path: str, model: TrainedModel) -> None:
 
 
 def load_model_bytes(data: bytes) -> TrainedModel:
+    """Parse + verify artifact bytes. Raises :class:`CorruptModelError`
+    (``truncated`` for unreadable bytes, ``checksum`` when the content
+    hash a v1 writer stamped does not match what was read); v0 artifacts
+    carry no hash and load trusting the zip layer's own entry CRCs."""
     import io as _io
 
-    return _load_model_npz(np.load(_io.BytesIO(data), allow_pickle=False))
+    try:
+        with np.load(_io.BytesIO(data), allow_pickle=False) as z:
+            return _load_model_npz(z)
+    except zipfile.BadZipFile as e:
+        raise _corrupt_from_badzip(e) from None
+
+
+def _count_corrupt(reason: str) -> None:
+    from real_time_fraud_detection_system_tpu.utils.metrics import (
+        get_registry,
+    )
+
+    get_registry().counter(
+        "rtfds_model_artifact_corrupt_total",
+        "model artifacts that failed load-time verification",
+        reason=reason).inc()
+
+
+def _quarantine_artifact(path: str) -> str:
+    """Local-file quarantine (the artifact twin of the checkpoint
+    lineage's ``stale-…`` stash): rename, never delete — the corrupt
+    bytes are forensics."""
+    d, base = os.path.split(path)
+    stale = os.path.join(d, f"stale-{uuid.uuid4().hex[:8]}-{base}")
+    try:
+        os.replace(path, stale)
+    except OSError:
+        return path  # best-effort: the raise below still stops serving
+    return stale
 
 
 def load_model(path: str) -> TrainedModel:
-    """Load from a local path or an object-store URL (``s3://…``)."""
+    """Load from a local path or an object-store URL (``s3://…``).
+
+    A local artifact that fails its CONTENT hash is quarantined
+    (``stale-…`` rename) before :class:`CorruptModelError` propagates —
+    the serving path can never keep re-loading a bit-rotted file. A
+    ``truncated`` failure raises WITHOUT quarantining: it can be a torn
+    read of a file an operator is shipping non-atomically over the
+    served path, and renaming it away would steal the destination from
+    the in-flight copy — the next reload poll retries and succeeds once
+    the write completes. Both reasons are counted in
+    ``rtfds_model_artifact_corrupt_total{reason=…}``."""
     if path.startswith("s3://"):
         from real_time_fraud_detection_system_tpu.io.store import make_store
 
         url, key = _split_s3_url(path)
-        return load_model_bytes(make_store(url).get(key))
-    with np.load(path, allow_pickle=False) as z:
-        return _load_model_npz(z)
+        try:
+            return load_model_bytes(make_store(url).get(key))
+        except CorruptModelError as e:
+            # no local bytes to quarantine; the registry/reload pollers
+            # swallow the raise, so the counter is the operator's signal
+            _count_corrupt(e.reason)
+            raise
+    try:
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                return _load_model_npz(z)
+        except zipfile.BadZipFile as e:
+            raise _corrupt_from_badzip(e) from None
+    except CorruptModelError as e:
+        _count_corrupt(e.reason)
+        if e.reason != "checksum":
+            raise
+        stale = _quarantine_artifact(path)
+        raise CorruptModelError(
+            e.reason, f"{e.detail} (quarantined to {stale})") from None
 
 
 def upload_model(store, key: str, model: TrainedModel) -> None:
@@ -139,11 +263,30 @@ def download_model(store, key: str, default=None):
         data = store.get(key)
     except KeyError:
         return default
-    return load_model_bytes(data)
+    try:
+        return load_model_bytes(data)
+    except CorruptModelError as e:
+        _count_corrupt(e.reason)
+        raise
 
 
-def _load_model_npz(z) -> TrainedModel:
-    meta = json.loads(str(z["__meta__"]))
+def _load_model_npz(npz) -> TrainedModel:
+    # Materialize + verify BEFORE building any params: the zip layer's
+    # entry CRCs fire here on bit-flips, and the v1 content hash is
+    # recomputed over exactly what was read.
+    try:
+        meta = json.loads(str(npz["__meta__"]))
+        z = {k: npz[k] for k in npz.files if k != "__meta__"}
+    except zipfile.BadZipFile as e:
+        raise _corrupt_from_badzip(e) from None
+    except (KeyError, EOFError, OSError, ValueError) as e:
+        raise CorruptModelError(
+            "truncated", f"{type(e).__name__}: {e}") from None
+    want = meta.get("content_sha256")
+    if want is not None and _content_sha256(meta, z) != want:
+        raise CorruptModelError(
+            "checksum", "content hash does not match the stamped "
+            f"sha256 {want[:12]}…")
     kind = meta["kind"]
     scaler = Scaler(
         mean=jnp.asarray(z["scaler_mean"]), scale=jnp.asarray(z["scaler_scale"])

@@ -148,6 +148,10 @@ def _state_arrays(engine_state) -> Tuple[dict, dict]:
         # count must travel with the state for cross-width restores
         "layout_devices": int(
             getattr(engine_state, "layout_devices", 1) or 1),
+        # registry version the params descend from (None outside
+        # continuous learning) — restore hands it back so the learning
+        # loop can tell restored params from the current champion
+        "model_version": getattr(engine_state, "model_version", None),
     }
     return arrays, meta
 
@@ -177,6 +181,11 @@ def _apply_arrays(engine_state, meta: dict, arrays: dict):
         engine_state.layout_devices = int(meta["layout_devices"])
     # pre-layout-aware checkpoints: leave the template's value (the old
     # same-width-restore assumption)
+    if meta.get("model_version") is not None:
+        engine_state.model_version = int(meta["model_version"])
+    # pre-learning checkpoints carry no stamp: keep the template's value
+    # (the version the fresh engine was built from), which makes a
+    # champion-pointer mismatch err toward re-applying the champion
     return engine_state
 
 

@@ -450,7 +450,14 @@ _OPS_PHASES = ("source_poll", "host_prep", "dispatch", "result_wait",
 _EVENT_CLASS = {"fault": "serious", "restart": "serious",
                 "poison": "serious", "dead_letter": "serious",
                 "gave_up": "serious", "checkpoint_fallback": "serious",
-                "checkpoint": "info", "feedback": "good"}
+                "checkpoint": "info", "feedback": "good",
+                # continuous-learning plane (runtime/learner.py)
+                "model_published": "info", "model_candidate": "info",
+                "model_reload": "info", "model_promoted": "good",
+                "model_canary_passed": "good",
+                "model_rollback": "serious",
+                "model_promote_refused": "serious",
+                "model_artifact_corrupt": "serious"}
 
 
 def _downsample_max(ys: np.ndarray, limit: int = 240):
@@ -598,6 +605,43 @@ def render_ops_html(
     else:
         tiles.append(("Durable state", "verified",
                       "restores re-checksummed, no fallback"))
+    # Learning tile: which model versions served/shadowed and how the
+    # canary ended. Only rendered when the run had a learning loop (any
+    # model_* event), so plain serving runs keep a clean tile row.
+    promos = [e for e in events if e.get("event") == "model_promoted"]
+    rollbacks = [e for e in events if e.get("event") == "model_rollback"]
+    cands = [e for e in events if e.get("event") == "model_candidate"]
+    pubs = [e for e in events if e.get("event") == "model_published"]
+    # refusals by cause: "corrupt" sends the operator hunting bit-rot,
+    # which is wrong advice for a kind-mismatched or vanished artifact
+    refusals = [e for e in events
+                if e.get("event") == "model_promote_refused"]
+    refused_corrupt = sum(1 for e in refusals
+                          if e.get("reason") in ("checksum", "truncated"))
+    refused_other = len(refusals) - refused_corrupt
+    refused = len(refusals)
+    if promos or rollbacks or cands or pubs or refused:
+        if rollbacks and (not promos
+                          or rollbacks[-1].get("t", 0.0)
+                          >= promos[-1].get("t", 0.0)):
+            champ = rollbacks[-1].get("version", "?")
+            verdict = f"rolled back from v{rollbacks[-1].get('regressed')}"
+        elif promos:
+            champ = promos[-1].get("version", "?")
+            verdict = f"promoted over v{promos[-1].get('previous')}"
+        else:
+            champ = man.get("model_kind", "champion")
+            verdict = f"{len(pubs)} candidate(s) published"
+        sub_bits = [verdict]
+        if cands:
+            sub_bits.append(f"shadow v{cands[-1].get('version')}")
+        if refused_corrupt:
+            sub_bits.append(f"{refused_corrupt} corrupt refused")
+        if refused_other:
+            sub_bits.append(f"{refused_other} refused "
+                            "(kind/missing)")
+        tiles.append(("Learning", f"v{champ}" if promos or rollbacks
+                      else str(champ), " · ".join(sub_bits)))
     tile_html = []
     for label, value, sub in tiles:
         subdiv = f"<div class='sub'>{_esc(sub)}</div>" if sub else ""

@@ -1,0 +1,423 @@
+"""Versioned model registry — the continuous-learning artifact plane.
+
+The reference retrains offline and restarts the Spark job to pick up a
+new ``trained_model.pkl`` (``fraud_detection.py:59-82``); MLlib's answer
+(arXiv:1505.06807) is pipeline persistence with no operational story for
+*which* model is serving or how to get back to the previous one. Here
+every artifact that can ever serve gets:
+
+- a **monotonically increasing version** (``model-v0000001.npz``) — the
+  registry never overwrites an artifact in place;
+- a **content hash** (sha256 over the artifact bytes, recorded in the
+  side manifest ``model-v0000001.json``) verified on every ``get`` — a
+  corrupt candidate can never be promoted (quarantined ``stale-…`` +
+  ``rtfds_model_artifact_corrupt_total{reason=…}``, mirroring checkpoint
+  format v2), on top of the artifact's own internal content hash
+  (:mod:`.artifacts` format v1);
+- **training-window metadata** (labels trained on, source, wall time);
+- **lineage** (parent version — the champion a candidate was warm-started
+  from).
+
+The **champion pointer** (``champion.json``) records which version is
+serving plus the promotion history, so ``rollback()`` is one atomic
+pointer move back to the previous champion — no artifact bytes move.
+``rtfds_model_version{role=champion|candidate}`` exports both sides of
+the canary.
+
+Storage reuses the checkpoint lineage backends (:mod:`.checkpoint`):
+local directory (tmp write + atomic rename) or any :mod:`.store` object
+store — the store plane inherits PR 6's flaky-store hardening (retries
+with original-typed propagation, optional per-op timeout) for free.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import re
+import threading
+import time
+import uuid
+from typing import List, Optional
+
+from real_time_fraud_detection_system_tpu.io.artifacts import (
+    CorruptModelError,
+    dump_model_bytes,
+    load_model_bytes,
+)
+from real_time_fraud_detection_system_tpu.io.checkpoint import (
+    _LocalBackend,
+    _StoreBackend,
+)
+from real_time_fraud_detection_system_tpu.utils.metrics import (
+    active_recorder,
+    get_registry,
+)
+
+CHAMPION_KEY = "champion.json"
+_ENTRY_RE = re.compile(r"^model-v(\d{7})\.json$")
+
+
+def _name_of(version: int, ext: str) -> str:
+    return f"model-v{int(version):07d}.{ext}"
+
+
+class ModelRegistry:
+    """Append-only versioned artifacts + an atomic champion pointer.
+
+    One writer per version (the streaming learner publishes candidates;
+    the controller/CLI moves the pointer); reads verify everything.
+    Thread-safe: ``publish`` runs on the learner's worker thread while
+    the serving loop promotes/gets.
+    """
+
+    def __init__(self, backend):
+        self._backend = backend
+        # Two narrow locks instead of one registry-wide lock: version
+        # allocation (shared by the learner's worker-thread publish and
+        # the loop thread's reload publish) and the champion pointer
+        # (loop thread / CLI). The artifact PUTs themselves run OUTSIDE
+        # any lock — on a store backend they carry retries and per-op
+        # timeouts, and a hung learner PUT must never block the serving
+        # loop's promote()/rollback() for the whole retry budget.
+        self._alloc_lock = threading.Lock()
+        self._ptr_lock = threading.Lock()
+        # Intra-process allocation floor: versions handed out by THIS
+        # process whose writes may still be in flight (the PUTs run
+        # outside the lock). Allocation re-lists the backend every time
+        # instead of caching a next-version counter: another PROCESS
+        # (`rtfds registry --publish` beside a serving run) may have
+        # taken versions since, and a stale cached counter would
+        # silently overwrite its artifact. The remaining cross-process
+        # window is one exists-check→write race between two truly
+        # simultaneous publishes — far outside the one-serving-loop +
+        # occasional-CLI operational model.
+        self._alloc_floor = 0
+        reg = get_registry()
+        self._m_ops = {
+            op: reg.counter("rtfds_model_registry_ops_total",
+                            "model registry operations", op=op)
+            for op in ("publish", "get", "promote", "rollback")
+        }
+        self._m_corrupt = {
+            r: reg.counter(
+                "rtfds_model_artifact_corrupt_total",
+                "model artifacts that failed load-time verification",
+                reason=r)
+            for r in ("checksum", "truncated")
+        }
+        self._g_version = {
+            role: reg.gauge(
+                "rtfds_model_version",
+                "registry model version by role (champion = serving, "
+                "candidate = newest published)", role=role)
+            for role in ("champion", "candidate")
+        }
+        ch = self.champion_version()
+        if ch is not None:
+            self._g_version["champion"].set(ch)
+        vs = self.versions()
+        if vs:
+            self._g_version["candidate"].set(vs[-1])
+
+    # -- listing ----------------------------------------------------------
+
+    def versions(self) -> List[int]:
+        """Live versions, oldest → newest."""
+        out = []
+        for n in self._backend.list_names():
+            m = _ENTRY_RE.match(n)
+            if m is not None:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def meta(self, version: int) -> dict:
+        """The side manifest of one version. Raises ``KeyError`` when the
+        version does not exist and :class:`CorruptModelError` (reason
+        ``truncated``) when the manifest bytes exist but do not parse —
+        a torn manifest PUT must surface as corruption the promotion
+        gate refuses, never as a stray ``ValueError`` that kills the
+        serving loop."""
+        data = self._backend.read(_name_of(version, "json"))
+        try:
+            man = json.loads(data.decode())
+        except (ValueError, UnicodeDecodeError) as e:
+            raise CorruptModelError(
+                "truncated",
+                f"manifest for v{version} is unreadable "
+                f"({type(e).__name__}: {e})") from None
+        if not isinstance(man, dict):
+            raise CorruptModelError(
+                "truncated", f"manifest for v{version} is not an object")
+        return man
+
+    # -- publish ----------------------------------------------------------
+
+    def publish(self, model, parent: Optional[int] = None,
+                source: str = "learner", labels_trained: int = 0,
+                note: str = "") -> int:
+        """Serialize + register a new version; returns it.
+
+        The artifact npz lands FIRST, the side manifest second — a crash
+        in between leaves an unlisted orphan npz, never a manifest that
+        names missing bytes."""
+        data = dump_model_bytes(model)
+        sha = hashlib.sha256(data).hexdigest()
+        with self._alloc_lock:
+            vs = self.versions()
+            version = max((vs[-1] + 1) if vs else 1,
+                          self._alloc_floor + 1)
+            while (self._backend.exists(_name_of(version, "npz"))
+                   or self._backend.exists(_name_of(version, "json"))):
+                # an unlisted orphan npz (concurrent publish mid-write,
+                # or a crash between npz and manifest): never reuse its
+                # number
+                version += 1
+            self._alloc_floor = version
+        # The (possibly slow, retried) artifact writes run unlocked: the
+        # allocated version is already unique, and the loop thread's
+        # pointer ops must not queue behind a hung store PUT.
+        self._backend.write(_name_of(version, "npz"), data)
+        manifest = {
+            "version": version,
+            "kind": model.kind,
+            "sha256": sha,
+            "size": len(data),
+            "created_unix": time.time(),
+            "parent": parent,
+            "source": source,
+            "labels_trained": int(labels_trained),
+            "note": note,
+        }
+        self._backend.write(
+            _name_of(version, "json"),
+            json.dumps(manifest, sort_keys=True,
+                       separators=(",", ":")).encode())
+        self._m_ops["publish"].inc()
+        self._g_version["candidate"].set(version)
+        rec = active_recorder()
+        if rec is not None:
+            rec.record_event("model_published", version=version,
+                             kind=model.kind, parent=parent, source=source,
+                             labels_trained=int(labels_trained))
+        return version
+
+    # -- verified get -----------------------------------------------------
+
+    def _note_corrupt(self, version: int, err: CorruptModelError) -> None:
+        self._m_corrupt[err.reason].inc()
+        rec = active_recorder()
+        if rec is not None:
+            rec.record_event("model_artifact_corrupt", version=version,
+                             reason=err.reason, detail=err.detail[:200])
+        from real_time_fraud_detection_system_tpu.utils.logging import (
+            get_logger,
+        )
+
+        get_logger("registry").error(
+            "corrupt model artifact v%d (%s: %s) — quarantining",
+            version, err.reason, err.detail[:200])
+        token = uuid.uuid4().hex[:8]
+        for ext in ("npz", "json"):
+            name = _name_of(version, ext)
+            if self._backend.exists(name):
+                self._backend.move(name, f"stale-{token}-{name}")
+
+    @staticmethod
+    def _verify_bytes(man: dict, data: bytes):
+        """The ONE verification core (promotion gate and deploy preflight
+        must agree): manifest size, manifest sha256, then the artifact's
+        own internal content hash via ``load_model_bytes``. Raises
+        :class:`CorruptModelError`; returns the loaded model."""
+        if man.get("size") is not None and len(data) != int(man["size"]):
+            raise CorruptModelError(
+                "truncated",
+                f"artifact is {len(data)} bytes, manifest says "
+                f"{man['size']}")
+        if hashlib.sha256(data).hexdigest() != man.get("sha256"):
+            raise CorruptModelError(
+                "checksum", "artifact bytes do not match the "
+                "manifest sha256")
+        return load_model_bytes(data)  # internal hash re-verified
+
+    def get(self, version: int):
+        """Load version → ``TrainedModel``, verifying the registry-level
+        sha256 AND the artifact's internal content hash. On any mismatch
+        the entry is quarantined (``stale-…``, bytes preserved) and
+        :class:`CorruptModelError` raises — the caller (promotion gate,
+        shadow install) must refuse, never serve, a bad artifact.
+        Raises ``KeyError`` for a version that does not exist."""
+        try:
+            man = self.meta(version)
+        except CorruptModelError as e:
+            self._note_corrupt(version, e)
+            raise
+        try:
+            data = self._backend.read(_name_of(version, "npz"))
+        except KeyError:
+            err = CorruptModelError(
+                "truncated", f"artifact bytes for v{version} are missing")
+            self._note_corrupt(version, err)
+            raise err from None
+        try:
+            model = self._verify_bytes(man, data)
+        except CorruptModelError as e:
+            self._note_corrupt(version, e)
+            raise
+        self._m_ops["get"].inc()
+        return model
+
+    # -- champion pointer -------------------------------------------------
+
+    def _read_pointer(self) -> Optional[dict]:
+        """The champion pointer, or None when none was ever written.
+
+        A pointer whose bytes exist but do not parse (torn PUT) is NOT
+        absence — treating it as absence would silently revert serving
+        to the bootstrap model and let the next ``promote`` rebuild an
+        empty history, destroying rollback. It is quarantined
+        (``stale-…``, bytes preserved), counted
+        (``rtfds_model_artifact_corrupt_total{reason=truncated}``) and
+        logged; only then does the registry proceed as pointerless —
+        loud degradation, the same contract as a corrupt artifact."""
+        try:
+            data = self._backend.read(CHAMPION_KEY)
+        except KeyError:
+            return None
+        try:
+            ptr = json.loads(data.decode())
+            if not isinstance(ptr, dict) or "version" not in ptr:
+                raise ValueError("not a pointer object")
+            return ptr
+        except (ValueError, UnicodeDecodeError) as e:
+            self._m_corrupt["truncated"].inc()
+            rec = active_recorder()
+            if rec is not None:
+                rec.record_event("model_pointer_corrupt",
+                                 detail=str(e)[:200])
+            from real_time_fraud_detection_system_tpu.utils.logging import (
+                get_logger,
+            )
+
+            token = uuid.uuid4().hex[:8]
+            stale = f"stale-{token}-{CHAMPION_KEY}"
+            try:
+                self._backend.move(CHAMPION_KEY, stale)
+            except Exception:  # noqa: BLE001 — quarantine is best-effort
+                stale = "(could not quarantine)"
+            get_logger("registry").error(
+                "champion pointer is unreadable (%s: %s) — quarantined "
+                "to %s; serving falls back to the bootstrap model and "
+                "promotion history is lost (recover it from the "
+                "quarantined file, then `rtfds registry --promote`)",
+                type(e).__name__, e, stale)
+            return None
+
+    def _write_pointer(self, ptr: dict) -> None:
+        self._backend.write(
+            CHAMPION_KEY,
+            json.dumps(ptr, sort_keys=True, separators=(",", ":")).encode())
+
+    def champion_version(self) -> Optional[int]:
+        ptr = self._read_pointer()
+        return int(ptr["version"]) if ptr else None
+
+    def champion(self):
+        """Verified ``TrainedModel`` of the serving champion, or None."""
+        v = self.champion_version()
+        return self.get(v) if v is not None else None
+
+    def promote(self, version: int, by: str = "controller") -> dict:
+        """Move the champion pointer to ``version`` (must exist). The
+        previous champion is pushed on the pointer's history stack so
+        :meth:`rollback` is one pointer move. Does NOT verify bytes —
+        the promotion gate calls :meth:`get` first (a promote of
+        unverified bytes is the caller's bug)."""
+        self.meta(version)  # existence check: KeyError on a ghost
+        with self._ptr_lock:
+            ptr = self._read_pointer() or {"history": []}
+            prev = ptr.get("version")
+            hist = list(ptr.get("history", []))
+            if prev is not None:
+                hist.append(int(prev))
+            ptr = {"version": int(version), "history": hist,
+                   "promoted_unix": time.time(), "by": by}
+            self._write_pointer(ptr)
+        self._m_ops["promote"].inc()
+        self._g_version["champion"].set(version)
+        return ptr
+
+    def rollback(self) -> Optional[int]:
+        """Pop the pointer back to the previous champion; returns the
+        restored version, or None when there is no history to return
+        to. The abandoned champion's artifact stays in the registry
+        (forensics + the lineage record of what served when)."""
+        with self._ptr_lock:
+            ptr = self._read_pointer()
+            if not ptr or not ptr.get("history"):
+                return None
+            hist = list(ptr["history"])
+            prev = int(hist.pop())
+            self._write_pointer({"version": prev, "history": hist,
+                                 "promoted_unix": time.time(),
+                                 "by": "rollback"})
+        self._m_ops["rollback"].inc()
+        self._g_version["champion"].set(prev)
+        return prev
+
+    # -- verification (CLI preflight) -------------------------------------
+
+    def list_versions(self) -> List[dict]:
+        """One row per live version (cheap: manifests only), champion
+        flagged."""
+        ch = self.champion_version()
+        out = []
+        for v in self.versions():
+            try:
+                man = self.meta(v)
+            except (KeyError, CorruptModelError):
+                man = {"version": v, "error": "manifest unreadable"}
+            man["role"] = "champion" if v == ch else "candidate"
+            out.append(man)
+        return out
+
+    def verify_all(self) -> List[dict]:
+        """Re-hash every live artifact against its manifest WITHOUT
+        quarantining or counting metrics (``rtfds registry --verify`` —
+        the deploy preflight; exit 1 on any corruption)."""
+        out = []
+        ch = self.champion_version()
+        for v in self.versions():
+            entry = {"version": v,
+                     "role": "champion" if v == ch else "candidate"}
+            try:
+                man = self.meta(v)
+                data = self._backend.read(_name_of(v, "npz"))
+                self._verify_bytes(man, data)
+                entry.update(kind=man.get("kind"), size=man.get("size"),
+                             parent=man.get("parent"),
+                             source=man.get("source"),
+                             labels_trained=man.get("labels_trained"),
+                             valid=True)
+            except CorruptModelError as e:
+                entry.update(valid=False, reason=e.reason,
+                             detail=e.detail[:200])
+            except KeyError:
+                entry.update(valid=False, reason="truncated",
+                             detail="artifact or manifest missing")
+            out.append(entry)
+        return out
+
+
+def make_model_registry(path_or_url: str, op_timeout_s: float = 0.0,
+                        op_attempts: int = 3) -> ModelRegistry:
+    """``s3://bucket/prefix`` → store-backed registry (flaky-store
+    hardened); local path → directory-backed registry."""
+    if path_or_url.startswith("s3://"):
+        from real_time_fraud_detection_system_tpu.io.store import make_store
+
+        return ModelRegistry(
+            _StoreBackend(make_store(path_or_url), prefix="",
+                          op_timeout_s=op_timeout_s,
+                          op_attempts=op_attempts))
+    return ModelRegistry(_LocalBackend(path_or_url))

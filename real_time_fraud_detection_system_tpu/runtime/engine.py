@@ -145,6 +145,13 @@ class EngineState:
     # travel WITH the state). Checkpoints record it; restore compares it
     # to the serving engine's own width and auto-reshards on mismatch.
     layout_devices: int = 1
+    # Registry version the params descend from (continuous learning).
+    # Travels WITH the state so a checkpoint restore tells the learning
+    # loop exactly which champion the restored params are: a crash
+    # between a promotion/reload swap and the next save restores
+    # pre-swap weights, and the stamp mismatch is how attach() knows to
+    # re-apply the registry champion instead of serving them stale.
+    model_version: Optional[int] = None
 
 
 @dataclass
@@ -479,6 +486,26 @@ class ScoringEngine:
         # Per-bucket zero feature matrices, shared read-only across
         # batches (see _zero_features).
         self._zeros_cache: dict = {}
+        # Continuous-learning hooks (runtime/learner.py): a ShadowScorer
+        # dual-scores emitted batches beside the champion; feedback_tap
+        # hands labeled rows to the streaming learner. Both None unless
+        # a LearningLoop attaches.
+        self.shadow = None
+        self.feedback_tap = None
+        # Param-swap accounting (hot reload × online SGD): True once any
+        # online update (in-step SGD on labeled rows, or a feedback SGD
+        # step) landed since the last wholesale params swap — a reload
+        # then CLOBBERS those updates, and the operator must be able to
+        # count it, not read a one-time warning.
+        self._online_dirty = False
+        self._m_reloads = {
+            o: reg.counter(
+                "rtfds_model_reloads_total",
+                "hot model reloads by outcome (clobbered_online_updates "
+                "= the swap discarded on-device online-SGD updates "
+                "accumulated since the previous artifact)", outcome=o)
+            for o in ("clean", "clobbered_online_updates")
+        }
 
     # -- AOT bucket precompilation ----------------------------------------
 
@@ -568,6 +595,30 @@ class ScoringEngine:
             self._aot = {}
             self._aot_params_sig = None
         return params
+
+    def set_shadow(self, shadow) -> None:
+        """Attach a shadow scorer (``runtime/learner.ShadowScorer``): the
+        candidate dual-scores every emitted batch on the SAME host
+        feature rows. Needs the full f32 feature matrix host-side —
+        exactly the modes the feedback loop already requires."""
+        if self.kind == "sequence":
+            raise ValueError(
+                "shadow scoring is not wired for kind='sequence' "
+                "(no host-side feature matrix to dual-score)")
+        if not self.cfg.runtime.emit_features or self._selective:
+            raise ValueError(
+                "shadow scoring consumes every row's features host-side; "
+                "it does not compose with alerts-only or selective "
+                "emission")
+        if self.cfg.runtime.emit_dtype != "float32":
+            raise ValueError(
+                "shadow scoring re-consumes the emitted features; "
+                "emit_dtype='bfloat16' would drift the candidate's "
+                "scores — keep float32")
+        self.shadow = shadow
+
+    def clear_shadow(self) -> None:
+        self.shadow = None
 
     def _dispatch_step(self, key, jit_fn, *args):
         """Serve from the AOT executable when one exists for ``key``;
@@ -961,6 +1012,20 @@ class ScoringEngine:
                 labeled=(np.asarray(in_band) >= 0)
                 if in_band is not None else None,
             )
+        if self.shadow is not None and n:
+            # Dual-score the SAME host feature rows with the candidate
+            # (runtime/learner.ShadowScorer): one extra jitted predict on
+            # a bucket-padded copy — the serving step's compiled program
+            # is untouched, so shadow mode can never recompile it.
+            with self.tracer.span("shadow_score",
+                                  batch=handle.get("trace_id")):
+                self.shadow.score_batch(cols["tx_id"], feats_np, probs_np)
+        if (self.online_lr > 0.0 and self._loss is not None
+                and cols.get("label") is not None
+                and (np.asarray(cols["label"]) >= 0).any()):
+            # in-step online SGD consumed this batch's in-band labels:
+            # the on-device params now lead the last published artifact
+            self._online_dirty = True
         self.state.batches_done += 1
         self.state.rows_done += n
         self._m_batches.inc()
@@ -1139,6 +1204,9 @@ class ScoringEngine:
                 )
                 if bool(l1 <= l0):
                     self.state.params = new_params
+                    # the on-device params now lead the last published
+                    # artifact: a wholesale reload would clobber this
+                    self._online_dirty = True
                     break
                 step_lr *= 0.5
             # 8 failed halvings: the chunk cannot contract from here
@@ -1155,6 +1223,7 @@ class ScoringEngine:
         heartbeat=None,
         feedback=None,
         model_reload=None,
+        learning=None,
     ) -> dict:
         """Stream until the source is exhausted (or max_batches).
 
@@ -1190,19 +1259,12 @@ class ScoringEngine:
             # before the first poll — no first-touch compile ever lands
             # mid-stream (rtfds_xla_recompiles_total stays 0).
             self.precompile()
-        if model_reload is not None and self.online_lr > 0.0:
-            from real_time_fraud_detection_system_tpu.utils import (
-                get_logger,
-            )
-
-            # params are swapped wholesale on reload: any online-SGD
-            # deltas accumulated since the artifact was written are
-            # silently dropped at each swap — the operator must know
-            get_logger("engine").warning(
-                "hot model reload + online SGD (--online-lr > 0): each "
-                "reload overwrites the on-device weights, discarding "
-                "online-learned updates accumulated since the artifact "
-                "was written")
+        if learning is not None:
+            # Continuous-learning controller (runtime/learner.py):
+            # installs the shadow scorer + learner tap now, then gets
+            # polled once per finished batch (after feedback, before the
+            # checkpoint — the same between-device-steps contract).
+            learning.attach(self)
         trigger = (
             self.cfg.runtime.trigger_seconds
             if trigger_seconds is None
@@ -1324,9 +1386,33 @@ class ScoringEngine:
                 swap = model_reload()
                 if swap is not None:
                     new_params, new_scaler = swap
+                    # Reload × online SGD: a wholesale swap discards any
+                    # on-device SGD updates accumulated since the last
+                    # swap/artifact. That used to be a one-time startup
+                    # warning; now EVERY swap is counted by outcome, so
+                    # the operator can see exactly how many reloads
+                    # clobbered learned updates.
+                    outcome = ("clobbered_online_updates"
+                               if self._online_dirty else "clean")
+                    self._m_reloads[outcome].inc()
+                    self._online_dirty = False
                     self.state.params = self._note_params_swap(new_params)
                     if new_scaler is not None:
                         self.state.scaler = new_scaler
+                    if recorder is not None:
+                        recorder.record_event("model_reload",
+                                              outcome=outcome)
+                    if learning is not None:
+                        # a reload is a versioned event: register the
+                        # swapped params in the registry lineage
+                        # (publish + promote, source=reload)
+                        learning.note_external_swap(
+                            self.state.params, self.state.scaler, outcome,
+                            engine=self)
+            if learning is not None:
+                # candidate install / promotion / rollback decisions ride
+                # the batch cadence, between device steps
+                learning.on_batch(self)
             if checkpointer is not None and self.state.batches_done % every == 0:
                 # Drain an async sink BEFORE the state save: checkpointed
                 # offsets must TRAIL durable sink output (a crash then

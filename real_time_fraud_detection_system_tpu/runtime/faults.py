@@ -556,9 +556,38 @@ class _GuardedSource(_FenceGuard):
         return cols
 
 
+def _fence_model_reload(model_reload, fence: "_AbandonFence"):
+    """Fence a reload poll AND keep the shared signature baseline
+    honest: a poll whose incarnation was abandoned DURING the call (a
+    store GET stalled long enough for the watchdog to give up) may have
+    committed the file's new signature to the cross-incarnation
+    baseline (``poll.sig_state``, ``--learn-registry`` mode) while its
+    swap can never land — every fenced apply path is closed to a
+    zombie. Restore the pre-call signature so the LIVE incarnation's
+    next poll still sees the change. If the live one updated the
+    baseline meanwhile this rolls it back one step and it redundantly
+    re-applies the same artifact next poll — the safe direction;
+    silently losing the update is not."""
+    sig = getattr(model_reload, "sig_state", None)
+
+    def _fenced_reload():
+        fence.check()
+        before = sig.get("sig") if sig is not None else None
+        out = model_reload()
+        try:
+            fence.check()
+        except StallError:
+            if sig is not None:
+                sig["sig"] = before
+            raise
+        return out
+
+    return _fenced_reload
+
+
 def _run_watched(engine, source, sink, checkpointer, max_batches,
                  heartbeat: Heartbeat, feedback=None, model_reload=None,
-                 target=None):
+                 learning=None, target=None):
     """Run one engine incarnation under a stall watchdog.
 
     The engine loop runs in a worker thread beating the heartbeat each
@@ -587,6 +616,14 @@ def _run_watched(engine, source, sink, checkpointer, max_batches,
     g_heartbeat = _FenceGuard(heartbeat, fence)
     g_feedback = _FenceGuard(feedback, fence) if feedback is not None \
         else None
+    # The learning loop outlives incarnations (its learner thread keeps
+    # the replay window warm across restarts) — fence THIS incarnation's
+    # handle so a zombie's promotion decision can never swap params on
+    # the live incarnation's engine.
+    g_learning = _FenceGuard(learning, fence) if learning is not None \
+        else None
+    g_model_reload = (_fence_model_reload(model_reload, fence)
+                      if model_reload is not None else None)
     if getattr(engine, "feature_cache", None) is not None:
         # The cache outlives incarnations (it's how the feedback join
         # finds rows scored before a restart) — fence THIS incarnation's
@@ -594,6 +631,24 @@ def _run_watched(engine, source, sink, checkpointer, max_batches,
         # re-scored (or reset their labeled marks, double-applying
         # additive label scatters).
         engine.feature_cache = _FenceGuard(engine.feature_cache, fence)
+    if learning is not None:
+        # The shadow score cache and learner queue outlive incarnations
+        # just like the feature cache — attach now (idempotent: the
+        # engine.run attach becomes a no-op for this engine) and fence
+        # the handles the attach installed, so a zombie that wakes
+        # mid-_finish can't write stale champion/candidate scores into
+        # the shared shadow cache or stale rows into the learner queue.
+        learning.attach(engine)
+        if engine.shadow is not None:
+            engine.shadow = _FenceGuard(engine.shadow, fence)
+        if engine.feedback_tap is not None:
+            _tap = engine.feedback_tap
+
+            def _fenced_tap(*a, **k):
+                fence.check()
+                return _tap(*a, **k)
+
+            engine.feedback_tap = _fenced_tap
 
     def _target():
         try:
@@ -604,7 +659,8 @@ def _run_watched(engine, source, sink, checkpointer, max_batches,
                 box["stats"] = engine.run(
                     g_source, sink=g_sink, checkpointer=g_ckpt,
                     max_batches=max_batches, heartbeat=g_heartbeat,
-                    feedback=g_feedback, model_reload=model_reload,
+                    feedback=g_feedback, model_reload=g_model_reload,
+                    learning=g_learning,
                 )
         except BaseException as e:  # report into the supervisor thread
             box["err"] = e
@@ -809,6 +865,7 @@ def run_with_recovery(
     make_source: Optional[Callable[[], object]] = None,
     make_feedback: Optional[Callable[[object], object]] = None,
     make_model_reload: Optional[Callable[[], object]] = None,
+    learning=None,
     recover_on: Tuple[Type[BaseException], ...] = (
         TransientError, OSError, ConnectionError,
     ),
@@ -963,6 +1020,11 @@ def run_with_recovery(
             set_sync(True)
         try:
             if poison_pending:
+                # No training overlaps a bisection in progress: the
+                # learner's device work would race the unpipelined
+                # probe steps' timing diagnosis.
+                if learning is not None:
+                    learning.pause()
                 if heartbeat is not None:
                     # Isolation under the same stall watchdog + zombie
                     # fencing as a normal incarnation: a batch that HANGS
@@ -988,17 +1050,20 @@ def run_with_recovery(
                 budget_used = 0
                 if set_sync is not None:
                     set_sync(False)  # fast (prefetched) mode resumes
+                if learning is not None:
+                    learning.resume()
                 continue
             if heartbeat is not None:
                 stats = _run_watched(
                     engine, source, sink, checkpointer, max_batches,
                     heartbeat, feedback=feedback, model_reload=model_reload,
+                    learning=learning,
                 )
             else:
                 stats = engine.run(
                     source, sink=sink, checkpointer=checkpointer,
                     max_batches=max_batches, feedback=feedback,
-                    model_reload=model_reload,
+                    model_reload=model_reload, learning=learning,
                 )
             # Final checkpoint so a clean exit never replays.
             checkpointer.save(engine.state)

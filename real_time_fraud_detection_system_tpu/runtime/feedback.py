@@ -107,6 +107,30 @@ class FeatureCache:
         self.capacity = int(capacity)
         self._feat = np.zeros((self.capacity, n_features), dtype=np.float32)
         self._ids = np.full(self.capacity, -1, dtype=np.int64)
+        # Telemetry: shadow/feedback quality silently degrades when
+        # labeled rows miss this cache (their labels are dropped, so the
+        # live precision/recall windows starve) — the operator needs the
+        # occupancy/eviction/hit-rate picture, not a guess. Occupancy is
+        # tracked incrementally (a 1M-slot scan per batch would not be).
+        reg = get_registry()
+        reg.gauge("rtfds_feature_cache_capacity",
+                  "feature cache slot capacity").set(self.capacity)
+        self._g_occupancy = reg.gauge(
+            "rtfds_feature_cache_occupancy",
+            "feature cache slots currently holding a scored row")
+        self._m_evictions = reg.counter(
+            "rtfds_feature_cache_evictions_total",
+            "cached rows overwritten by a colliding insert before their "
+            "label arrived (labels for evicted rows are dropped)")
+        self._m_lookups = {
+            o: reg.counter(
+                "rtfds_feature_cache_lookups_total",
+                "feedback label → cache joins by outcome (a rising miss "
+                "share means labels arrive after eviction: raise "
+                "capacity)", outcome=o)
+            for o in ("hit", "miss")
+        }
+        self._occupancy = 0
         # Aux columns for state-level feedback (terminal risk windows need
         # the original transaction's terminal + day, features/online.py::
         # apply_feedback).
@@ -136,6 +160,20 @@ class FeatureCache:
         tx_ids = np.asarray(tx_ids, dtype=np.int64)
         n = len(tx_ids)
         slots = tx_ids % self.capacity
+        if n:
+            # Occupancy/eviction accounting against the PRE-insert state,
+            # per distinct slot (fancy assignment below is last-wins for
+            # colliding slots within one batch — mirror that): a slot
+            # that was empty fills, a slot holding a DIFFERENT live tx
+            # evicts it (that row's label can now never land).
+            uslots, first_rev = np.unique(slots[::-1], return_index=True)
+            new_ids = tx_ids[n - 1 - first_rev]
+            prev = self._ids[uslots]
+            self._occupancy += int((prev < 0).sum())
+            self._g_occupancy.set(self._occupancy)
+            evicted = int(((prev >= 0) & (prev != new_ids)).sum())
+            if evicted:
+                self._m_evictions.inc(evicted)
         self._ids[slots] = tx_ids
         self._feat[slots] = features
         self._terminal[slots] = (
@@ -169,6 +207,11 @@ class FeatureCache:
         slots = tx_ids % self.capacity
         # tx_ids < 0 would alias the empty-slot sentinel: always a miss.
         hit = (self._ids[slots] == tx_ids) & (tx_ids >= 0)
+        n_hit = int(hit.sum())
+        if n_hit:
+            self._m_lookups["hit"].inc(n_hit)
+        if len(tx_ids) - n_hit:
+            self._m_lookups["miss"].inc(len(tx_ids) - n_hit)
         sel = slots[hit]
         return (self._feat[sel], self._terminal[sel], self._day[sel], hit,
                 self._labeled[sel])
@@ -356,6 +399,14 @@ class FeedbackLoop:
             self.stats["duplicates"] += dup
             self._m_stats["duplicates"].inc(dup)
             tx_ids, labels = tx_ids[keep], labels[keep]
+        shadow = getattr(self.engine, "shadow", None)
+        if shadow is not None and len(tx_ids):
+            # Join the labels to BOTH models' cached decisions (the
+            # shadow keeps its own tx_id → (champion, candidate) score
+            # cache): this is what makes rtfds_live_precision/recall
+            # live. Its cache consumes each entry once, so re-delivered
+            # labels can't double-count the confusion windows.
+            shadow.observe_labels(tx_ids, labels)
         feats, term_ids, days, hit, done = self.cache.get_batch_full(tx_ids)
         n_hit = int(hit.sum())
         self.stats["missed"] += len(tx_ids) - n_hit
@@ -379,6 +430,12 @@ class FeedbackLoop:
         #    differentiable kinds — tree ensembles learn via retraining.
         if self.engine.supports_online_sgd:
             self.engine.apply_feedback(feats[fresh], y)
+        # 3) streaming learner tap: the SAME (raw features, label) rows
+        #    the champion just learned from go to the candidate's replay
+        #    window — one bounded-queue enqueue, never a block.
+        tap = getattr(self.engine, "feedback_tap", None)
+        if tap is not None:
+            tap(feats[fresh], y)
         self.cache.mark_labeled(tx_ids[hit][fresh])
         n_labeled = int(len(y))
         self.stats["applied"] += n_labeled

@@ -607,6 +607,63 @@ class MetricsServer:
             v = self.registry.family_total(fam)
             if v is not None:
                 extras[key] = v
+        # Feedback feature cache: shadow/live precision-recall quality
+        # silently degrades when labeled rows miss the cache (their
+        # labels are dropped on the floor) — surface the hit rate so the
+        # operator can SEE it, not infer it from starved metric windows.
+        c_hit = self.registry.get("rtfds_feature_cache_lookups_total",
+                                  outcome="hit")
+        c_miss = self.registry.get("rtfds_feature_cache_lookups_total",
+                                   outcome="miss")
+        if c_hit is not None or c_miss is not None:
+            hits = c_hit.value if c_hit is not None else 0.0
+            misses = c_miss.value if c_miss is not None else 0.0
+            total = hits + misses
+            cache: Dict[str, float] = {
+                "hit_rate": round(hits / total, 4) if total else 1.0,
+                "lookups": total,
+            }
+            occ = self.registry.get("rtfds_feature_cache_occupancy")
+            cap = self.registry.get("rtfds_feature_cache_capacity")
+            if occ is not None:
+                cache["occupancy"] = occ.value
+            if cap is not None:
+                cache["capacity"] = cap.value
+            ev = self.registry.family_total(
+                "rtfds_feature_cache_evictions_total")
+            if ev is not None:
+                cache["evictions"] = ev
+            extras["feature_cache"] = cache
+        # Continuous-learning plane: which versions are serving/shadowing
+        # and whether promotions/rollbacks have fired — present only once
+        # a registry/learning loop exists, so other runs stay clean.
+        champ = self.registry.get("rtfds_model_version", role="champion")
+        if champ is not None:
+            learning: Dict[str, float] = {
+                "champion_version": champ.value}
+            cand = self.registry.get("rtfds_model_version",
+                                     role="candidate")
+            if cand is not None:
+                learning["candidate_version"] = cand.value
+            # promotions/refusals are DIFFERENT outcomes of one family —
+            # summing them would report a refused corrupt candidate as a
+            # successful promotion
+            for outcome, key in (("promoted", "promotions"),
+                                 ("refused_corrupt", "refusals")):
+                m = self.registry.get("rtfds_model_promotions_total",
+                                      outcome=outcome)
+                if m is not None:
+                    learning[key] = m.value
+            for fam, key in (
+                    ("rtfds_model_rollbacks_total", "rollbacks"),
+                    ("rtfds_shadow_divergence_total",
+                     "shadow_divergence"),
+                    ("rtfds_model_artifact_corrupt_total",
+                     "model_artifact_corrupt")):
+                v = self.registry.family_total(fam)
+                if v is not None:
+                    learning[key] = v
+            extras["learning"] = learning
         status = "ok" if ok else "unhealthy"
         if ok and extras.get("dead_letter_rows", 0) > 0:
             # alive and progressing, but quarantined rows await triage

@@ -597,6 +597,126 @@ def test_model_reloader_semantics(tmp_path, monkeypatch):
     assert r3() is None
 
 
+def test_model_reloader_shared_sig_survives_restart(tmp_path):
+    """--learn-registry mode: the signature baseline is seeded ONCE and
+    shared across supervisor incarnations. A file update landing between
+    the previous incarnation's last poll and its crash must still be
+    applied by the next incarnation — a per-incarnation re-baseline
+    would capture the NEW file's signature and silently drop the update
+    forever."""
+    import logging
+    import os
+    import time
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from real_time_fraud_detection_system_tpu.cli import _make_model_reloader
+    from real_time_fraud_detection_system_tpu.io.artifacts import save_model
+    from real_time_fraud_detection_system_tpu.models.logreg import (
+        LogRegParams,
+    )
+    from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+    from real_time_fraud_detection_system_tpu.models.train import TrainedModel
+
+    log = logging.getLogger("t")
+    path = str(tmp_path / "m.npz")
+
+    def write(w0, bump_ns=0):
+        save_model(path, TrainedModel(
+            kind="logreg",
+            scaler=Scaler(mean=jnp.zeros(15), scale=jnp.ones(15)),
+            params=LogRegParams(w=jnp.full(15, w0), b=jnp.zeros(()))))
+        if bump_ns:
+            os.utime(path, ns=(time.time_ns(), time.time_ns() + bump_ns))
+
+    write(1.0)
+    sig: dict = {}
+    r1 = _make_model_reloader(path, "logreg", every_batches=1, log=log,
+                              seed_initial=True, sig_state=sig)
+    # seeded baseline: no forced first reload (the registry champion,
+    # not the bootstrap file, is what should serve)
+    assert r1() is None
+    # the update lands; the incarnation crashes BEFORE its next poll
+    write(2.0, bump_ns=10**9)
+    r2 = _make_model_reloader(path, "logreg", every_batches=1, log=log,
+                              seed_initial=True, sig_state=sig)
+    got = r2()  # next incarnation: baseline survives → change detected
+    assert got is not None
+    np.testing.assert_allclose(np.asarray(got[0].w), 2.0)
+    assert r2() is None  # the applied signature gates from here
+
+
+def test_zombie_reloader_cannot_poison_shared_sig(tmp_path):
+    """A reload poll whose incarnation is abandoned MID-CALL (store GET
+    stalled past the watchdog) commits the new file signature to the
+    shared cross-incarnation baseline, but its swap can never land
+    (fenced). The fence wrapper must restore the pre-call signature so
+    the LIVE incarnation's next poll still detects the update — else
+    the update is silently dropped forever."""
+    import logging
+    import os
+    import time
+
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+
+    from real_time_fraud_detection_system_tpu.cli import _make_model_reloader
+    from real_time_fraud_detection_system_tpu.io.artifacts import save_model
+    from real_time_fraud_detection_system_tpu.models.logreg import (
+        LogRegParams,
+    )
+    from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+    from real_time_fraud_detection_system_tpu.models.train import TrainedModel
+    from real_time_fraud_detection_system_tpu.runtime.faults import (
+        StallError,
+        _AbandonFence,
+        _fence_model_reload,
+    )
+
+    log = logging.getLogger("t")
+    path = str(tmp_path / "m.npz")
+
+    def write(w0, bump_ns=0):
+        save_model(path, TrainedModel(
+            kind="logreg",
+            scaler=Scaler(mean=jnp.zeros(15), scale=jnp.ones(15)),
+            params=LogRegParams(w=jnp.full(15, w0), b=jnp.zeros(()))))
+        if bump_ns:
+            os.utime(path, ns=(time.time_ns(), time.time_ns() + bump_ns))
+
+    write(1.0)
+    sig: dict = {}
+    zombie_poll = _make_model_reloader(path, "logreg", every_batches=1,
+                                       log=log, seed_initial=True,
+                                       sig_state=sig)
+    fence = _AbandonFence()
+    fenced = _fence_model_reload(zombie_poll, fence)
+    assert fenced() is None  # seeded: no forced first reload
+
+    # the update lands while the zombie is mid-poll; the watchdog
+    # abandons it before the poll returns
+    orig_poll = zombie_poll
+
+    def abandoned_mid_call():
+        write(2.0, bump_ns=10**9)
+        fence.abandoned = True
+        return orig_poll()
+
+    abandoned_mid_call.sig_state = sig
+    fenced2 = _fence_model_reload(abandoned_mid_call, fence)
+    with pytest.raises(StallError):
+        fenced2()
+    # the zombie's swap never landed, and neither did its sig commit
+    live_poll = _make_model_reloader(path, "logreg", every_batches=1,
+                                     log=log, seed_initial=True,
+                                     sig_state=sig)
+    got = live_poll()
+    assert got is not None
+    np.testing.assert_allclose(np.asarray(got[0].w), 2.0)
+
+
 def test_model_reloader_s3_head_gates_get(tmp_path, monkeypatch):
     """s3:// reload polling: an unchanged artifact costs one HEAD per
     interval, never a GET — the full download happens only when the
@@ -759,3 +879,332 @@ def test_cli_score_nan_guard_flag_validation(tmp_path, capsys):
                    "--model-file", "m.npz", "--nan-guard"])
     assert rc == 2  # --nan-guard without --dead-letter
     capsys.readouterr()
+
+
+def test_load_model_v0_unhashed_back_compat(tmp_path):
+    """Artifacts written before the content-hash stamp (v0: no
+    ``format`` / ``content_sha256`` in the meta) still load — existing
+    deployments upgrade in place on their next save, which is stamped
+    v1."""
+    import io
+
+    import jax.numpy as jnp
+
+    from real_time_fraud_detection_system_tpu.io.artifacts import (
+        ARTIFACT_FORMAT,
+        dump_model_bytes,
+        load_model,
+        load_model_bytes,
+    )
+    from real_time_fraud_detection_system_tpu.models.logreg import (
+        init_logreg,
+    )
+    from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+    from real_time_fraud_detection_system_tpu.models.train import (
+        TrainedModel,
+    )
+
+    model = TrainedModel(
+        kind="logreg",
+        scaler=Scaler(mean=jnp.zeros(15), scale=jnp.ones(15)),
+        params=init_logreg(15, seed=5))
+    data = dump_model_bytes(model)
+    with np.load(io.BytesIO(data), allow_pickle=False) as z:
+        meta = json.loads(str(z["__meta__"]))
+        arrays = {k: z[k] for k in z.files if k != "__meta__"}
+    # strip the v1 stamps → a byte-faithful v0 (pre-hash) artifact
+    assert meta.pop("format") == ARTIFACT_FORMAT
+    assert meta.pop("content_sha256")
+    buf = io.BytesIO()
+    np.savez(buf, __meta__=json.dumps(meta), **arrays)
+    v0_bytes = buf.getvalue()
+
+    got = load_model_bytes(v0_bytes)
+    assert got.kind == "logreg"
+    np.testing.assert_allclose(np.asarray(got.params.w),
+                               np.asarray(model.params.w))
+    # the file path loads too (no quarantine on a healthy v0)
+    path = tmp_path / "v0.npz"
+    path.write_bytes(v0_bytes)
+    assert load_model(str(path)).kind == "logreg"
+    assert path.exists()
+    # its next save is stamped v1 with a verifiable content hash
+    with np.load(io.BytesIO(dump_model_bytes(got)),
+                 allow_pickle=False) as z2:
+        meta2 = json.loads(str(z2["__meta__"]))
+    assert meta2["format"] == ARTIFACT_FORMAT
+    assert len(meta2["content_sha256"]) == 64
+
+
+def test_cli_registry_list_inspect_promote_rollback_verify(tmp_path,
+                                                           capsys):
+    """`rtfds registry`: list shows lineage + roles, --inspect dumps one
+    manifest, --promote verifies then moves the champion pointer,
+    --rollback pops it, and --verify exits 1 on a corrupt artifact —
+    which --promote then refuses."""
+    import jax.numpy as jnp
+
+    from real_time_fraud_detection_system_tpu.io.registry import (
+        make_model_registry,
+    )
+    from real_time_fraud_detection_system_tpu.models.logreg import (
+        init_logreg,
+    )
+    from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+    from real_time_fraud_detection_system_tpu.models.train import (
+        TrainedModel,
+    )
+
+    def _m(seed):
+        return TrainedModel(
+            kind="logreg",
+            scaler=Scaler(mean=jnp.zeros(15), scale=jnp.ones(15)),
+            params=init_logreg(15, seed=seed))
+
+    root = str(tmp_path)
+    reg = make_model_registry(root)
+    v1 = reg.publish(_m(0), source="bootstrap")
+    reg.publish(_m(1), parent=v1, source="learner", labels_trained=64)
+    reg.promote(v1, by="bootstrap")
+
+    rc = cli_main(["--platform", "cpu", "registry", "--path", root])
+    assert rc == 0
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    assert lines[0]["champion"] == 1
+    assert [r["version"] for r in lines[1:]] == [1, 2]
+    assert [r["role"] for r in lines[1:]] == ["champion", "candidate"]
+
+    rc = cli_main(["--platform", "cpu", "registry", "--path", root,
+                   "--inspect", "2"])
+    assert rc == 0
+    man = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert man["parent"] == 1 and man["source"] == "learner"
+    assert man["labels_trained"] == 64
+
+    rc = cli_main(["--platform", "cpu", "registry", "--path", root,
+                   "--promote", "2"])
+    assert rc == 0
+    ptr = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert ptr["version"] == 2 and ptr["history"] == [1]
+
+    rc = cli_main(["--platform", "cpu", "registry", "--path", root,
+                   "--rollback"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["champion"] == 1
+
+    # rot the candidate: --verify is the deploy preflight and exits 1
+    npz = tmp_path / "model-v0000002.npz"
+    data = bytearray(npz.read_bytes())
+    data[len(data) // 2] ^= 0xFF
+    npz.write_bytes(bytes(data))
+    rc = cli_main(["--platform", "cpu", "registry", "--path", root,
+                   "--verify"])
+    assert rc == 1
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    assert lines[0]["corrupt"] == 1
+    bad = [e for e in lines[1:] if not e["valid"]]
+    assert [e["version"] for e in bad] == [2]
+
+    # a corrupt candidate can never be promoted, from the CLI either
+    rc = cli_main(["--platform", "cpu", "registry", "--path", root,
+                   "--promote", "2"])
+    assert rc == 1
+    capsys.readouterr()
+    rc = cli_main(["--platform", "cpu", "registry", "--path", root])
+    assert rc == 0
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    assert lines[0]["champion"] == 1
+    assert [r["version"] for r in lines[1:]] == [1]  # v2 quarantined
+
+
+def test_cli_registry_publish_external_candidate(tmp_path, capsys):
+    """`rtfds registry --publish m.npz`: the offline-retrain entry point
+    (tree kinds) — the artifact is verified, registered as a candidate
+    with the champion as parent, and a corrupt file is refused."""
+    import jax.numpy as jnp
+
+    from real_time_fraud_detection_system_tpu.io.artifacts import save_model
+    from real_time_fraud_detection_system_tpu.io.registry import (
+        make_model_registry,
+    )
+    from real_time_fraud_detection_system_tpu.models.logreg import (
+        init_logreg,
+    )
+    from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+    from real_time_fraud_detection_system_tpu.models.train import (
+        TrainedModel,
+    )
+
+    root = str(tmp_path / "reg")
+    reg = make_model_registry(root)
+    v1 = reg.publish(TrainedModel(
+        kind="logreg",
+        scaler=Scaler(mean=jnp.zeros(15), scale=jnp.ones(15)),
+        params=init_logreg(15, seed=0)), source="bootstrap")
+    reg.promote(v1, by="bootstrap")
+
+    mfile = tmp_path / "retrained.npz"
+    save_model(str(mfile), TrainedModel(
+        kind="logreg",
+        scaler=Scaler(mean=jnp.zeros(15), scale=jnp.ones(15)),
+        params=init_logreg(15, seed=3)))
+    rc = cli_main(["--platform", "cpu", "registry", "--path", root,
+                   "--publish", str(mfile)])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["published"] == 2 and out["kind"] == "logreg"
+    man = reg.meta(2)
+    assert man["source"] == "cli" and man["parent"] == 1
+    # the champion pointer does NOT move: the serving loop's live-metric
+    # gate (or an explicit --promote) decides, never a bare publish
+    assert reg.champion_version() == 1
+
+    # a corrupt artifact is refused at publish
+    data = bytearray(mfile.read_bytes())
+    data[len(data) // 2] ^= 0xFF
+    mfile.write_bytes(bytes(data))
+    rc = cli_main(["--platform", "cpu", "registry", "--path", root,
+                   "--publish", str(mfile)])
+    assert rc == 1
+    capsys.readouterr()
+    assert [m["version"] for m in reg.list_versions()] == [1, 2]
+
+
+def test_load_model_truncated_raises_without_quarantine(tmp_path):
+    """A short read (torn concurrent write of an operator-shipped file)
+    raises but does NOT rename the file away — the next reload poll must
+    find the completed write at the same path. A failed CONTENT hash is
+    definitive corruption and IS quarantined."""
+    import jax.numpy as jnp
+
+    from real_time_fraud_detection_system_tpu.io.artifacts import (
+        CorruptModelError,
+        dump_model_bytes,
+        load_model,
+    )
+    from real_time_fraud_detection_system_tpu.models.logreg import (
+        init_logreg,
+    )
+    from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+    from real_time_fraud_detection_system_tpu.models.train import (
+        TrainedModel,
+    )
+
+    data = dump_model_bytes(TrainedModel(
+        kind="logreg",
+        scaler=Scaler(mean=jnp.zeros(15), scale=jnp.ones(15)),
+        params=init_logreg(15)))
+    torn = tmp_path / "torn.npz"
+    torn.write_bytes(data[:48])
+    with pytest.raises(CorruptModelError) as ei:
+        load_model(str(torn))
+    assert ei.value.reason == "truncated"
+    assert torn.exists()  # still there: the in-flight copy can finish
+    assert not [n for n in os.listdir(tmp_path) if n.startswith("stale-")]
+
+    # definitive content-hash corruption: rebuild the npz with one array
+    # value changed but the writer's v1 hash stamp intact — the zip layer
+    # is happy, the recomputed content sha256 is not
+    import io
+
+    with np.load(io.BytesIO(data), allow_pickle=False) as z:
+        meta_raw = str(z["__meta__"])
+        arrays = {k: np.array(z[k]) for k in z.files if k != "__meta__"}
+    arrays["w"].flat[0] += 1.0
+    buf = io.BytesIO()
+    np.savez(buf, __meta__=meta_raw, **arrays)
+    rotted = tmp_path / "rotted.npz"
+    rotted.write_bytes(buf.getvalue())
+    with pytest.raises(CorruptModelError) as ei2:
+        load_model(str(rotted))
+    assert ei2.value.reason == "checksum"
+    assert not rotted.exists()  # bit-rot: quarantined
+    assert [n for n in os.listdir(tmp_path) if n.startswith("stale-")]
+
+
+def test_score_learn_registry_restart_adopts_champion(tmp_path):
+    """On restart with a non-empty registry, the engine must serve the
+    registry's champion — a promotion survives the process, and the
+    lineage/metrics describe the model that is actually serving — and a
+    kind-mismatched champion fails fast instead of silently serving the
+    wrong thing."""
+    import subprocess
+    import sys
+
+    import jax.numpy as jnp
+
+    from real_time_fraud_detection_system_tpu.io.query import load_analyzed
+    from real_time_fraud_detection_system_tpu.io.registry import (
+        make_model_registry,
+    )
+    from real_time_fraud_detection_system_tpu.models.logreg import (
+        init_logreg,
+    )
+    from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+    from real_time_fraud_detection_system_tpu.models.train import (
+        TrainedModel,
+    )
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               RTFDS_BACKEND_PROBE_TIMEOUT="0")
+
+    def cli(*a):
+        return subprocess.run(
+            [sys.executable, "-m",
+             "real_time_fraud_detection_system_tpu.cli", *a],
+            capture_output=True, text=True, cwd=repo, env=env)
+
+    p = cli("datagen", "--out", str(tmp_path / "txs.npz"),
+            "--customers", "60", "--terminals", "120", "--days", "25")
+    assert p.returncode == 0, p.stderr[-500:]
+    p = cli("train", "--data", str(tmp_path / "txs.npz"),
+            "--out-model", str(tmp_path / "m.npz"), "--model", "logreg")
+    assert p.returncode == 0, p.stderr[-500:]
+    reg_dir = str(tmp_path / "reg")
+    p = cli("score", "--data", str(tmp_path / "txs.npz"),
+            "--model-file", str(tmp_path / "m.npz"),
+            "--out", str(tmp_path / "run1"),
+            "--learn-registry", reg_dir, "--max-batches", "2")
+    assert p.returncode == 0, p.stderr[-800:]
+    reg = make_model_registry(reg_dir)
+    assert reg.champion_version() == 1  # bootstrapped from the file
+
+    # out-of-band promotion (e.g. `rtfds registry --promote` after an
+    # offline retrain): a flag-everything model, distinctive on purpose
+    scaler = Scaler(mean=jnp.zeros(15), scale=jnp.ones(15))
+    v2 = reg.publish(
+        TrainedModel(kind="logreg", scaler=scaler,
+                     params=init_logreg(15)._replace(
+                         b=jnp.asarray(6.0, jnp.float32))),
+        parent=1, source="learner")
+    reg.promote(v2)
+
+    # restart: same flags, same --model-file — v2 must serve
+    p = cli("score", "--data", str(tmp_path / "txs.npz"),
+            "--model-file", str(tmp_path / "m.npz"),
+            "--out", str(tmp_path / "run2"),
+            "--learn-registry", reg_dir, "--max-batches", "2")
+    assert p.returncode == 0, p.stderr[-800:]
+    assert "serving registry champion v2" in p.stderr
+    fresh = make_model_registry(reg_dir)
+    assert fresh.champion_version() == 2
+    assert fresh.versions() == [1, 2]  # no duplicate bootstrap
+    cols = load_analyzed(str(tmp_path / "run2"))
+    # b=+6 champion flags everything — provably not the file model
+    assert float(np.mean(cols["prediction"])) > 0.9
+
+    # a champion of a DIFFERENT kind fails fast, never silently serves
+    p = cli("train", "--data", str(tmp_path / "txs.npz"),
+            "--out-model", str(tmp_path / "forest.npz"),
+            "--model", "forest", "--epochs", "2")
+    assert p.returncode == 0, p.stderr[-500:]
+    p = cli("score", "--data", str(tmp_path / "txs.npz"),
+            "--model-file", str(tmp_path / "forest.npz"),
+            "--out", str(tmp_path / "run3"),
+            "--learn-registry", reg_dir, "--max-batches", "2")
+    assert p.returncode == 2

@@ -334,3 +334,64 @@ def test_ops_dashboard_durable_state_tile(tmp_path):
     assert "2 corrupt" in htm
     assert "restored ckpt-0000000004.npz" in htm
     assert "checkpoint_fallback" in htm
+
+
+def test_ops_dashboard_learning_tile(tmp_path):
+    """The ops view tells the continuous-learning story: a plain serving
+    run has no Learning tile; a run with model_* events shows the
+    champion version, how the canary ended (promoted / rolled back), the
+    shadowed candidate, and any corrupt-candidate refusals."""
+    import time as _time
+
+    from real_time_fraud_detection_system_tpu.io.dashboard import (
+        _EVENT_CLASS,
+        render_ops_html,
+    )
+
+    assert _EVENT_CLASS["model_promoted"] == "good"
+    assert _EVENT_CLASS["model_rollback"] == "serious"
+    assert _EVENT_CLASS["model_promote_refused"] == "serious"
+    t0 = _time.time()
+    batches = [
+        {"kind": "batch", "t": t0 + i, "batch": i + 1, "rows": 100,
+         "phases": {"dispatch": 0.001}, "queue_depth": 0,
+         "latency_s": 0.002}
+        for i in range(4)
+    ]
+    clean = render_ops_html({"model_kind": "logreg"}, batches)
+    assert "Learning" not in clean  # plain serving run: no tile
+
+    promoted = batches + [
+        {"kind": "event", "t": t0 + 1.1, "event": "model_published",
+         "version": 2, "parent": 1},
+        {"kind": "event", "t": t0 + 1.2, "event": "model_candidate",
+         "version": 2},
+        {"kind": "event", "t": t0 + 1.5, "event": "model_promoted",
+         "version": 2, "previous": 1, "recall": 0.81},
+    ]
+    htm = render_ops_html({"model_kind": "logreg"}, promoted)
+    assert "Learning" in htm
+    assert "v2" in htm
+    assert "promoted over v1" in htm
+    assert "shadow v2" in htm
+
+    regressed = promoted + [
+        {"kind": "event", "t": t0 + 2.0, "event": "model_promote_refused",
+         "version": 3, "reason": "checksum"},
+        {"kind": "event", "t": t0 + 2.5, "event": "model_rollback",
+         "version": 1, "regressed": 2},
+    ]
+    htm2 = render_ops_html({"model_kind": "logreg"}, regressed)
+    assert "rolled back from v2" in htm2
+    assert "1 corrupt refused" in htm2
+    assert "model_rollback" in htm2
+
+    # a kind-mismatch refusal is NOT corruption — the tile must not
+    # send the operator hunting bit-rot for a wrong model family
+    mixed = regressed + [
+        {"kind": "event", "t": t0 + 3.0, "event": "model_promote_refused",
+         "version": 4, "reason": "kind_mismatch"},
+    ]
+    htm3 = render_ops_html({"model_kind": "logreg"}, mixed)
+    assert "1 corrupt refused" in htm3
+    assert "1 refused (kind/missing)" in htm3

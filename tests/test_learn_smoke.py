@@ -1,0 +1,902 @@
+"""`make learn-smoke` — the tier-1 continuous-learning gate.
+
+ONE scripted run closes the learning loop end to end and asserts every
+claim from the metrics registry (never prints):
+
+- the champion (a deliberately blind model: strong negative bias, flags
+  nothing) serves while the streaming learner trains a candidate on
+  injected labeled feedback, publishing versions to the model registry;
+- the candidate shadow-scores the same live batches beside the champion
+  (``rtfds_shadow_rows_total``, divergence counted on decision flips);
+- the candidate's LIVE recall — joined from the feedback stream, not an
+  offline eval — overtakes the champion's and promotion fires exactly
+  once (``rtfds_model_promotions_total{outcome=promoted}``), swapping
+  serving params through the AOT-preserving hook;
+- an injected label regression (labels invert after the promotion, so
+  the new champion's live recall collapses against its pre-promotion
+  baseline) triggers exactly one automatic rollback
+  (``rtfds_model_rollbacks_total``) and the engine provably serves the
+  original champion artifact again;
+- zero mid-stream recompiles under ``runtime.precompile``
+  (``rtfds_xla_recompiles_total`` delta == 0) — promotion, rollback and
+  shadow scoring never pay a compile on the serving path;
+- shadow-mode loop overhead stays bounded against a no-shadow control
+  run over the identical chunk schedule;
+- the feedback FeatureCache surfaces hit/miss + occupancy and /healthz
+  carries the ``feature_cache`` and ``learning`` blocks.
+
+Separate chaos cells prove a corrupt candidate can NEVER be promoted:
+a torn registry PUT (``TornStore``) is refused at shadow install, and a
+bit-flip between install and the promotion gate is refused AT the gate
+— in both the champion keeps serving and the counters say exactly why.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+from types import SimpleNamespace
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from real_time_fraud_detection_system_tpu.config import (
+    Config,
+    FeatureConfig,
+    LearnConfig,
+    RuntimeConfig,
+)
+from real_time_fraud_detection_system_tpu.io.checkpoint import _StoreBackend
+from real_time_fraud_detection_system_tpu.io.registry import (
+    ModelRegistry,
+    make_model_registry,
+)
+from real_time_fraud_detection_system_tpu.io.store import LocalStore
+from real_time_fraud_detection_system_tpu.models.logreg import init_logreg
+from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+from real_time_fraud_detection_system_tpu.models.train import TrainedModel
+from real_time_fraud_detection_system_tpu.runtime import (
+    FEEDBACK_TOPIC,
+    FeatureCache,
+    FeedbackLoop,
+    InProcBroker,
+    ReplaySource,
+    ScoringEngine,
+    encode_feedback_envelopes,
+)
+from real_time_fraud_detection_system_tpu.runtime.faults import TornStore
+from real_time_fraud_detection_system_tpu.runtime.learner import (
+    LearningLoop,
+    StreamingLearner,
+)
+from real_time_fraud_detection_system_tpu.utils.metrics import (
+    FlightRecorder,
+    MetricsServer,
+    get_registry,
+    set_active_recorder,
+)
+
+EPOCH0 = 1_743_465_600
+N_ROWS = 6144
+CHUNK = 512
+
+# The metric deltas the gate asserts on (name, labels).
+_METRICS = {
+    "trained": ("rtfds_learner_labels_trained_total", {}),
+    "published": ("rtfds_learner_published_total", {}),
+    "shadow_rows": ("rtfds_shadow_rows_total", {}),
+    "divergence": ("rtfds_shadow_divergence_total", {}),
+    "promoted": ("rtfds_model_promotions_total", {"outcome": "promoted"}),
+    "refused": ("rtfds_model_promotions_total",
+                {"outcome": "refused_corrupt"}),
+    "rollbacks": ("rtfds_model_rollbacks_total", {}),
+    "recompiles": ("rtfds_xla_recompiles_total", {}),
+    "cache_hits": ("rtfds_feature_cache_lookups_total",
+                   {"outcome": "hit"}),
+    "corrupt_trunc": ("rtfds_model_artifact_corrupt_total",
+                      {"reason": "truncated"}),
+    "corrupt_sum": ("rtfds_model_artifact_corrupt_total",
+                    {"reason": "checksum"}),
+}
+
+
+def _snap() -> dict:
+    reg = get_registry()
+    out = {}
+    for key, (name, labels) in _METRICS.items():
+        m = reg.get(name, **labels)
+        out[key] = float(m.value) if m is not None else 0.0
+    return out
+
+
+def _cfg(dcfg, **learn_kw) -> Config:
+    lk = dict(publish_every_labels=128, promote_min_labels=96,
+              promote_margin=0.01, precision_tolerance=0.05,
+              rollback_min_labels=96, rollback_margin=0.05,
+              window_rows=1024, epochs=2)
+    lk.update(learn_kw)
+    return Config(
+        data=dcfg,
+        features=FeatureConfig(customer_capacity=256, terminal_capacity=512,
+                               cms_width=1 << 10),
+        runtime=RuntimeConfig(batch_buckets=(256,), max_batch_rows=256,
+                              precompile=True),
+        learn=LearnConfig(**lk),
+    )
+
+
+def _blind_champion():
+    """A champion that flags nothing (strong negative bias): live recall
+    0, so any candidate that actually learns the label rule wins."""
+    params = init_logreg(15)._replace(b=jnp.asarray(-4.0, jnp.float32))
+    scaler = Scaler(mean=jnp.zeros(15), scale=jnp.ones(15))
+    return params, scaler, TrainedModel(kind="logreg", scaler=scaler,
+                                        params=params)
+
+
+def _feed(broker, sl, y) -> None:
+    broker.produce_many(FEEDBACK_TOPIC,
+                        [str(int(t)).encode() for t in sl.tx_id],
+                        encode_feedback_envelopes(sl.tx_id, y))
+
+
+@pytest.fixture(scope="module")
+def learn_run(small_dataset, tmp_path_factory):
+    """The scripted promote→regress→rollback run, plus the no-shadow
+    control over the identical chunk schedule."""
+    dcfg, _, _, txs = small_dataset
+    part = txs.slice(slice(0, N_ROWS))
+    cfg = _cfg(dcfg)
+    tmp = tmp_path_factory.mktemp("learn_smoke")
+    # label rule the learner must discover: high-amount rows are fraud
+    amt_thresh = float(np.percentile(part.amount_cents, 70))
+
+    params, scaler, model = _blind_champion()
+    registry = make_model_registry(str(tmp / "registry"))
+    learner = StreamingLearner(
+        "logreg", params, scaler, cfg, registry,
+        publish_every_labels=cfg.learn.publish_every_labels,
+        window_rows=cfg.learn.window_rows, epochs=cfg.learn.epochs)
+    learning = LearningLoop(registry, cfg, "logreg", model=model,
+                            learner=learner)
+    cache = FeatureCache(capacity=1 << 14)
+    engine = ScoringEngine(cfg, kind="logreg", params=params, scaler=scaler,
+                           feature_cache=cache)
+    broker = InProcBroker(2)
+    fb = FeedbackLoop(engine, broker, cache)
+
+    recorder = FlightRecorder(str(tmp / "learn.jsonl"))
+    set_active_recorder(recorder)
+    base = _snap()
+    chunks = []  # the slices the scripted run consumed (control replays)
+    promoted = False
+    t_learn = 0.0
+    try:
+        for s in range(0, N_ROWS, CHUNK):
+            sl = part.slice(slice(s, min(s + CHUNK, N_ROWS)))
+            chunks.append(sl)
+            t0 = time.perf_counter()
+            engine.run(ReplaySource(sl, EPOCH0, batch_rows=256),
+                       feedback=fb, learning=learning)
+            t_learn += time.perf_counter() - t0
+            if not promoted and _snap()["promoted"] > base["promoted"]:
+                promoted = True
+            y = (np.asarray(sl.amount_cents) > amt_thresh).astype(np.int32)
+            if promoted:
+                # injected regression: the label rule inverts, so the
+                # promoted champion's live recall collapses against its
+                # pre-promotion baseline
+                y = 1 - y
+            _feed(broker, sl, y)
+            assert learner.drain(60.0), "learner queue failed to drain"
+            if _snap()["rollbacks"] > base["rollbacks"]:
+                break
+    finally:
+        set_active_recorder(None)
+        recorder.close()
+        learning.close()
+    final = _snap()
+
+    # No-shadow control: identical chunk schedule + feedback, no
+    # learning loop attached — the overhead baseline.
+    c_params, c_scaler, _ = _blind_champion()
+    c_cache = FeatureCache(capacity=1 << 14)
+    c_engine = ScoringEngine(cfg, kind="logreg", params=c_params,
+                             scaler=c_scaler, feature_cache=c_cache)
+    c_broker = InProcBroker(2)
+    c_fb = FeedbackLoop(c_engine, c_broker, c_cache)
+    t_control = 0.0
+    for sl in chunks:
+        t0 = time.perf_counter()
+        c_engine.run(ReplaySource(sl, EPOCH0, batch_rows=256), feedback=c_fb)
+        t_control += time.perf_counter() - t0
+        _feed(c_broker, sl,
+              (np.asarray(sl.amount_cents) > amt_thresh).astype(np.int32))
+
+    events = []
+    with open(tmp / "learn.jsonl") as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if rec.get("kind") == "event":
+                events.append(rec)
+    delta = {k: final[k] - base[k] for k in final}
+    return SimpleNamespace(
+        delta=delta, registry=registry, engine=engine, events=events,
+        rows_fed=sum(len(sl.tx_id) for sl in chunks),
+        t_learn=t_learn, t_control=t_control)
+
+
+def _events(run, name):
+    return [e for e in run.events if e.get("event") == name]
+
+
+class TestLearnSmoke:
+    def test_stream_completes_and_learner_trains(self, learn_run):
+        assert learn_run.engine.state.rows_done == learn_run.rows_fed
+        assert learn_run.delta["trained"] > 0
+        assert learn_run.delta["published"] >= 2
+        # lineage: the first learner candidate warm-started from the
+        # bootstrap champion and records its training window
+        man = learn_run.registry.meta(2)
+        assert man["source"] == "learner"
+        assert man["parent"] == 1
+        assert man["labels_trained"] > 0
+        boot = learn_run.registry.meta(1)
+        assert boot["source"] == "bootstrap"
+
+    def test_shadow_scores_beside_champion(self, learn_run):
+        assert learn_run.delta["shadow_rows"] > 0
+        # blind champion vs a candidate that learned the rule: decision
+        # flips MUST register as divergence
+        assert learn_run.delta["divergence"] > 0
+
+    def test_promotion_fires_from_live_metrics(self, learn_run):
+        assert learn_run.delta["promoted"] == 1
+        ev = _events(learn_run, "model_promoted")
+        assert len(ev) == 1
+        assert ev[0]["previous"] == 1
+        assert ev[0]["version"] >= 2
+        # promotion was earned on LIVE recall, not an offline eval
+        assert ev[0]["recall"] > 0.1
+        cand_ev = _events(learn_run, "model_candidate")
+        assert cand_ev, "candidate was never shadow-installed"
+
+    def test_rollback_on_live_regression(self, learn_run):
+        assert learn_run.delta["rollbacks"] == 1
+        ev = _events(learn_run, "model_rollback")
+        assert len(ev) == 1
+        promoted = _events(learn_run, "model_promoted")[0]["version"]
+        assert ev[0]["regressed"] == promoted
+        assert ev[0]["version"] == 1
+        # the pointer AND the serving params are back on the original
+        # champion artifact (blind bias restored bit-for-bit)
+        assert learn_run.registry.champion_version() == 1
+        assert float(learn_run.engine.state.params.b) == pytest.approx(-4.0)
+
+    def test_zero_midstream_recompiles(self, learn_run):
+        # precompile on: candidate install, promotion and rollback all
+        # swap through the AOT-preserving hook — the whole scripted run
+        # (shadow scoring included) never recompiles the serving step
+        assert learn_run.delta["recompiles"] == 0
+
+    def test_feature_cache_surfaced(self, learn_run):
+        assert learn_run.delta["cache_hits"] > 0
+        reg = get_registry()
+        cap = reg.get("rtfds_feature_cache_capacity")
+        assert cap is not None and cap.value >= 1 << 14
+        occ = reg.get("rtfds_feature_cache_occupancy")
+        assert occ is not None and occ.value > 0
+
+    def test_healthz_reports_learning_and_cache(self, learn_run):
+        server = MetricsServer(port=0, registry=get_registry(),
+                               max_batch_age_s=3600.0).start()
+        try:
+            with urllib.request.urlopen(server.url + "/healthz",
+                                        timeout=5) as r:
+                body = json.loads(r.read())
+        finally:
+            server.stop()
+        fc = body["feature_cache"]
+        assert 0.0 <= fc["hit_rate"] <= 1.0
+        assert fc["lookups"] > 0
+        assert fc["capacity"] >= 1 << 14
+        learn = body["learning"]
+        assert learn["champion_version"] >= 1
+        assert learn["promotions"] >= 1
+        assert learn["rollbacks"] >= 1
+
+    def test_shadow_overhead_bounded(self, learn_run):
+        # dual-scoring + learner enqueue ride the loop thread: generous
+        # CI bound, but a runaway (per-batch retrace, synchronous
+        # training) would blow straight through it
+        assert learn_run.t_learn <= 4.0 * learn_run.t_control + 2.0
+
+
+class TestCorruptCandidateNeverPromoted:
+    def test_torn_registry_put_refused_at_install(self, small_dataset,
+                                                  tmp_path):
+        """The learner's first published candidate lands TORN in the
+        registry store (silent truncated PUT). The install must refuse
+        it — counted, quarantined — the champion must keep serving, and
+        the NEXT (clean) candidate must still be installable."""
+        dcfg, _, _, txs = small_dataset
+        part = txs.slice(slice(0, 768))
+        # promotion gate out of reach: this cell is about refusal
+        cfg = _cfg(dcfg, publish_every_labels=192,
+                   promote_min_labels=100_000)
+        params, scaler, model = _blind_champion()
+        # PUT order: bootstrap npz(0) + manifest(1) + champion ptr(2),
+        # then the learner's first candidate npz is PUT 3 — torn.
+        store = TornStore(LocalStore(str(tmp_path)), tear_at=3,
+                          keep_bytes=64)
+        registry = ModelRegistry(_StoreBackend(store, prefix="",
+                                               op_attempts=3))
+        learner = StreamingLearner(
+            "logreg", params, scaler, cfg, registry,
+            publish_every_labels=cfg.learn.publish_every_labels,
+            window_rows=cfg.learn.window_rows, epochs=1)
+        learning = LearningLoop(registry, cfg, "logreg", model=model,
+                                learner=learner)
+        cache = FeatureCache(capacity=1 << 14)
+        engine = ScoringEngine(cfg, kind="logreg", params=params,
+                               scaler=scaler, feature_cache=cache)
+        broker = InProcBroker(2)
+        fb = FeedbackLoop(engine, broker, cache)
+        amt_thresh = float(np.percentile(part.amount_cents, 70))
+        base = _snap()
+        try:
+            for s in range(0, 768, 256):
+                sl = part.slice(slice(s, s + 256))
+                engine.run(ReplaySource(sl, EPOCH0, batch_rows=256),
+                           feedback=fb, learning=learning)
+                _feed(broker, sl, (np.asarray(sl.amount_cents)
+                                   > amt_thresh).astype(np.int32))
+                assert learner.drain(60.0)
+        finally:
+            learning.close()
+        delta = {k: _snap()[k] - base[k] for k in base}
+        # the torn candidate was refused — and the counters say why
+        assert delta["refused"] >= 1
+        assert delta["corrupt_trunc"] >= 1
+        assert delta["promoted"] == 0
+        # quarantined out of the lineage; the champion kept serving
+        assert 2 not in registry.versions()
+        assert registry.champion_version() == 1
+        # still the blind champion (online feedback SGD nudges the bias
+        # a hair; a swapped-in learned candidate would move it far)
+        assert float(engine.state.params.b) == pytest.approx(-4.0, abs=0.05)
+        assert engine.state.rows_done == 768
+        # the next, clean publish is installable again (self-healing)
+        assert learning.shadow.candidate_version in (None, 3)
+        if len(registry.versions()) > 1:
+            assert registry.get(registry.versions()[-1]).kind == "logreg"
+
+    def test_bit_flip_refused_at_promotion_gate(self, small_dataset,
+                                                tmp_path):
+        """A candidate that was CLEAN at shadow install but whose
+        registry bytes rot before the gate: the gate re-verifies and
+        refuses — the champion pointer and the serving params are
+        untouched."""
+        dcfg, _, _, txs = small_dataset
+        cfg = _cfg(dcfg, promote_min_labels=64)
+        params, scaler, model = _blind_champion()
+        registry = make_model_registry(str(tmp_path / "reg"))
+        learning = LearningLoop(registry, cfg, "logreg", model=model)
+        engine = ScoringEngine(cfg, kind="logreg", params=params,
+                               scaler=scaler)
+        learning.attach(engine)
+        # a candidate that flags everything (strong positive bias): its
+        # live recall is 1.0 on all-fraud labels, so the gate WOULD fire
+        strong = TrainedModel(
+            kind="logreg", scaler=scaler,
+            params=init_logreg(15)._replace(b=jnp.asarray(4.0,
+                                                          jnp.float32)))
+        v2 = registry.publish(strong, parent=1, source="learner")
+        learning.on_batch(engine)  # no learner: no install from publish
+        learning._install_candidate(engine, v2)
+        assert learning.shadow.candidate_version == v2
+        rng = np.random.default_rng(3)
+        tx_ids = np.arange(1, 257, dtype=np.int64)
+        feats = rng.normal(size=(256, 15)).astype(np.float32)
+        learning.shadow.score_batch(
+            tx_ids, feats, np.zeros(256, np.float32))
+        learning.shadow.observe_labels(tx_ids, np.ones(256, np.int32))
+        assert learning.shadow.candidate.n >= cfg.learn.promote_min_labels
+        # rot the candidate bytes between install and the gate
+        path = tmp_path / "reg" / "model-v0000002.npz"
+        data = bytearray(path.read_bytes())
+        data[len(data) // 2] ^= 0xFF
+        path.write_bytes(bytes(data))
+        base = _snap()
+        params_before = engine.state.params
+        learning.on_batch(engine)  # the gate: re-verify → refuse
+        delta = {k: _snap()[k] - base[k] for k in base}
+        assert delta["refused"] == 1
+        assert delta["promoted"] == 0
+        assert delta["corrupt_sum"] >= 1
+        assert engine.state.params is params_before
+        assert registry.champion_version() == 1
+        assert learning.shadow.candidate_version is None
+        refusals = [e for e in [None] if e]  # gate emits flight events
+        assert refusals == []  # (no recorder active in this cell)
+
+
+class TestReloadIsVersioned:
+    def test_reload_counted_by_outcome_and_registered(self, small_dataset,
+                                                      tmp_path):
+        """Hot reload × online SGD: each wholesale swap is counted by
+        outcome (clobbered_online_updates when on-device SGD deltas are
+        discarded, clean otherwise) and lands in the registry lineage as
+        a promoted source=reload version — a reload is a versioned
+        event, not a silent overwrite."""
+        dcfg, _, _, txs = small_dataset
+        part = txs.slice(slice(0, 768))
+        cfg = _cfg(dcfg)
+        params, scaler, model = _blind_champion()
+        registry = make_model_registry(str(tmp_path / "reg"))
+        learning = LearningLoop(registry, cfg, "logreg", model=model)
+        cache = FeatureCache(capacity=1 << 14)
+        engine = ScoringEngine(cfg, kind="logreg", params=params,
+                               scaler=scaler, feature_cache=cache,
+                               online_lr=0.05)
+        broker = InProcBroker(2)
+        fb = FeedbackLoop(engine, broker, cache)
+        reg = get_registry()
+
+        def reloads(outcome):
+            m = reg.get("rtfds_model_reloads_total", outcome=outcome)
+            return float(m.value) if m is not None else 0.0
+
+        # chunk 0: score rows, then label them — the labels sit queued
+        sl0 = part.slice(slice(0, 256))
+        engine.run(ReplaySource(sl0, EPOCH0, batch_rows=256),
+                   feedback=fb, learning=learning)
+        _feed(broker, sl0, (np.arange(256) % 2).astype(np.int32))
+        # chunk 1: feedback applies an online-SGD step (params now lead
+        # the artifact), then the reload swaps wholesale → clobbered
+        base = (reloads("clean"), reloads("clobbered_online_updates"))
+        swaps = [(init_logreg(15, seed=9), None)]
+        engine.run(ReplaySource(part.slice(slice(256, 512)), EPOCH0,
+                                batch_rows=256),
+                   feedback=fb, learning=learning,
+                   model_reload=lambda: swaps.pop() if swaps else None)
+        assert reloads("clobbered_online_updates") == base[1] + 1
+        # the reload is in the lineage: a promoted source=reload version
+        v = learning.champion_version
+        assert v is not None and v > 1
+        assert registry.champion_version() == v
+        man = registry.meta(v)
+        assert man["source"] == "reload"
+        assert man["note"] == "clobbered_online_updates"
+        # chunk 2: no feedback between swaps → the next reload is clean
+        swaps2 = [(init_logreg(15, seed=11), None)]
+        engine.run(ReplaySource(part.slice(slice(512, 768)), EPOCH0,
+                                batch_rows=256),
+                   feedback=fb, learning=learning,
+                   model_reload=lambda: swaps2.pop() if swaps2 else None)
+        assert reloads("clean") == base[0] + 1
+        assert registry.meta(learning.champion_version)["note"] == "clean"
+
+
+class TestResetSupersedesInflightTraining:
+    def test_mid_train_reset_discards_writeback(self, small_dataset,
+                                                tmp_path):
+        """A promotion/rollback reset that lands while the worker is
+        mid-train must win: the in-flight result descends from the
+        superseded lineage (possibly a rolled-back champion) and is
+        discarded, not written back over the reset."""
+        dcfg = small_dataset[0]
+        cfg = _cfg(dcfg)
+        params, scaler, _ = _blind_champion()
+        registry = make_model_registry(str(tmp_path))
+        learner = StreamingLearner(
+            "logreg", params, scaler, cfg, registry,
+            publish_every_labels=100_000, window_rows=256, epochs=1)
+        try:
+            reset_params = init_logreg(15, seed=42)
+            orig = learner._fb_step
+            fired = []
+
+            def hijack(*a):
+                if not fired:
+                    fired.append(1)
+                    # the rollback reset lands mid-train, on cue
+                    learner.reset(reset_params, scaler, 7)
+                return orig(*a)
+
+            learner._fb_step = hijack
+            reg = get_registry()
+            m = reg.get("rtfds_learner_labels_trained_total")
+            before = float(m.value) if m is not None else 0.0
+            rng = np.random.default_rng(0)
+            learner.submit(rng.normal(size=(64, 15)).astype(np.float32),
+                           (np.arange(64) % 2).astype(np.int32))
+            assert learner.drain(30.0)
+            assert fired, "training never ran"
+            with learner._plock:
+                got = np.asarray(learner._params.w)
+            np.testing.assert_array_equal(got,
+                                          np.asarray(reset_params.w))
+            assert learner.parent_version == 7
+            # the discarded pass counts nothing toward the publish cadence
+            m = reg.get("rtfds_learner_labels_trained_total")
+            assert (float(m.value) if m is not None else 0.0) == before
+        finally:
+            learner.close()
+
+
+class TestInstallDeferredDuringCanaryWatch:
+    def test_deferred_then_discarded_on_rollback(self, small_dataset,
+                                                 tmp_path):
+        """A version published during an active canary watch must NOT
+        install (installing resets the champion metric window — the
+        watch's evidence); on rollback it is discarded with the rest of
+        the regressed lineage."""
+        dcfg = small_dataset[0]
+        cfg = _cfg(dcfg, promote_min_labels=64, rollback_min_labels=64)
+        params, scaler, model = _blind_champion()
+        registry = make_model_registry(str(tmp_path / "reg"))
+        learning = LearningLoop(registry, cfg, "logreg", model=model)
+        engine = ScoringEngine(cfg, kind="logreg", params=params,
+                               scaler=scaler)
+        learning.attach(engine)
+        strong = TrainedModel(
+            kind="logreg", scaler=scaler,
+            params=init_logreg(15)._replace(b=jnp.asarray(4.0,
+                                                          jnp.float32)))
+        v2 = registry.publish(strong, parent=1, source="learner")
+        learning._install_candidate(engine, v2)
+        rng = np.random.default_rng(5)
+        tx = np.arange(1, 129, dtype=np.int64)
+        feats = rng.normal(size=(128, 15)).astype(np.float32)
+        learning.shadow.score_batch(tx, feats, np.zeros(128, np.float32))
+        learning.shadow.observe_labels(tx, np.ones(128, np.int32))
+        learning.on_batch(engine)  # candidate recall 1.0 vs 0 → promote
+        assert learning._watch is not None
+        assert registry.champion_version() == v2
+        # a publish lands mid-watch: stashed, not installed
+        v3 = registry.publish(strong, parent=v2, source="learner")
+        learning._pending_install = v3
+        learning.on_batch(engine)
+        assert learning.shadow.candidate_version is None
+        assert learning._pending_install == v3
+        # champion metric window kept accumulating (not reset by install)
+        # regression: fraud the promoted champion misses → live recall 0
+        # vs baseline 1.0 → rollback
+        tx2 = np.arange(500, 628, dtype=np.int64)
+        learning.shadow.score_batch(
+            tx2, rng.normal(size=(128, 15)).astype(np.float32),
+            np.zeros(128, np.float32))
+        learning.shadow.observe_labels(tx2, np.ones(128, np.int32))
+        learning.on_batch(engine)
+        assert registry.champion_version() == 1
+        assert learning._pending_install is None  # regressed lineage
+        assert learning.shadow.candidate_version is None
+
+
+class TestExternalCandidates:
+    """Tree kinds have no in-stream gradient path: candidates arrive by
+    EXTERNAL publish (`rtfds registry` after an offline retrain) and the
+    loop must still shadow + gate them — on_batch polls the registry on
+    a batch cadence when no learner runs."""
+
+    def _loop(self, small_dataset, tmp_path, **learn_kw):
+        dcfg = small_dataset[0]
+        cfg = _cfg(dcfg, promote_min_labels=64, external_poll_batches=1,
+                   **learn_kw)
+        params, scaler, model = _blind_champion()
+        registry = make_model_registry(str(tmp_path / "reg"))
+        learning = LearningLoop(registry, cfg, "logreg", model=model)
+        engine = ScoringEngine(cfg, kind="logreg", params=params,
+                               scaler=scaler)
+        learning.attach(engine)
+        return registry, learning, engine, scaler
+
+    def test_external_publish_installed_promoted_never_reinstalled(
+            self, small_dataset, tmp_path):
+        registry, learning, engine, scaler = self._loop(
+            small_dataset, tmp_path, rollback_min_labels=64)
+        strong = TrainedModel(
+            kind="logreg", scaler=scaler,
+            params=init_logreg(15)._replace(b=jnp.asarray(4.0,
+                                                          jnp.float32)))
+        v2 = registry.publish(strong, parent=1, source="cli")
+        # one batch: the poll detects the external publish AND installs
+        learning.on_batch(engine)
+        assert learning.shadow.candidate_version == v2
+        # live labels: candidate recall 1.0 vs blind champion 0 → promote
+        rng = np.random.default_rng(7)
+        tx = np.arange(1, 129, dtype=np.int64)
+        learning.shadow.score_batch(
+            tx, rng.normal(size=(128, 15)).astype(np.float32),
+            np.zeros(128, np.float32))
+        learning.shadow.observe_labels(tx, np.ones(128, np.int32))
+        base = _snap()
+        learning.on_batch(engine)
+        assert _snap()["promoted"] - base["promoted"] == 1
+        assert registry.champion_version() == v2
+        # regression (fraud the new champion misses) → rollback; v2 is
+        # now the NEWEST artifact but a handled one: the poll must never
+        # re-install the rolled-back ex-champion
+        tx2 = np.arange(500, 628, dtype=np.int64)
+        learning.shadow.score_batch(
+            tx2, rng.normal(size=(128, 15)).astype(np.float32),
+            np.zeros(128, np.float32))
+        learning.shadow.observe_labels(tx2, np.ones(128, np.int32))
+        learning.on_batch(engine)
+        assert registry.champion_version() == 1
+        for _ in range(3):
+            learning.on_batch(engine)
+        assert learning.shadow.candidate_version is None
+
+    def test_wrong_kind_external_publish_refused(self, small_dataset,
+                                                 tmp_path):
+        from real_time_fraud_detection_system_tpu.models.mlp import init_mlp
+
+        registry, learning, engine, scaler = self._loop(
+            small_dataset, tmp_path)
+        v2 = registry.publish(
+            TrainedModel(kind="mlp", scaler=scaler,
+                         params=init_mlp(15)),
+            parent=1, source="cli")
+        learning.on_batch(engine)
+        # detected, refused (shape family mismatch), never installed —
+        # and the poll does not retry it every batch
+        assert learning.shadow.candidate_version is None
+        assert learning._ext_seen == v2
+        learning.on_batch(engine)
+        assert learning.shadow.candidate_version is None
+        assert registry.champion_version() == 1
+
+
+class TestNoPositivesWindowDefersRollback:
+    def test_all_negative_canary_window_is_not_evidence(
+            self, small_dataset, tmp_path):
+        """Recall over a window with zero fraud labels is UNDEFINED, not
+        0.0: at ~1% prevalence a min-size canary window has no positives
+        with non-trivial probability, and reading the placeholder as
+        collapse would demote a healthy champion. The watch must wait
+        for positive labels before deciding."""
+        dcfg = small_dataset[0]
+        cfg = _cfg(dcfg, promote_min_labels=64, rollback_min_labels=64)
+        params, scaler, model = _blind_champion()
+        registry = make_model_registry(str(tmp_path / "reg"))
+        learning = LearningLoop(registry, cfg, "logreg", model=model)
+        engine = ScoringEngine(cfg, kind="logreg", params=params,
+                               scaler=scaler)
+        learning.attach(engine)
+        strong = TrainedModel(
+            kind="logreg", scaler=scaler,
+            params=init_logreg(15)._replace(b=jnp.asarray(4.0,
+                                                          jnp.float32)))
+        v2 = registry.publish(strong, parent=1, source="learner")
+        learning._install_candidate(engine, v2)
+        rng = np.random.default_rng(13)
+        tx = np.arange(1, 129, dtype=np.int64)
+        learning.shadow.score_batch(
+            tx, rng.normal(size=(128, 15)).astype(np.float32),
+            np.zeros(128, np.float32))
+        learning.shadow.observe_labels(tx, np.ones(128, np.int32))
+        learning.on_batch(engine)  # promote (baseline recall 1.0)
+        assert registry.champion_version() == v2
+        assert learning._watch is not None
+        # canary window: 128 labels, ALL legit — enough labels to meet
+        # rollback_min_labels but zero positives → no decision
+        tx2 = np.arange(500, 628, dtype=np.int64)
+        learning.shadow.score_batch(
+            tx2, rng.normal(size=(128, 15)).astype(np.float32),
+            np.full(128, 0.99, np.float32))
+        learning.shadow.observe_labels(tx2, np.zeros(128, np.int32))
+        base = _snap()
+        learning.on_batch(engine)
+        assert _snap()["rollbacks"] - base["rollbacks"] == 0
+        assert registry.champion_version() == v2
+        assert learning._watch is not None  # still watching
+        # positives arrive and the champion misses them: NOW the watch
+        # has evidence and rolls back
+        tx3 = np.arange(900, 1028, dtype=np.int64)
+        learning.shadow.score_batch(
+            tx3, rng.normal(size=(128, 15)).astype(np.float32),
+            np.zeros(128, np.float32))
+        learning.shadow.observe_labels(tx3, np.ones(128, np.int32))
+        learning.on_batch(engine)
+        assert _snap()["rollbacks"] - base["rollbacks"] == 1
+        assert registry.champion_version() == 1
+
+
+class TestMissingManifestRefusedNotCrash:
+    def test_vanished_version_refused_at_install_and_gate(
+            self, small_dataset, tmp_path):
+        """A version quarantined by a CONCURRENT reader (CLI --verify,
+        another process's get) vanishes between listing and read: the
+        registry raises KeyError, and both gates must refuse — never let
+        a registry read kill the serving loop."""
+        dcfg = small_dataset[0]
+        cfg = _cfg(dcfg, promote_min_labels=64)
+        params, scaler, model = _blind_champion()
+        registry = make_model_registry(str(tmp_path / "reg"))
+        learning = LearningLoop(registry, cfg, "logreg", model=model)
+        engine = ScoringEngine(cfg, kind="logreg", params=params,
+                               scaler=scaler)
+        learning.attach(engine)
+        strong = TrainedModel(
+            kind="logreg", scaler=scaler,
+            params=init_logreg(15)._replace(b=jnp.asarray(4.0,
+                                                          jnp.float32)))
+        v2 = registry.publish(strong, parent=1, source="learner")
+        # install gate: the manifest vanished before the read
+        (tmp_path / "reg" / "model-v0000002.json").unlink()
+        base = _snap()
+        learning._install_candidate(engine, v2)  # must not raise
+        assert _snap()["refused"] - base["refused"] == 1
+        assert learning.shadow.candidate_version is None
+        # promotion gate: installed clean, THEN the version vanishes
+        v3 = registry.publish(strong, parent=1, source="learner")
+        learning._install_candidate(engine, v3)
+        assert learning.shadow.candidate_version == v3
+        rng = np.random.default_rng(11)
+        tx = np.arange(1, 129, dtype=np.int64)
+        learning.shadow.score_batch(
+            tx, rng.normal(size=(128, 15)).astype(np.float32),
+            np.zeros(128, np.float32))
+        learning.shadow.observe_labels(tx, np.ones(128, np.int32))
+        (tmp_path / "reg" / "model-v0000003.json").unlink()
+        base = _snap()
+        learning.on_batch(engine)  # the gate would promote v3 — refuse
+        assert _snap()["refused"] - base["refused"] == 1
+        assert _snap()["promoted"] - base["promoted"] == 0
+        assert registry.champion_version() == 1
+        assert learning.shadow.candidate_version is None
+
+
+class TestPauseWaitsOutInflightTraining:
+    def test_pause_blocks_until_chunk_done(self, small_dataset, tmp_path):
+        """pause() must wait out a chunk ALREADY training, not just stop
+        the next dequeue — the no-training-overlaps-a-bisection
+        invariant covers device work in flight."""
+        dcfg = small_dataset[0]
+        cfg = _cfg(dcfg)
+        params, scaler, _ = _blind_champion()
+        registry = make_model_registry(str(tmp_path))
+        learner = StreamingLearner(
+            "logreg", params, scaler, cfg, registry,
+            publish_every_labels=100_000, window_rows=256, epochs=1)
+        try:
+            orig = learner._fb_step
+            entered = threading.Event()
+
+            def slow(*a):
+                entered.set()
+                time.sleep(0.25)
+                return orig(*a)
+
+            learner._fb_step = slow
+            reg = get_registry()
+            m = reg.get("rtfds_learner_labels_trained_total")
+            before = float(m.value) if m is not None else 0.0
+            rng = np.random.default_rng(0)
+            learner.submit(rng.normal(size=(64, 15)).astype(np.float32),
+                           np.ones(64, np.int32))
+            assert entered.wait(10.0), "training never started"
+            learner.pause()
+            # pause returned ⇒ the in-flight chunk fully finished: its
+            # write-back landed and no learner device work is running
+            assert not learner._in_train
+            m = reg.get("rtfds_learner_labels_trained_total")
+            assert (float(m.value) if m is not None else 0.0) \
+                == before + 64
+            learner.resume()
+        finally:
+            learner.close()
+
+
+class TestIncarnationResync:
+    def test_fresh_incarnation_readopts_promoted_champion(
+            self, small_dataset, tmp_path):
+        """A supervisor restart builds a fresh engine from the BOOTSTRAP
+        params and restores whatever checkpoint exists — either can
+        predate a promotion/reload the registry already records. The
+        state's model_version stamp disagrees with the champion pointer
+        and attach() re-applies the champion artifact: stale weights
+        never serve silently (rtfds_model_resyncs_total counts it)."""
+        dcfg = small_dataset[0]
+        cfg = _cfg(dcfg)
+        params, scaler, model = _blind_champion()
+        registry = make_model_registry(str(tmp_path / "reg"))
+        learning = LearningLoop(registry, cfg, "logreg", model=model)
+        assert learning.champion_version == 1
+        # a reload-style promotion moves the pointer to v2 (the same
+        # publish+promote+champion_version path _promote takes)
+        better = init_logreg(15, seed=5)
+        learning.note_external_swap(better, scaler, "clean")
+        v2 = learning.champion_version
+        assert v2 == 2 and registry.champion_version() == 2
+
+        reg = get_registry()
+
+        def resyncs():
+            m = reg.get("rtfds_model_resyncs_total")
+            return float(m.value) if m is not None else 0.0
+
+        # next incarnation: fresh engine still built from bootstrap-era
+        # params (the make_engine closure binds the startup model)
+        before = resyncs()
+        eng = ScoringEngine(cfg, kind="logreg", params=params,
+                            scaler=scaler)
+        learning.attach(eng)
+        assert resyncs() == before + 1
+        assert eng.state.model_version == v2
+        np.testing.assert_array_equal(np.asarray(eng.state.params.w),
+                                      np.asarray(better.w))
+
+        # an incarnation whose restored stamp already matches the
+        # pointer keeps its params (checkpointed online updates survive)
+        tweaked = better._replace(b=jnp.asarray(0.25, jnp.float32))
+        eng2 = ScoringEngine(cfg, kind="logreg", params=tweaked,
+                             scaler=scaler)
+        eng2.state.model_version = v2  # as a checkpoint restore sets it
+        before = resyncs()
+        learning.attach(eng2)
+        assert resyncs() == before
+        np.testing.assert_array_equal(np.asarray(eng2.state.params.b),
+                                      np.asarray(tweaked.b))
+
+    def test_unadopted_champion_stamp_stays_honest_and_heals(
+            self, small_dataset, tmp_path):
+        """cmd_score failed to adopt the champion at startup (flaky
+        store): the engines serve fallback params, so the boot stamp
+        must be None — NOT the champion's version — and the next
+        attach() re-applies the champion as soon as the registry
+        heals."""
+        dcfg = small_dataset[0]
+        cfg = _cfg(dcfg)
+        params, scaler, model = _blind_champion()
+        registry = make_model_registry(str(tmp_path / "reg"))
+        better = init_logreg(15, seed=5)
+        v1 = registry.publish(TrainedModel(kind="logreg", scaler=scaler,
+                                           params=better))
+        registry.promote(v1)
+        # startup could NOT load v1: the loop is told the model is not
+        # the champion
+        learning = LearningLoop(registry, cfg, "logreg", model=model,
+                                model_is_champion=False)
+        assert learning._boot_version is None
+        eng = ScoringEngine(cfg, kind="logreg", params=params,
+                            scaler=scaler)
+        learning.attach(eng)  # registry is healthy here: resync applies
+        assert eng.state.model_version == v1
+        np.testing.assert_array_equal(np.asarray(eng.state.params.w),
+                                      np.asarray(better.w))
+
+    def test_model_version_stamp_travels_with_checkpoint(
+            self, small_dataset):
+        """The serving-version stamp is part of the checkpointed state:
+        a restore hands it back so attach() can tell restored params
+        from the current champion; pre-learning checkpoints (no stamp)
+        keep the template's value."""
+        from real_time_fraud_detection_system_tpu.io.checkpoint import (
+            _apply_arrays,
+            _state_arrays,
+        )
+
+        dcfg = small_dataset[0]
+        cfg = _cfg(dcfg)
+        params, scaler, _ = _blind_champion()
+        eng = ScoringEngine(cfg, kind="logreg", params=params,
+                            scaler=scaler)
+        eng.state.model_version = 3
+        arrays, meta = _state_arrays(eng.state)
+        assert meta["model_version"] == 3
+        fresh = ScoringEngine(cfg, kind="logreg", params=params,
+                              scaler=scaler)
+        assert fresh.state.model_version is None
+        _apply_arrays(fresh.state, meta, arrays)
+        assert fresh.state.model_version == 3
+        # back-compat: a meta without the key leaves the template value
+        meta2 = {k: v for k, v in meta.items() if k != "model_version"}
+        fresh2 = ScoringEngine(cfg, kind="logreg", params=params,
+                               scaler=scaler)
+        fresh2.state.model_version = 7
+        _apply_arrays(fresh2.state, meta2, arrays)
+        assert fresh2.state.model_version == 7

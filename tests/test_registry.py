@@ -1,0 +1,336 @@
+"""Versioned model registry (io/registry.py) — the continuous-learning
+artifact plane.
+
+Every artifact gets a monotonically increasing version, a content hash
+verified on every get (corruption → quarantine + raise, never serve),
+training-window metadata and parent lineage; the champion pointer moves
+atomically and rollback is one pointer pop. Storage rides the checkpoint
+backends, so the store plane inherits the flaky-store hardening.
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from real_time_fraud_detection_system_tpu.io.artifacts import (
+    CorruptModelError,
+)
+from real_time_fraud_detection_system_tpu.io.checkpoint import _StoreBackend
+from real_time_fraud_detection_system_tpu.io.registry import (
+    ModelRegistry,
+    make_model_registry,
+)
+from real_time_fraud_detection_system_tpu.io.store import LocalStore
+from real_time_fraud_detection_system_tpu.models.logreg import init_logreg
+from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+from real_time_fraud_detection_system_tpu.models.train import TrainedModel
+from real_time_fraud_detection_system_tpu.runtime.faults import (
+    FlakyStore,
+    TornStore,
+)
+from real_time_fraud_detection_system_tpu.utils.metrics import get_registry
+
+
+def _model(seed: int = 0, kind: str = "logreg") -> TrainedModel:
+    return TrainedModel(
+        kind=kind,
+        scaler=Scaler(mean=jnp.zeros(15), scale=jnp.ones(15)),
+        params=init_logreg(15, seed=seed),
+    )
+
+
+def _counter(name: str, **labels) -> float:
+    m = get_registry().get(name, **labels)
+    return float(m.value) if m is not None else 0.0
+
+
+class TestPublishAndGet:
+    def test_versions_monotonic_and_lineage(self, tmp_path):
+        reg = make_model_registry(str(tmp_path))
+        assert reg.versions() == []
+        v1 = reg.publish(_model(0), source="bootstrap")
+        v2 = reg.publish(_model(1), parent=v1, source="learner",
+                         labels_trained=128, note="warm start")
+        assert (v1, v2) == (1, 2)
+        assert reg.versions() == [1, 2]
+        man = reg.meta(2)
+        assert man["parent"] == 1
+        assert man["source"] == "learner"
+        assert man["labels_trained"] == 128
+        assert man["kind"] == "logreg"
+        # the artifact never overwrites in place: both npz files exist
+        names = sorted(os.listdir(tmp_path))
+        assert "model-v0000001.npz" in names
+        assert "model-v0000002.npz" in names
+
+    def test_get_roundtrip(self, tmp_path):
+        reg = make_model_registry(str(tmp_path))
+        m = _model(3)
+        v = reg.publish(m)
+        got = reg.get(v)
+        assert got.kind == "logreg"
+        np.testing.assert_allclose(np.asarray(got.params.w),
+                                   np.asarray(m.params.w))
+
+    def test_get_missing_version_raises_keyerror(self, tmp_path):
+        reg = make_model_registry(str(tmp_path))
+        with pytest.raises(KeyError):
+            reg.get(7)
+
+    def test_concurrent_process_publish_never_overwrites(self, tmp_path):
+        """Two registry handles over the same backing (a serving run +
+        `rtfds registry --publish` in another process): allocation
+        re-lists every publish, so neither handle's version counter can
+        go stale and silently overwrite the other's artifact."""
+        reg_serve = make_model_registry(str(tmp_path))
+        reg_cli = make_model_registry(str(tmp_path))
+        v1 = reg_serve.publish(_model(0), source="bootstrap")
+        v2 = reg_cli.publish(_model(1), source="cli")
+        # the serving handle published BEFORE the CLI did: its next
+        # publish must jump past the CLI's version, not reuse it
+        v3 = reg_serve.publish(_model(2), source="learner")
+        assert (v1, v2, v3) == (1, 2, 3)
+        # every artifact's bytes survived — nothing was overwritten
+        for v, seed in ((1, 0), (2, 1), (3, 2)):
+            np.testing.assert_allclose(
+                np.asarray(reg_serve.get(v).params.w),
+                np.asarray(_model(seed).params.w))
+
+    def test_orphan_npz_version_never_reused(self, tmp_path):
+        """A crash between the npz write and the manifest write leaves
+        an unlisted orphan npz; allocation must skip its number, never
+        pair a fresh manifest with stale bytes."""
+        reg = make_model_registry(str(tmp_path))
+        reg.publish(_model(0))
+        (tmp_path / "model-v0000002.npz").write_bytes(b"orphan bytes")
+        v = reg.publish(_model(1))
+        assert v == 3
+        np.testing.assert_allclose(np.asarray(reg.get(3).params.w),
+                                   np.asarray(_model(1).params.w))
+
+    def test_version_gauges(self, tmp_path):
+        reg = make_model_registry(str(tmp_path))
+        v = reg.publish(_model())
+        reg.promote(v)
+        assert _counter("rtfds_model_version", role="candidate") >= v
+        assert _counter("rtfds_model_version", role="champion") >= v
+
+
+class TestChampionPointer:
+    def test_promote_and_rollback(self, tmp_path):
+        reg = make_model_registry(str(tmp_path))
+        v1 = reg.publish(_model(0))
+        v2 = reg.publish(_model(1), parent=v1)
+        assert reg.champion_version() is None
+        reg.promote(v1, by="bootstrap")
+        assert reg.champion_version() == 1
+        ptr = reg.promote(v2)
+        assert ptr["version"] == 2 and ptr["history"] == [1]
+        assert reg.champion_version() == 2
+        # rollback is one pointer pop; artifact bytes never move
+        assert reg.rollback() == 1
+        assert reg.champion_version() == 1
+        assert reg.versions() == [1, 2]  # the regressed version stays
+
+    def test_rollback_without_history_is_none(self, tmp_path):
+        reg = make_model_registry(str(tmp_path))
+        assert reg.rollback() is None
+        v = reg.publish(_model())
+        reg.promote(v)
+        assert reg.rollback() is None  # champion, but nothing to pop to
+
+    def test_promote_ghost_version_raises(self, tmp_path):
+        reg = make_model_registry(str(tmp_path))
+        with pytest.raises(KeyError):
+            reg.promote(9)
+
+    def test_champion_survives_reopen(self, tmp_path):
+        reg = make_model_registry(str(tmp_path))
+        v = reg.publish(_model())
+        reg.promote(v)
+        again = make_model_registry(str(tmp_path))
+        assert again.champion_version() == v
+        assert again.champion().kind == "logreg"
+
+
+class TestCorruption:
+    def test_bit_flip_quarantines_and_raises(self, tmp_path):
+        reg = make_model_registry(str(tmp_path))
+        v = reg.publish(_model())
+        path = tmp_path / "model-v0000001.npz"
+        data = bytearray(path.read_bytes())
+        data[len(data) // 2] ^= 0xFF
+        path.write_bytes(bytes(data))
+        before = _counter("rtfds_model_artifact_corrupt_total",
+                          reason="checksum")
+        with pytest.raises(CorruptModelError):
+            reg.get(v)
+        assert _counter("rtfds_model_artifact_corrupt_total",
+                        reason="checksum") == before + 1
+        # quarantined (stale- rename, bytes preserved), delisted
+        assert reg.versions() == []
+        stale = [n for n in os.listdir(tmp_path) if n.startswith("stale-")]
+        assert len(stale) == 2  # npz + manifest
+
+    def test_truncated_artifact(self, tmp_path):
+        reg = make_model_registry(str(tmp_path))
+        v = reg.publish(_model())
+        path = tmp_path / "model-v0000001.npz"
+        path.write_bytes(path.read_bytes()[:48])
+        before = _counter("rtfds_model_artifact_corrupt_total",
+                          reason="truncated")
+        with pytest.raises(CorruptModelError) as ei:
+            reg.get(v)
+        assert ei.value.reason == "truncated"
+        assert _counter("rtfds_model_artifact_corrupt_total",
+                        reason="truncated") == before + 1
+
+    def test_missing_bytes_is_truncated(self, tmp_path):
+        reg = make_model_registry(str(tmp_path))
+        v = reg.publish(_model())
+        os.remove(tmp_path / "model-v0000001.npz")
+        with pytest.raises(CorruptModelError) as ei:
+            reg.get(v)
+        assert ei.value.reason == "truncated"
+
+    def test_verify_all_reports_without_quarantining(self, tmp_path):
+        reg = make_model_registry(str(tmp_path))
+        reg.publish(_model(0))
+        reg.publish(_model(1))
+        reg.promote(2)
+        path = tmp_path / "model-v0000001.npz"
+        data = bytearray(path.read_bytes())
+        data[-20] ^= 0x01
+        path.write_bytes(bytes(data))
+        report = reg.verify_all()
+        by_v = {e["version"]: e for e in report}
+        assert not by_v[1]["valid"]
+        assert by_v[2]["valid"] and by_v[2]["role"] == "champion"
+        # the preflight never quarantines — both versions still listed
+        assert reg.versions() == [1, 2]
+
+
+class TestStoreBacked:
+    def _store_registry(self, root: str, store) -> ModelRegistry:
+        return ModelRegistry(_StoreBackend(store, prefix="", op_attempts=3))
+
+    def test_roundtrip_over_store(self, tmp_path):
+        reg = self._store_registry(
+            str(tmp_path), LocalStore(str(tmp_path)))
+        v = reg.publish(_model(2))
+        reg.promote(v)
+        assert reg.get(v).kind == "logreg"
+        assert reg.champion_version() == v
+
+    def test_flaky_store_put_is_retried(self, tmp_path):
+        # first PUT raises ConnectionError; the hardened backend retries
+        # and the publish still lands whole
+        store = FlakyStore(LocalStore(str(tmp_path)), fail_puts=[0])
+        reg = self._store_registry(str(tmp_path), store)
+        v = reg.publish(_model())
+        assert reg.get(v).kind == "logreg"
+
+    def test_torn_store_put_caught_on_get(self, tmp_path):
+        # a torn PUT (silently truncated, reports success) can only be
+        # caught by read-time verification — and is
+        store = TornStore(LocalStore(str(tmp_path)), tear_at=0,
+                          keep_bytes=128)
+        reg = self._store_registry(str(tmp_path), store)
+        v = reg.publish(_model())
+        with pytest.raises(CorruptModelError):
+            reg.get(v)
+        # quarantined in the store plane too
+        fresh = self._store_registry(str(tmp_path),
+                                     LocalStore(str(tmp_path)))
+        assert fresh.versions() == []
+
+
+class TestManifestIntegrity:
+    def test_manifest_size_mismatch_is_truncated(self, tmp_path):
+        reg = make_model_registry(str(tmp_path))
+        v = reg.publish(_model())
+        man_path = tmp_path / "model-v0000001.json"
+        man = json.loads(man_path.read_text())
+        man["size"] = man["size"] - 1
+        man_path.write_text(json.dumps(man))
+        with pytest.raises(CorruptModelError) as ei:
+            reg.get(v)
+        assert ei.value.reason == "truncated"
+
+    def test_list_versions_marks_roles(self, tmp_path):
+        reg = make_model_registry(str(tmp_path))
+        reg.publish(_model(0))
+        reg.publish(_model(1))
+        reg.promote(1)
+        rows = reg.list_versions()
+        assert [r["role"] for r in rows] == ["champion", "candidate"]
+
+
+class TestTornManifest:
+    def test_torn_manifest_is_corrupt_not_valueerror(self, tmp_path):
+        """A torn manifest PUT (unparseable JSON) must surface as
+        CorruptModelError — counted + quarantined — never as a stray
+        ValueError that would kill the serving loop's promotion gate."""
+        reg = make_model_registry(str(tmp_path))
+        v = reg.publish(_model())
+        (tmp_path / "model-v0000001.json").write_text('{"version": 1, "sh')
+        before = _counter("rtfds_model_artifact_corrupt_total",
+                          reason="truncated")
+        with pytest.raises(CorruptModelError) as ei:
+            reg.get(v)
+        assert ei.value.reason == "truncated"
+        assert _counter("rtfds_model_artifact_corrupt_total",
+                        reason="truncated") == before + 1
+        assert reg.versions() == []  # quarantined, both files
+        stale = [n for n in os.listdir(tmp_path) if n.startswith("stale-")]
+        assert len(stale) == 2
+
+    def test_verify_all_reports_torn_manifest(self, tmp_path):
+        reg = make_model_registry(str(tmp_path))
+        reg.publish(_model(0))
+        reg.publish(_model(1))
+        (tmp_path / "model-v0000002.json").write_bytes(b"\xff\xfe not json")
+        report = reg.verify_all()  # must not raise
+        by_v = {e["version"]: e for e in report}
+        assert by_v[1]["valid"]
+        assert not by_v[2]["valid"]
+        assert by_v[2]["reason"] == "truncated"
+        # the preflight never quarantines
+        assert reg.versions() == [1, 2]
+
+
+class TestTornChampionPointer:
+    def test_torn_pointer_quarantined_not_silent_absence(self, tmp_path):
+        """A champion.json whose bytes exist but do not parse (torn PUT)
+        must NOT read as 'no champion was ever promoted' — that would
+        silently revert serving to the bootstrap model and let the next
+        promote rebuild an empty history. It is quarantined (stale-
+        rename, bytes preserved for history recovery), counted, and only
+        then does the registry proceed as pointerless."""
+        reg = make_model_registry(str(tmp_path))
+        v1 = reg.publish(_model(0), source="bootstrap")
+        reg.promote(v1, by="bootstrap")
+        v2 = reg.publish(_model(1), parent=v1)
+        reg.promote(v2)
+        assert reg.champion_version() == 2
+        (tmp_path / "champion.json").write_bytes(b'{"version": 2, "hist')
+        before = _counter("rtfds_model_artifact_corrupt_total",
+                          reason="truncated")
+        assert reg.champion_version() is None  # loud fallback, no crash
+        assert _counter("rtfds_model_artifact_corrupt_total",
+                        reason="truncated") == before + 1
+        stales = [n for n in os.listdir(tmp_path)
+                  if n.startswith("stale-") and n.endswith("champion.json")]
+        assert len(stales) == 1  # forensics: the torn bytes survive
+        # self-heals: an explicit promote writes a fresh pointer
+        reg.promote(v2)
+        assert reg.champion_version() == 2
+
+    def test_non_object_pointer_is_corrupt(self, tmp_path):
+        reg = make_model_registry(str(tmp_path))
+        (tmp_path / "champion.json").write_text("[1, 2, 3]")
+        assert reg.champion_version() is None
+        assert any(n.startswith("stale-") for n in os.listdir(tmp_path))
