@@ -87,6 +87,16 @@ chaos-smoke:
 recovery-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_recovery_smoke.py -q
 
+# static-analysis gate: the project-native analyzer (tools/rtfdslint)
+# must report ZERO unbaselined P0/P1 findings over the whole package —
+# recompile hazards, cross-thread races, exception-taxonomy erosion,
+# wall-clock durations, metric-name drift, loop-thread blocking. Runs
+# jax-free (pure stdlib ast). Accept a deliberate finding with an
+# inline `# rtfdslint: disable=<rule> (<reason>)` pragma or
+# `rtfds lint --update-baseline --reason '...'`.
+lint-static:
+	$(PY) -m real_time_fraud_detection_system_tpu.cli lint
+
 # continuous-learning gate: champion serves, the streaming learner
 # trains a candidate on injected labeled feedback, the shadow's live
 # recall overtakes the champion's, promotion fires, an injected
@@ -136,4 +146,4 @@ install:
 clean:
 	rm -rf $(OUT)
 
-.PHONY: demo datagen train score run-all query dashboard connectors dryrun trace-demo bench perf-smoke chaos-smoke recovery-smoke learn-smoke test integration integration-up integration-down sqlcheck install clean
+.PHONY: demo datagen train score run-all query dashboard connectors dryrun trace-demo bench perf-smoke chaos-smoke recovery-smoke learn-smoke lint-static test integration integration-up integration-down sqlcheck install clean

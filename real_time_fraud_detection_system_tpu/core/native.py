@@ -31,6 +31,18 @@ from typing import Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+
+class NativeUnavailableError(RuntimeError):
+    """The native .so could not be built/loaded in this process.
+
+    A deploy/toolchain condition, not a data fault: callers gate via
+    :func:`native_available` / :func:`hostprep_available`, so reaching
+    this raise means a caller skipped the gate — fail fast with a type
+    the supervisor taxonomy can tell apart from a jax-internal
+    RuntimeError (subclasses RuntimeError for back-compat with any
+    external catcher)."""
+
+
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _build_error: Optional[str] = None
@@ -67,6 +79,7 @@ def _build_and_load(name: str, configure) -> "Tuple[Optional[ctypes.CDLL], Optio
     try:
         if not os.path.exists(so) or \
                 os.path.getmtime(so) < os.path.getmtime(src):
+            # rtfdslint: disable=blocking-call-on-loop-thread (one-time native build on first decode; .so is cached for the process/filesystem lifetime)
             subprocess.run(
                 ["g++", "-O3", "-shared", "-fPIC", "-o", so, src],
                 check=True, capture_output=True, text=True, timeout=120,
@@ -177,7 +190,8 @@ def decode_envelopes_slab(
     can pin per-slab exactness against the whole-batch decode."""
     lib = _load()
     if lib is None:
-        raise RuntimeError(f"native decoder unavailable: {_build_error}")
+        raise NativeUnavailableError(
+            f"native decoder unavailable: {_build_error}")
     if b > a:
         lib.decode_envelopes(
             buf, offsets[a : b + 1], b - a,
@@ -208,7 +222,8 @@ def decode_transaction_envelopes_native(
     costs ~2× the join (measured 108 ms vs 54 ms at 200k messages)."""
     lib = _load()
     if lib is None:
-        raise RuntimeError(f"native decoder unavailable: {_build_error}")
+        raise NativeUnavailableError(
+            f"native decoder unavailable: {_build_error}")
     msgs: List[bytes] = (
         messages if isinstance(messages, list) else list(messages)
     )
@@ -305,7 +320,8 @@ def latest_wins_keep(tx_id: np.ndarray, ts: np.ndarray) -> np.ndarray:
     ops.dedup.latest_wins_mask_np with all rows valid), O(n) hash pass."""
     lib = _load_hostprep()
     if lib is None:
-        raise RuntimeError(f"native hostprep unavailable: {_hp_error}")
+        raise NativeUnavailableError(
+            f"native hostprep unavailable: {_hp_error}")
     n = len(tx_id)
     keep = np.zeros(n, dtype=np.uint8)
     if n:
@@ -327,7 +343,8 @@ def pack_rows(
     bit-identical to the NumPy composition (tests/test_native.py)."""
     lib = _load_hostprep()
     if lib is None:
-        raise RuntimeError(f"native hostprep unavailable: {_hp_error}")
+        raise NativeUnavailableError(
+            f"native hostprep unavailable: {_hp_error}")
     n = len(tx_datetime_us)
     if pad < n:
         raise ValueError(f"pad={pad} < batch rows {n}")

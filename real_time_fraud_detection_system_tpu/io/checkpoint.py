@@ -412,6 +412,7 @@ class _StoreBackend:
             def run():
                 try:
                     box["v"] = fn()
+                # rtfdslint: disable=broad-exception-catch (thread-boundary transport: the op-timeout thread parks the ORIGINAL exception for the caller to re-raise through the typed retry policy)
                 except BaseException as e:  # reported to the caller thread
                     box["e"] = e
 
@@ -817,13 +818,14 @@ class _CheckpointerBase:
         listing verdict: one read per entry — the zip layer's own entry
         CRCs still catch bit-flips in the entry itself, but a broken
         chain link only surfaces under ``deep``."""
-        now = time.time()
+        now = time.time()  # vs backend mtime: cross-process wall age
         out = []
         for n in self._live_names():
             info = self._backend.info(n)
             entry = {
                 "path": self._backend.path_of(n),
                 "size": info.get("size"),
+                # rtfdslint: disable=wall-clock-duration (age vs the backend's mtime — a wall-clock stamp written by ANOTHER process; perf_counter has no cross-process meaning)
                 "age_s": (round(now - info["mtime"], 1)
                           if info.get("mtime") else None),
             }

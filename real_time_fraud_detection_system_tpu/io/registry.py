@@ -303,7 +303,8 @@ class ModelRegistry:
             stale = f"stale-{token}-{CHAMPION_KEY}"
             try:
                 self._backend.move(CHAMPION_KEY, stale)
-            except Exception:  # noqa: BLE001 — quarantine is best-effort
+            # rtfdslint: disable=broad-exception-catch (quarantine of an unreadable champion pointer is best-effort forensics; the fallback-to-bootstrap path below is the real handling and must run regardless of what the move raised)
+            except Exception:
                 stale = "(could not quarantine)"
             get_logger("registry").error(
                 "champion pointer is unreadable (%s: %s) — quarantined "

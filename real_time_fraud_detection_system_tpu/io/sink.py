@@ -192,7 +192,9 @@ class AsyncSink:
                     return
                 if self._error is None:
                     try:
+                        # rtfdslint: disable=cross-thread-race (drain() is the guard: every loop-side inner access — flush/truncate_after/read_all/concat — calls drain() first, and q.join() orders every writer append strictly before it; crash/replay lineage tests pin the contract)
                         self.inner.append(item)
+                    # rtfdslint: disable=broad-exception-catch (thread-boundary transport: the writer parks the ORIGINAL exception; append/drain re-raise it typed on the loop thread for the supervisor's recover_on policy)
                     except BaseException as e:  # propagate to loop thread
                         self._error = _SinkError(
                             e, int(getattr(item, "batch_index", -1)))

@@ -662,6 +662,7 @@ def _run_watched(engine, source, sink, checkpointer, max_batches,
                     feedback=g_feedback, model_reload=g_model_reload,
                     learning=g_learning,
                 )
+        # rtfdslint: disable=broad-exception-catch (thread-boundary transport: the ORIGINAL exception object crosses to the supervisor thread, which applies the typed recover_on policy — narrowing here would strip the taxonomy, not preserve it)
         except BaseException as e:  # report into the supervisor thread
             box["err"] = e
 
@@ -972,6 +973,7 @@ def run_with_recovery(
             if close is not None and not last_was_stall:
                 try:
                     close()
+                # rtfdslint: disable=exception-swallow (best-effort close of a DEAD incarnation's source; the real crash is already being handled by the supervisor — a close error here must not mask it)
                 except Exception:  # a dying session may not close cleanly
                     pass
             source = make_source()
@@ -1098,6 +1100,7 @@ def run_with_recovery(
                 # be inside it — leak that one rather than hang here).
                 try:
                     feedback.close()
+                # rtfdslint: disable=exception-swallow (best-effort close of the dead incarnation's feedback session so the group rebalances; the crash being recovered is the signal, not this close)
                 except Exception:
                     pass
             log.warning("engine crashed (%s); restart %d/%d",

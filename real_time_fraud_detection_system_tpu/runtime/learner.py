@@ -567,6 +567,7 @@ class StreamingLearner:
                     with self._train_cond:
                         self._in_train = False
                         self._train_cond.notify_all()
+            # rtfdslint: disable=broad-exception-catch (thread-boundary transport: the training thread parks the ORIGINAL exception for the loop thread to re-raise typed)
             except BaseException as e:  # reported to the loop thread
                 self._err = e
             finally:
@@ -849,7 +850,8 @@ class LearningLoop:
             self.shadow.champion.reset()
             if self.learner is not None:
                 self.learner.reset(params, scaler, v)
-        except Exception as e:  # noqa: BLE001 — lineage is best-effort here
+        # rtfdslint: disable=broad-exception-catch (lineage registration of a hot-reload is best-effort: ANY registry failure must leave serving on the already-swapped params, warn-logged)
+        except Exception as e:
             log.warning("could not register hot-reloaded params as a "
                         "version (%s: %s); serving is unaffected",
                         type(e).__name__, e)
@@ -888,7 +890,8 @@ class LearningLoop:
         through ``take_published``.)"""
         try:
             vs = self.registry.versions()
-        except Exception as e:  # noqa: BLE001 — a flaky listing skips one poll
+        # rtfdslint: disable=broad-exception-catch (a flaky registry listing skips ONE external-candidate poll and retries next cadence; any store/parse error type lands here via the backend)
+        except Exception as e:
             log.warning("registry poll for external candidates failed "
                         "(%s: %s); retrying next cadence",
                         type(e).__name__, e)

@@ -129,6 +129,7 @@ class PrefetchSource:
                 # advances the consumed position to exactly here.
                 if not put((cols, list(self.inner.offsets))):
                     return
+        # rtfdslint: disable=broad-exception-catch (thread-boundary transport: the producer ships the ORIGINAL exception to the consumer thread, which re-raises it typed for the supervisor)
         except BaseException as e:  # re-raised on the consumer thread
             put(_Err(e))
 

@@ -171,8 +171,11 @@ class Tracer:
                 import jax
 
                 self._annotation_cls = jax.profiler.TraceAnnotation
-            except Exception:
-                self._annotation_cls = None  # stdlib-only process: fine
+            except (ImportError, AttributeError, RuntimeError):
+                # stdlib-only process, or a broken jax/jaxlib pairing
+                # (raises RuntimeError at import): tracing degrades to
+                # plain spans, never kills the run
+                self._annotation_cls = None
         if not annotate:
             self._annotation_cls = None
         if self.enabled and self._m_spans is None:

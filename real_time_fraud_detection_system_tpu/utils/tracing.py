@@ -35,6 +35,7 @@ def enable_compilation_cache(path: Optional[str] = None) -> None:
     try:
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    # rtfdslint: disable=broad-exception-catch (cache enablement must degrade to a LOUD warning whatever jax.config raises across versions — a silently-cold cache costs 20-40 s per compile over the tunnel)
     except Exception as e:
         # A silently-cold cache costs 20-40 s PER COMPILE over the
         # tunnel on every restart — the operator must see why.

@@ -65,7 +65,9 @@ def install_compile_telemetry() -> bool:
             return True
         try:
             import jax.monitoring as monitoring
-        except Exception:
+        except (ImportError, AttributeError, RuntimeError):
+            # RuntimeError: mismatched jax/jaxlib raises at import time —
+            # telemetry answers "unavailable", it never crashes the host
             return False
         reg = get_registry()
         m_compiles = reg.counter(
@@ -248,13 +250,17 @@ class DeviceMemoryTelemetry:
                 import jax
 
                 self._devices = jax.local_devices()
-            except Exception:
+            except (ImportError, RuntimeError, AttributeError):
+                # AttributeError: partially-broken jax (import succeeds,
+                # local_devices missing) — this runs per batch on the
+                # loop thread, so telemetry self-disables, never crashes
                 self._dead = True
                 return
         any_stats = False
         for i, d in enumerate(self._devices):
             try:
                 stats = d.memory_stats()
+            # rtfdslint: disable=broad-exception-catch (memory_stats is a per-backend C++ binding that can raise arbitrary plugin errors; telemetry must sample-or-skip, never kill the batch)
             except Exception:
                 stats = None
             if not stats:

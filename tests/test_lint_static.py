@@ -1,0 +1,87 @@
+"""Tier-1 static-analysis gate — the in-process twin of
+``make lint-static``.
+
+Two halves, both required by the PR-8 acceptance bar:
+
+1. The whole package lints CLEAN: zero unbaselined P0/P1 findings,
+   every pragma and baseline entry carrying a reason (a reason-less
+   pragma surfaces as its own P1, a reason-less baseline entry refuses
+   to load — so the one assertion covers the workflow rules too).
+2. The gate is evidence of analyzer SENSITIVITY, not just absence of
+   findings: a seeded cross-thread race and a seeded recompile hazard,
+   linted under the very same configuration, MUST be flagged. A lint
+   that stopped seeing bugs would fail here, not pass vacuously.
+"""
+
+import os
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from rtfdslint import run_lint  # noqa: E402
+from rtfdslint.runner import DEFAULT_BASELINE  # noqa: E402
+
+
+def test_package_lints_clean_with_committed_baseline():
+    res = run_lint(REPO)  # default targets + committed baseline
+    gate = res.gate_failures()
+    assert gate == [], "unbaselined P0/P1 findings:\n" + "\n".join(
+        f.render() for f in gate)
+    # the committed baseline must be live, not a fossil: no stale
+    # entries (delete them when the finding disappears)
+    assert res.stale_baseline == [], res.stale_baseline
+    # P2s are advisory but bounded: new undocumented metrics must go
+    # into the README catalog, not accumulate silently
+    p2 = [f for f in res.findings if f.severity == "P2"]
+    assert len(p2) == 0, "advisory findings crept in:\n" + "\n".join(
+        f.render() for f in p2)
+
+
+def test_committed_baseline_entries_all_carry_reasons():
+    import json
+    path = os.path.join(REPO, DEFAULT_BASELINE)
+    with open(path) as f:
+        data = json.load(f)
+    assert data["entries"], "baseline exists but is empty?"
+    for ent in data["entries"]:
+        assert str(ent.get("reason", "")).strip(), ent
+
+
+def test_gate_is_sensitive_not_vacuous(tmp_path):
+    """Seeded race + recompile hazard must be FLAGGED under the same
+    rule set that just passed the package."""
+    pkg = tmp_path / "seeded"
+    pkg.mkdir()
+    (pkg / "race.py").write_text(textwrap.dedent("""
+        import threading
+
+        class Sneaky:
+            def __init__(self):
+                self.hits = 0
+                t = threading.Thread(target=self._work, daemon=True)
+                t.start()
+
+            def _work(self):
+                self.hits += 1
+
+            def read(self):
+                return self.hits
+    """))
+    (pkg / "recompile.py").write_text(textwrap.dedent("""
+        import jax
+
+        def step(x):
+            if x.sum() > 0:
+                return x * 2
+            return float(x[0])
+
+        step_j = jax.jit(step)
+    """))
+    res = run_lint(str(tmp_path), targets=["seeded"], baseline_path=None)
+    rules = {f.rule for f in res.findings}
+    assert "cross-thread-race" in rules, [f.render() for f in res.findings]
+    assert "jit-recompile-hazard" in rules, [f.render()
+                                            for f in res.findings]
+    assert res.gate_failures(), "seeded bugs did not gate"

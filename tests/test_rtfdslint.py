@@ -1,0 +1,1240 @@
+"""rtfdslint unit + fixture tests: every rule proven to FIRE on a
+known-bad snippet and stay QUIET on the matching known-good one, plus
+the pragma/baseline workflow and the analyzer's self-check.
+
+The analyzer is pure stdlib ``ast`` — no jax import anywhere here, so
+this file is one of the cheapest in tier-1.
+"""
+# The fixture strings below deliberately contain malformed pragmas,
+# reason-less pragmas and unregistered rtfds_* names; the analyzer
+# scans tests/ too (metric two-way diff + pragma hygiene), so this
+# file opts out of exactly those rules:
+# rtfdslint: disable-file=metric-name-drift,pragma-missing-reason,pragma-malformed,pragma-unknown-rule (fixture strings are known-bad INPUTS to the analyzer under test, not live code)
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from rtfdslint import run_lint  # noqa: E402
+from rtfdslint.baseline import Baseline, BaselineError  # noqa: E402
+from rtfdslint.pragmas import parse_pragmas  # noqa: E402
+from rtfdslint.runner import update_baseline  # noqa: E402
+
+PKG = "real_time_fraud_detection_system_tpu"
+
+
+def lint_tree(tmp_path, files, targets=None, readme=None, tests=None,
+              baseline=None, rules=None, report_stale=None):
+    """Write a throwaway tree and lint it."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    if readme is not None:
+        (tmp_path / "README.md").write_text(textwrap.dedent(readme))
+    for rel, src in (tests or {}).items():
+        p = tmp_path / "tests" / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return run_lint(str(tmp_path),
+                    targets=targets or sorted({r.split("/")[0]
+                                               for r in files}),
+                    baseline_path=baseline, rules=rules,
+                    report_stale=report_stale)
+
+
+def names(result):
+    return [(f.rule, f.path, f.line) for f in result.findings]
+
+
+# --------------------------------------------------------------------------
+# rule 1: jit-recompile-hazard
+# --------------------------------------------------------------------------
+
+JIT_BAD = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def helper(v):
+        return float(v)          # tainted through the call graph
+
+    def step(state, x):
+        if x.sum() > 0:          # value branch on a tracer
+            state = state + 1
+        n = int(x[0])            # concretizing cast
+        pad = jnp.zeros(n)       # non-static shape
+        y = np.asarray(x)        # numpy forces concretization
+        v = x.mean().item()      # host sync
+        w = helper(x)            # interprocedural taint
+        return state, pad, y, v, w
+
+    step_j = jax.jit(step)
+"""
+
+JIT_GOOD = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    TABLE = np.arange(8)
+
+    def step(state, x, mode):
+        if mode == "fast":            # static_argnames param
+            state = state * 2
+        if x.shape[0] > 4:            # shapes are static under trace
+            state = state + 1
+        if x is None:                 # identity never concretizes
+            return state
+        k = x.shape[1]
+        pad = jnp.zeros(k)            # shape-derived size: static
+        lut = jnp.asarray(TABLE)      # numpy on a CONSTANT, not a tracer
+        n = int(x.shape[0])           # cast of a static shape
+        return state + pad.sum() + lut[0] + n
+
+    step_j = jax.jit(step, static_argnames=("mode",))
+"""
+
+
+def test_jit_rule_fires_on_every_hazard_kind(tmp_path):
+    res = lint_tree(tmp_path, {"pkg/mod.py": JIT_BAD},
+                    rules=["jit-recompile-hazard"])
+    lines = sorted(f.line for f in res.findings)
+    msgs = " | ".join(f.message for f in res.findings)
+    assert len(res.findings) == 6, names(res)
+    assert all(f.severity == "P0" for f in res.findings)
+    for marker in ("branching", "int()", "non-static shape",
+                   "np.asarray", ".item()", "float()"):
+        assert marker in msgs, f"missing hazard kind {marker!r}: {msgs}"
+    # the interprocedural float() finding lands in helper's body
+    helper_hits = [f for f in res.findings if f.context.endswith("helper")]
+    assert len(helper_hits) == 1
+    assert lines[0] < lines[-1]
+
+
+def test_jit_rule_quiet_on_static_idioms(tmp_path):
+    res = lint_tree(tmp_path, {"pkg/mod.py": JIT_GOOD},
+                    rules=["jit-recompile-hazard"])
+    assert res.findings == [], names(res)
+
+
+def test_jit_rule_static_argnums_counts_self_on_methods(tmp_path):
+    """Regression: jax's static_argnums counts self as position 0 on a
+    method — index 0 must NOT resolve to the first real parameter."""
+    src = """
+        import jax
+        from functools import partial
+
+        class Scorer:
+            @partial(jax.jit, static_argnums=(0, 2))
+            def step(self, x, mode):
+                if mode == "a":          # index 2: static, fine
+                    return x * 2
+                return float(x[0])       # x (index 1) IS traced: hazard
+
+        s = Scorer()
+    """
+    res = lint_tree(tmp_path, {"pkg/mod.py": src},
+                    rules=["jit-recompile-hazard"])
+    msgs = " | ".join(f.message for f in res.findings)
+    assert "float()" in msgs, names(res)
+    assert "branching" not in msgs, names(res)
+
+
+def test_jit_rule_attribute_store_does_not_retaint_base(tmp_path):
+    """Regression: `obj.y = traced` must not taint (or launder) the
+    base name `obj` itself."""
+    src = """
+        import jax
+
+        class Box:
+            pass
+
+        def step(x, s):
+            s.y = x                  # attribute store: s itself unchanged
+            if s.big_mode:           # plain Python flag on s: no hazard
+                x = x * 2
+            return x
+
+        step_j = jax.jit(step, static_argnames=("s",))
+    """
+    res = lint_tree(tmp_path, {"pkg/mod.py": src},
+                    rules=["jit-recompile-hazard"])
+    assert res.findings == [], names(res)
+
+
+def test_wall_clock_rebind_to_perf_counter_kills_wall_status(tmp_path):
+    """Regression: reusing a timer name for a perf_counter delta after
+    a wall stamp must not flag the monotonic delta."""
+    src = """
+        import time
+
+        def mixed():
+            t = time.time()          # wall stamp
+            stamp = {"t": t}
+            t = time.perf_counter()  # rebind: t is monotonic now
+            work()
+            return stamp, time.perf_counter() - t
+    """
+    res = lint_tree(tmp_path, {"pkg/mod.py": src},
+                    rules=["wall-clock-duration"])
+    assert res.findings == [], names(res)
+
+
+def test_jit_rule_honors_static_argnums_positional(tmp_path):
+    src = """
+        import jax
+
+        def step(x, n):
+            return x.reshape(n) if n > 0 else x   # n is static
+
+        step_j = jax.jit(step, static_argnums=(1,))
+    """
+    res = lint_tree(tmp_path, {"pkg/mod.py": src},
+                    rules=["jit-recompile-hazard"])
+    assert res.findings == [], names(res)
+
+
+def test_jit_rule_shape_property_launders_taint(tmp_path):
+    src = """
+        import jax
+        import jax.numpy as jnp
+        from typing import NamedTuple
+
+        class State(NamedTuple):
+            events: jnp.ndarray
+
+            @property
+            def capacity(self) -> int:
+                return int(self.events.shape[0])
+
+        def step(state, x):
+            k = state.capacity      # shape-derived property: static
+            if k > 4:
+                x = x + 1
+            return jnp.arange(k) + x.sum()
+
+        step_j = jax.jit(step)
+    """
+    res = lint_tree(tmp_path, {"pkg/mod.py": src},
+                    rules=["jit-recompile-hazard"])
+    assert res.findings == [], names(res)
+
+
+# --------------------------------------------------------------------------
+# rule 2: cross-thread-race + lock-order-cycle
+# --------------------------------------------------------------------------
+
+RACE_BAD = """
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self.counter = 0
+            self.rows = []
+            self._t = threading.Thread(target=self._work, daemon=True)
+            self._t.start()
+
+        def _work(self):
+            while True:
+                self.counter += 1          # unguarded RMW in the worker
+                self.rows.append(1)        # unguarded mutation
+
+        def stats(self):
+            return self.counter, len(self.rows)   # read on the loop side
+"""
+
+RACE_GOOD = """
+    import queue
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self._q = queue.Queue()
+            self._lock = threading.Lock()
+            self.counter = 0
+            self.latest = None
+            self._t = threading.Thread(target=self._work, daemon=True)
+            self._t.start()
+
+        def _work(self):
+            while True:
+                item = self._q.get()       # sync object: safe
+                with self._lock:
+                    self.counter += 1      # guarded RMW
+                self.latest = item         # atomic whole-object swap
+
+        def push(self, item):
+            self._q.put(item)
+
+        def stats(self):
+            with self._lock:
+                n = self.counter           # guarded read
+            return n, self.latest          # swap read: safe
+"""
+
+LOCK_CYCLE = """
+    import threading
+
+    class Banks:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+            self._b_lock = threading.Lock()
+            self._t = threading.Thread(target=self.ab, daemon=True)
+            self._t.start()
+
+        def ab(self):
+            with self._a_lock:
+                with self._b_lock:
+                    pass
+
+        def ba(self):
+            with self._b_lock:
+                with self._a_lock:
+                    pass
+"""
+
+
+def test_race_rule_flags_seeded_race(tmp_path):
+    res = lint_tree(tmp_path, {"pkg/mod.py": RACE_BAD},
+                    rules=["cross-thread-race"])
+    attrs = {f.message.split()[0] for f in res.findings}
+    assert attrs == {"self.counter", "self.rows"}, names(res)
+    assert all(f.severity == "P1" for f in res.findings)
+    msg = next(f.message for f in res.findings
+               if f.message.startswith("self.counter"))
+    assert "worker-side Pump._work" in msg and "Pump.stats" in msg
+
+
+def test_race_rule_flags_one_sided_locking(tmp_path):
+    """Regression: a lock on ONE side does not make the other side's
+    bare RMW safe — a lock only excludes other lock holders."""
+    src = """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+                self._t = threading.Thread(target=self._work, daemon=True)
+                self._t.start()
+
+            def _work(self):
+                while True:
+                    with self._lock:
+                        self.n += 1        # guarded side
+
+            def bump(self):
+                self.n += 1                # UNGUARDED loop-side RMW
+    """
+    res = lint_tree(tmp_path, {"pkg/mod.py": src},
+                    rules=["cross-thread-race"])
+    assert len(res.findings) == 1, names(res)
+    assert "Pump.bump" in res.findings[0].message
+    assert "(guarded)" in res.findings[0].message
+
+
+def test_lockish_is_token_anchored_not_substring(tmp_path):
+    """Regression: 'cond' in 'seconds' / 'lock' in 'clock' must not
+    exclude plain attributes from race analysis."""
+    src = """
+        import threading
+
+        class Meter:
+            def __init__(self):
+                self.wait_seconds = 0.0
+                self._t = threading.Thread(target=self._work, daemon=True)
+                self._t.start()
+
+            def _work(self):
+                while True:
+                    self.wait_seconds += 1.0   # NOT a lock: analyzed
+
+            def read(self):
+                return self.wait_seconds
+    """
+    res = lint_tree(tmp_path, {"pkg/mod.py": src},
+                    rules=["cross-thread-race"])
+    assert len(res.findings) == 1, names(res)
+    assert "wait_seconds" in res.findings[0].message
+
+
+def test_lock_order_cycle_multi_item_with(tmp_path):
+    """Regression: `with self._a, self._b:` acquires a then b — the
+    combined form must feed the same order graph as nested withs."""
+    src = """
+        import threading
+
+        class Banks:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+                self._t = threading.Thread(target=self.ab, daemon=True)
+                self._t.start()
+
+            def ab(self):
+                with self._a_lock, self._b_lock:
+                    pass
+
+            def ba(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+    """
+    res = lint_tree(tmp_path, {"pkg/mod.py": src},
+                    rules=["cross-thread-race", "lock-order-cycle"])
+    cyc = [f for f in res.findings if f.rule == "lock-order-cycle"]
+    assert len(cyc) == 1, names(res)
+
+
+def test_jit_rule_keyword_args_carry_taint(tmp_path):
+    src = """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def step(x, n):
+            a = jnp.zeros(shape=n)       # keyword-spelled traced shape
+            b = np.asarray(a=x)          # keyword-spelled numpy call
+            return a, b
+
+        step_j = jax.jit(step)
+    """
+    res = lint_tree(tmp_path, {"pkg/mod.py": src},
+                    rules=["jit-recompile-hazard"])
+    msgs = " | ".join(f.message for f in res.findings)
+    assert "non-static shape" in msgs, names(res)
+    assert "np.asarray" in msgs, names(res)
+
+
+def test_pragma_covers_wrapped_statement(tmp_path):
+    """Regression: a comment-line pragma above a statement that wraps
+    across physical lines must cover the whole statement span."""
+    src = """
+        import time
+
+        def wrapped(t0):
+            # rtfdslint: disable=wall-clock-duration (cross-process age on purpose)
+            d = (
+                time.time() - t0
+            )
+            return d
+    """
+    res = lint_tree(tmp_path, {"pkg/mod.py": src},
+                    rules=["wall-clock-duration"])
+    assert res.findings == [], names(res)
+    assert len(res.suppressed) == 1
+
+
+def test_update_baseline_with_no_baseline_refused():
+    from rtfdslint.cli import main as lint_main
+    rc = lint_main(["--root", REPO, "--no-baseline", "--update-baseline",
+                    "--reason", "probe"])
+    assert rc == 2
+
+
+def test_focused_run_ignores_unrelated_pragma_hygiene(tmp_path):
+    """Regression: a --rule-focused run must not fail on a reason-less
+    pragma belonging to a different rule (full gate still catches it)."""
+    src = """
+        import time
+
+        def f(ts):
+            # rtfdslint: disable=wall-clock-duration
+            return time.time() - ts
+    """
+    res = lint_tree(tmp_path, {"pkg/mod.py": src},
+                    rules=["blocking-call-on-loop-thread"])
+    assert res.findings == [], names(res)
+    full = lint_tree(tmp_path, {"pkg/mod.py": src})
+    assert any(f.rule == "pragma-missing-reason" for f in full.findings)
+
+
+def test_thread_entry_point_never_inherits_lock_context(tmp_path):
+    """Regression: Thread(target=self._work) invokes _work with NO lock
+    held — a guarded in-code call site must not mark _work guarded."""
+    src = """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.x = 0
+                self._t = threading.Thread(target=self._work, daemon=True)
+                self._t.start()
+
+            def _work(self):
+                self.x += 1            # thread runs this UNGUARDED
+
+            def replay(self):
+                with self._lock:
+                    self._work()       # the only in-code call site
+    """
+    res = lint_tree(tmp_path, {"pkg/mod.py": src},
+                    rules=["cross-thread-race"])
+    assert len(res.findings) == 1, names(res)
+    assert "self.x" in res.findings[0].message
+
+
+def test_raise_caught_name_counts_as_reraise(tmp_path):
+    """Regression: `except Exception as e: ...; raise e` preserves the
+    type exactly like a bare raise — not a broad-catch finding."""
+    src = """
+        def f():
+            try:
+                g()
+            except Exception as e:
+                note(e)
+                raise e
+    """
+    res = lint_tree(tmp_path, {"pkg/runtime/mod.py": src},
+                    rules=["broad-exception-catch"])
+    assert res.findings == [], names(res)
+
+
+def test_explicit_targets_suppress_stale_reporting(tmp_path):
+    """Regression: run_lint with a narrowed explicit target list must
+    not advise deleting out-of-scope baseline entries by default."""
+    files = {"pkg/runtime/mod.py": """
+        def f():
+            raise RuntimeError("boom")
+    """, "pkg/other/mod.py": "X = 1\n"}
+    res = lint_tree(tmp_path, files, baseline=None)
+    update_baseline(str(tmp_path), res, "bl.json", reason="accepted")
+    narrow = lint_tree(tmp_path, files, targets=["pkg/other"],
+                       baseline="bl.json")
+    assert narrow.stale_baseline == [], narrow.stale_baseline
+
+
+def test_race_rule_quiet_on_guarded_class(tmp_path):
+    res = lint_tree(tmp_path, {"pkg/mod.py": RACE_GOOD},
+                    rules=["cross-thread-race"])
+    assert res.findings == [], names(res)
+
+
+def test_race_rule_no_self_race_on_worker_only_helper(tmp_path):
+    """Regression: a private helper reachable only from the worker
+    thread must not be counted on the loop side too (it reported
+    single-thread-owned code as racing with itself)."""
+    src = """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._n = 0
+                self._t = threading.Thread(target=self._work, daemon=True)
+                self._t.start()
+
+            def _work(self):
+                while True:
+                    self._bump()
+
+            def _bump(self):
+                self._n += 1        # worker-owned: no second side
+    """
+    res = lint_tree(tmp_path, {"pkg/mod.py": src},
+                    rules=["cross-thread-race"])
+    assert res.findings == [], names(res)
+
+
+def test_focused_runs_do_not_report_stale_baseline(tmp_path):
+    """Regression: a --rule-narrowed run must not advise deleting live
+    baseline entries its rules never produced."""
+    files = {"pkg/runtime/mod.py": """
+        def f():
+            raise RuntimeError("boom")
+    """}
+    res = lint_tree(tmp_path, files, baseline=None)
+    update_baseline(str(tmp_path), res, "bl.json", reason="accepted")
+    focused = lint_tree(tmp_path, files, baseline="bl.json",
+                        rules=["wall-clock-duration"])
+    assert focused.stale_baseline == [], focused.stale_baseline
+    full = lint_tree(tmp_path, files, baseline="bl.json")
+    assert full.stale_baseline == []  # entry is live on the full run too
+
+
+def test_lambda_body_mutation_is_never_lock_guarded(tmp_path):
+    """Regression: a mutation inside a lambda BUILT under a lock runs
+    later, lock-free — it must be recorded unguarded."""
+    src = """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []
+                self._t = threading.Thread(target=self._work, daemon=True)
+                self._t.start()
+
+            def _work(self):
+                while True:
+                    self.items.append(1)      # worker-side mutation
+
+            def schedule(self, q, x):
+                with self._lock:
+                    q.put(lambda: self.items.append(x))  # runs UNLOCKED
+    """
+    res = lint_tree(tmp_path, {"pkg/mod.py": src},
+                    rules=["cross-thread-race"])
+    assert len(res.findings) == 1, names(res)
+    assert "self.items" in res.findings[0].message
+    # neither side may claim a guard: the lambda's lock was released
+    assert "(guarded)" not in res.findings[0].message
+
+
+def test_lock_order_cycle_detected(tmp_path):
+    res = lint_tree(tmp_path, {"pkg/mod.py": LOCK_CYCLE},
+                    rules=["cross-thread-race", "lock-order-cycle"])
+    cyc = [f for f in res.findings if f.rule == "lock-order-cycle"]
+    assert len(cyc) == 1, names(res)
+    assert "_a_lock" in cyc[0].message and "_b_lock" in cyc[0].message
+
+
+# --------------------------------------------------------------------------
+# rule 3: exception taxonomy
+# --------------------------------------------------------------------------
+
+def test_exception_rules_classified_paths(tmp_path):
+    src = """
+        def f():
+            raise RuntimeError("boom")          # generic in runtime/
+
+        def g():
+            try:
+                f()
+            except Exception:
+                pass                            # swallow
+
+        def h():
+            try:
+                f()
+            except Exception:
+                count()                         # substitute, no re-raise
+
+        def ok_reraise():
+            try:
+                f()
+            except Exception:
+                count()
+                raise                           # metering wrapper: fine
+
+        def ok_typed():
+            try:
+                f()
+            except (ValueError, OSError):
+                return None
+    """
+    res = lint_tree(tmp_path, {"pkg/runtime/mod.py": src},
+                    rules=["raise-generic-exception", "exception-swallow",
+                           "broad-exception-catch"])
+    got = {(f.rule, f.severity) for f in res.findings}
+    assert got == {("raise-generic-exception", "P1"),
+                   ("exception-swallow", "P1"),
+                   ("broad-exception-catch", "P1")}, names(res)
+    # identical code OUTSIDE runtime//io/ downgrades the two path-scoped
+    # rules to P2 (swallow stays P1 anywhere)
+    res2 = lint_tree(tmp_path, {"pkg2/models/mod.py": src},
+                     rules=["raise-generic-exception", "exception-swallow",
+                            "broad-exception-catch"])
+    sev = {(f.rule, f.severity) for f in res2.findings}
+    assert sev == {("raise-generic-exception", "P2"),
+                   ("exception-swallow", "P1"),
+                   ("broad-exception-catch", "P2")}
+
+
+def test_broad_catch_nested_reraise_does_not_exempt(tmp_path):
+    """Regression: a bare `raise` inside a nested def or a nested try's
+    own except block does not make the OUTER broad catch taxonomy-
+    preserving."""
+    src = """
+        def f():
+            try:
+                g()
+            except Exception:
+                def retry():
+                    try:
+                        cleanup()
+                    except OSError:
+                        raise          # inner context, not ours
+                schedule(retry)
+
+        def ok():
+            try:
+                g()
+            except Exception:
+                try:
+                    cleanup()
+                finally:
+                    raise              # still OUR exception context
+    """
+    res = lint_tree(tmp_path, {"pkg/runtime/mod.py": src},
+                    rules=["broad-exception-catch"])
+    ctxs = [f.context.split(":")[-1] for f in res.findings]
+    assert ctxs == ["f"], names(res)
+
+
+def test_metric_rule_runs_for_alternate_target_spellings(tmp_path):
+    """Regression: `./pkg` and an absolute path are the same target as
+    `pkg` — the whole-package metric contract must still apply."""
+    files = {
+        f"{PKG}/core/m.py": """
+            def setup(reg):
+                reg.counter("rtfds_real_total", "registered")
+        """,
+        f"{PKG}/io/dashboard.py": 'TILE = "rtfds_missing_total"\n',
+    }
+    for spelling in (f"./{PKG}", f"{PKG}/"):
+        res = lint_tree(tmp_path, files, targets=[spelling],
+                        readme="`rtfds_real_total`\n",
+                        rules=["metric-name-drift"])
+        assert [f.context for f in res.findings] == \
+            ["rtfds_missing_total"], (spelling, names(res))
+
+
+def test_strict_report_agrees_with_exit(tmp_path):
+    """Regression: under --strict the human gate line and JSON summary
+    must use the same strictness as the exit code."""
+    from rtfdslint.report import render_human
+
+    res = lint_tree(tmp_path, {"pkg/models/m.py": """
+        def f():
+            raise RuntimeError("x")     # P2 outside runtime//io/
+    """})
+    assert res.gate_failures() == [] and res.gate_failures(strict=True)
+    human = render_human(res, strict=True)
+    assert "FAIL" in human and "P0/P1/P2" in human
+    assert res.to_json(strict=True)["summary"]["gate_failures"] == 1
+    assert res.to_json()["summary"]["gate_failures"] == 0
+
+
+def test_jit_static_argnums_on_bound_method_target(tmp_path):
+    """Regression: jax.jit(self.step, static_argnums=(1,)) receives a
+    BOUND method — index 1 is the second real param, not the first."""
+    src = """
+        import jax
+
+        class Scorer:
+            def __init__(self):
+                self._j = jax.jit(self.step, static_argnums=(1,))
+
+            def step(self, x, n):
+                if n > 0:                # n is static: fine
+                    return float(x[0])   # x is traced: hazard
+                return x
+    """
+    res = lint_tree(tmp_path, {"pkg/mod.py": src},
+                    rules=["jit-recompile-hazard"])
+    msgs = " | ".join(f.message for f in res.findings)
+    assert "float()" in msgs, names(res)
+    assert "branching" not in msgs, names(res)
+
+
+def test_wall_clock_annassign_and_tuple_assign(tmp_path):
+    src = """
+        import time
+
+        def ann():
+            t0: float = time.time()
+            return end() - t0            # flagged: AnnAssign wall stamp
+
+        def tup():
+            t0, t1 = time.time(), time.time()
+            return t1 - t0               # flagged: tuple-form stamps
+
+        def killed():
+            t = time.time()
+            t: float = time.perf_counter()
+            return time.perf_counter() - t   # rebind killed wall status
+    """
+    res = lint_tree(tmp_path, {"pkg/mod.py": src},
+                    rules=["wall-clock-duration"])
+    ctxs = sorted(f.context.split(":")[-1] for f in res.findings)
+    assert ctxs == ["ann", "tup"], names(res)
+
+
+# --------------------------------------------------------------------------
+# rule 4: wall-clock-duration
+# --------------------------------------------------------------------------
+
+def test_wall_clock_rule(tmp_path):
+    src = """
+        import time
+
+        def bad_direct(t0):
+            return time.time() - t0
+
+        def bad_var():
+            start = time.time()
+            work()
+            return time.time() - start
+
+        def good_perf():
+            t0 = time.perf_counter()
+            work()
+            return time.perf_counter() - t0
+
+        def good_stamp():
+            return {"t": time.time()}            # timestamp, no delta
+
+        def accepted(ts):
+            # rtfdslint: disable=wall-clock-duration (age vs a stamp another process wrote)
+            return time.time() - ts
+    """
+    res = lint_tree(tmp_path, {"pkg/mod.py": src},
+                    rules=["wall-clock-duration"])
+    ctxs = sorted(f.context.split(":")[-1] for f in res.findings)
+    assert ctxs == ["bad_direct", "bad_var"], names(res)
+    assert len(res.suppressed) == 1
+    assert res.suppressed[0].context.endswith("accepted")
+
+
+# --------------------------------------------------------------------------
+# rule 5: metric-name-drift (two-way)
+# --------------------------------------------------------------------------
+
+def test_metric_drift_two_way(tmp_path):
+    files = {
+        f"{PKG}/core/m.py": """
+            def setup(reg):
+                reg.counter("rtfds_documented_total", "help")
+                reg.gauge("rtfds_orphan_gauge", "never documented")
+                reg.histogram("rtfds_lat_seconds", "latency")
+        """,
+        f"{PKG}/io/dashboard.py": """
+            TILES = ["rtfds_documented_total",
+                     "rtfds_lat_seconds_bucket",     # histogram suffix ok
+                     "rtfds_ghost_total"]            # registered nowhere
+        """,
+    }
+    readme = """
+        Catalog: `rtfds_documented_total`, `rtfds_lat_seconds`.
+    """
+    tests = {"test_m.py": """
+        def test_x(reg):
+            reg.counter("rtfds_test_local_total", "registered in tests")
+            assert reg.get("rtfds_test_local_total") is not None
+            assert reg.get("rtfds_documented_total") is not None
+    """}
+    res = lint_tree(tmp_path, files, targets=[PKG], readme=readme,
+                    tests=tests,
+                    rules=["metric-name-drift", "undocumented-metric"])
+    drift = [f for f in res.findings if f.rule == "metric-name-drift"]
+    undoc = [f for f in res.findings if f.rule == "undocumented-metric"]
+    assert [f.context for f in drift] == ["rtfds_ghost_total"], names(res)
+    assert drift[0].severity == "P1"
+    assert drift[0].path.endswith("io/dashboard.py")
+    assert [f.context for f in undoc] == ["rtfds_orphan_gauge"]
+    assert undoc[0].severity == "P2"
+
+
+def test_metric_drift_wildcard_prefix_documents_family(tmp_path):
+    files = {f"{PKG}/core/m.py": """
+        def setup(reg):
+            reg.counter("rtfds_family_alpha_total", "one of a family")
+            reg.counter("rtfds_family_beta_total", "another")
+    """}
+    res = lint_tree(tmp_path, files, targets=[PKG],
+                    readme="Documented as `rtfds_family_*`.\n",
+                    rules=["metric-name-drift", "undocumented-metric"])
+    assert res.findings == [], names(res)
+
+
+# --------------------------------------------------------------------------
+# rule 6: blocking-call-on-loop-thread
+# --------------------------------------------------------------------------
+
+def test_blocking_call_reachable_from_engine_step(tmp_path):
+    files = {f"{PKG}/runtime/engine.py": """
+        import time
+
+        def _helper():
+            time.sleep(0.1)              # reachable via run()
+
+        class ScoringEngine:
+            def run(self):
+                _helper()
+                self._paced()
+
+            def _paced(self):
+                # rtfdslint: disable=blocking-call-on-loop-thread (sanctioned wait point for the fixture)
+                time.sleep(0.2)
+
+        def unrelated():
+            time.sleep(9)                # NOT reachable: quiet
+    """}
+    res = lint_tree(tmp_path, files, targets=[PKG],
+                    rules=["blocking-call-on-loop-thread"])
+    assert [f.context.split(":")[-1] for f in res.findings] == ["_helper"]
+    assert len(res.suppressed) == 1, names(res)
+
+
+# --------------------------------------------------------------------------
+# pragmas + baseline workflow
+# --------------------------------------------------------------------------
+
+def test_pragma_requires_reason_and_is_itself_flagged(tmp_path):
+    src = """
+        import time
+
+        def f(ts):
+            # rtfdslint: disable=wall-clock-duration
+            return time.time() - ts
+    """
+    res = lint_tree(tmp_path, {"pkg/mod.py": src})
+    rules = {f.rule for f in res.findings}
+    # the reason-less pragma suppresses nothing AND is its own P1
+    assert "pragma-missing-reason" in rules
+    assert "wall-clock-duration" in rules
+    assert not res.suppressed
+
+
+def test_pragma_unknown_rule_and_malformed(tmp_path):
+    src = """
+        X = 1  # rtfdslint: disable=no-such-rule (because)
+        # rtfdslint: disable spelled wrong
+    """
+    res = lint_tree(tmp_path, {"pkg/mod.py": src})
+    got = {f.rule for f in res.findings}
+    assert "pragma-unknown-rule" in got
+    assert "pragma-malformed" in got
+
+
+def test_pragma_comment_line_governs_next_line():
+    fp, meta = parse_pragmas("x.py", (
+        "a = 1\n"
+        "# rtfdslint: disable=exception-swallow (transport with nested"
+        " parens like close() and q.join())\n"
+        "except_line = 2\n"
+        "b = 3  # rtfdslint: disable=wall-clock-duration (trailing form)\n"),
+        known_rules={"exception-swallow", "wall-clock-duration"})
+    assert not meta
+    assert fp.suppresses("exception-swallow", 3)      # next line
+    assert not fp.suppresses("exception-swallow", 2)  # not its own
+    assert fp.suppresses("wall-clock-duration", 4)    # trailing form
+
+
+def test_baseline_absorbs_and_reports_stale(tmp_path):
+    src = """
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """
+    res = lint_tree(tmp_path, {"pkg/runtime/mod.py": src})
+    fp = next(f for f in res.findings
+              if f.rule == "exception-swallow").fingerprint
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"format": 1, "entries": [
+        {"fingerprint": fp, "rule": "exception-swallow",
+         "path": "pkg/runtime/mod.py", "count": 1,
+         "reason": "fixture: accepted for the test"},
+        {"fingerprint": "dead00000000beef", "rule": "ghost-rule",
+         "path": "gone.py", "count": 1, "reason": "stale entry"},
+    ]}))
+    res2 = lint_tree(tmp_path, {"pkg/runtime/mod.py": src},
+                     baseline=str(bl), report_stale=True)
+    assert not any(f.rule == "exception-swallow" for f in res2.findings)
+    assert len(res2.baselined) == 1
+    assert [e["fingerprint"] for e in res2.stale_baseline] == \
+        ["dead00000000beef"]
+
+
+def test_jit_rule_sees_match_arms_and_ternaries(tmp_path):
+    """Regression: hazards inside match-case bodies and IfExp ternary
+    tests were invisible to the statement walker."""
+    src = """
+        import jax
+
+        def step(x, mode):
+            match mode:
+                case "a":
+                    return float(x[0])        # hazard inside a case arm
+                case _:
+                    y = x * 2 if x.sum() > 0 else x   # ternary branch
+                    return y
+
+        step_j = jax.jit(step, static_argnames=("mode",))
+    """
+    res = lint_tree(tmp_path, {"pkg/mod.py": src},
+                    rules=["jit-recompile-hazard"])
+    msgs = " | ".join(f.message for f in res.findings)
+    assert "float()" in msgs, names(res)
+    assert "branching" in msgs, names(res)
+    assert len(res.findings) == 2
+
+
+def test_plugin_registration_before_load_keeps_builtins():
+    """Regression: registering a repo-local plugin before the first
+    all_rules() call must not skip loading the built-in rules."""
+    import rtfdslint.registry as regmod
+    # force a pristine registry state: the rule modules must actually
+    # re-execute (their decorators register), so evict them from the
+    # import cache too
+    saved = (dict(regmod._RULES), regmod._loaded)
+    saved_mods = {k: v for k, v in sys.modules.items()
+                  if k.startswith("rtfdslint.rules")}
+    import rtfdslint as pkg
+    saved_attr = getattr(pkg, "rules", None)
+    try:
+        regmod._RULES.clear()
+        regmod._loaded = False
+        for k in saved_mods:
+            del sys.modules[k]
+        if saved_attr is not None:
+            # `from . import rules` short-circuits on the stale parent
+            # attribute; drop it so the re-import actually re-executes
+            delattr(pkg, "rules")
+
+        @regmod.register
+        class _PluginRule:
+            name = "zz-plugin-rule"
+            doc = "test plugin"
+
+            def run(self, project):
+                return []
+
+        names_now = {r.name for r in regmod.all_rules()}
+        assert "zz-plugin-rule" in names_now
+        assert "jit-recompile-hazard" in names_now, names_now
+    finally:
+        regmod._RULES.clear()
+        regmod._RULES.update(saved[0])
+        regmod._loaded = saved[1]
+        sys.modules.update(saved_mods)
+        if saved_attr is not None:
+            pkg.rules = saved_attr
+
+
+def test_blocking_rule_resolves_import_aliases(tmp_path):
+    """Regression: `from time import sleep` / `import time as tm` must
+    still be recognized as blocking calls."""
+    files = {f"{PKG}/runtime/engine.py": """
+        from time import sleep
+        import time as tm
+
+        class ScoringEngine:
+            def run(self):
+                sleep(1)
+                tm.sleep(2)
+    """}
+    res = lint_tree(tmp_path, files, targets=[PKG],
+                    rules=["blocking-call-on-loop-thread"])
+    assert len(res.findings) == 2, names(res)
+    assert all("time.sleep" in f.message for f in res.findings)
+
+
+def test_jit_rule_prunes_lambda_bodies_with_shadowing_params(tmp_path):
+    """Regression: a lambda whose param shadows a traced name must not
+    produce a false P0 against the outer taint environment."""
+    src = """
+        import jax
+
+        def step(x):
+            f = lambda x: float(x)     # fresh x: NOT the traced one
+            g = lambda v: int(v)       # unrelated param
+            return x * 2
+
+        step_j = jax.jit(step)
+    """
+    res = lint_tree(tmp_path, {"pkg/mod.py": src},
+                    rules=["jit-recompile-hazard"])
+    assert res.findings == [], names(res)
+
+
+def test_focused_update_baseline_is_refused():
+    """Regression: --update-baseline with --rule/paths would silently
+    drop every out-of-scope baseline entry — refused at the CLI."""
+    from rtfdslint.cli import main as lint_main
+    rc = lint_main(["--root", REPO, "--rule", "wall-clock-duration",
+                    "--update-baseline", "--reason", "probe",
+                    "--baseline", "/nonexistent-never-written.json"])
+    assert rc == 2
+    assert not os.path.exists("/nonexistent-never-written.json")
+
+
+def test_baseline_rejects_non_list_entries(tmp_path):
+    bl = tmp_path / "b.json"
+    bl.write_text(json.dumps({"format": 1, "entries": {"a": 1}}))
+    with pytest.raises(BaselineError, match="entries"):
+        Baseline.load(str(bl))
+    bl.write_text(json.dumps({"format": 1, "entries": ["just-a-string"]}))
+    with pytest.raises(BaselineError, match="not an object"):
+        Baseline.load(str(bl))
+
+
+def test_baseline_refuses_reasonless_entries(tmp_path):
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"format": 1, "entries": [
+        {"fingerprint": "abc", "rule": "x", "path": "y", "count": 1}]}))
+    with pytest.raises(BaselineError, match="no reason"):
+        Baseline.load(str(bl))
+
+
+def test_update_baseline_roundtrip(tmp_path):
+    files = {"pkg/runtime/mod.py": """
+        def f():
+            raise RuntimeError("boom")
+    """}
+    res = lint_tree(tmp_path, files, baseline=None)
+    assert res.gate_failures()
+    n = update_baseline(str(tmp_path), res, "bl.json",
+                        reason="accepted while PR N retypes it")
+    assert n == 1
+    res2 = lint_tree(tmp_path, files, baseline="bl.json")
+    assert not res2.gate_failures()
+    ent = json.loads((tmp_path / "bl.json").read_text())["entries"][0]
+    assert ent["reason"] == "accepted while PR N retypes it"
+    # reasons survive a re-update
+    update_baseline(str(tmp_path), res, "bl.json", reason="NEW default")
+    ent2 = json.loads((tmp_path / "bl.json").read_text())["entries"][0]
+    assert ent2["reason"] == "accepted while PR N retypes it"
+
+
+# --------------------------------------------------------------------------
+# reporters, CLI, self-check
+# --------------------------------------------------------------------------
+
+def test_json_report_schema(tmp_path):
+    res = lint_tree(tmp_path, {"pkg/mod.py": "X = 1\n"})
+    d = res.to_json()
+    assert d["version"] == 1
+    assert set(d["summary"]) == {"active", "gate_failures", "suppressed",
+                                "baselined"}
+    assert isinstance(d["rules"], dict)
+
+
+def test_parse_error_is_p0(tmp_path):
+    res = lint_tree(tmp_path, {"pkg/bad.py": "def f(:\n"})
+    assert [(f.rule, f.severity) for f in res.findings] == \
+        [("parse-error", "P0")]
+
+
+def test_cli_module_runs_and_gates(tmp_path):
+    (tmp_path / "pkg").mkdir()
+    (tmp_path / "pkg" / "m.py").write_text(
+        "def f():\n    raise RuntimeError('x')\n")
+    env = dict(os.environ,
+               PYTHONPATH=os.path.join(REPO, "tools"))
+    p = subprocess.run(
+        [sys.executable, "-m", "rtfdslint", "--root", str(tmp_path),
+         "--no-baseline", "--json", "pkg"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert p.returncode == 0, p.stderr[-500:]  # P2 outside runtime/io
+    d = json.loads(p.stdout)
+    assert d["summary"]["active"] == 1
+    p2 = subprocess.run(
+        [sys.executable, "-m", "rtfdslint", "--root", str(tmp_path),
+         "--no-baseline", "--strict", "pkg"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert p2.returncode == 1  # --strict gates the P2
+
+
+def test_update_baseline_preserves_still_matching_entries(tmp_path):
+    """Regression: regenerating the baseline must keep entries that
+    still match (they were absorbed out of the active set), or the very
+    next run resurfaces a previously-accepted finding and fails."""
+    files = {"pkg/runtime/mod.py": """
+        def f():
+            raise RuntimeError("boom")
+    """}
+    res = lint_tree(tmp_path, files, baseline=None)
+    update_baseline(str(tmp_path), res, "bl.json", reason="accepted v1")
+    # run WITH the baseline (finding absorbed), then regenerate
+    res2 = lint_tree(tmp_path, files, baseline="bl.json")
+    assert not res2.gate_failures() and len(res2.baselined) == 1
+    update_baseline(str(tmp_path), res2, "bl.json", reason="unused")
+    ents = json.loads((tmp_path / "bl.json").read_text())["entries"]
+    assert len(ents) == 1 and ents[0]["reason"] == "accepted v1"
+    res3 = lint_tree(tmp_path, files, baseline="bl.json")
+    assert not res3.gate_failures(), "regeneration dropped a live entry"
+
+
+def test_rule_filter_follows_produced_by(tmp_path):
+    """Regression: --rule lock-order-cycle must run the producing
+    analysis (cross-thread-race), not pass vacuously — and a focused
+    run must not leak the producer's other findings."""
+    res = lint_tree(tmp_path, {"pkg/mod.py": LOCK_CYCLE},
+                    rules=["lock-order-cycle"])
+    assert [f.rule for f in res.findings] == ["lock-order-cycle"]
+    res2 = lint_tree(tmp_path, {"pkg/mod.py": RACE_BAD},
+                     rules=["cross-thread-race"])
+    assert all(f.rule == "cross-thread-race" for f in res2.findings)
+    assert res2.findings
+
+
+def test_unknown_rule_name_is_an_error_not_a_clean_pass(tmp_path):
+    """Regression: a misspelled --rule must error (rc 2 path), never
+    report a vacuous clean gate; parse-errors survive focused runs."""
+    files = {"pkg/mod.py": "X = 1\n", "pkg/broken.py": "def f(:\n"}
+    with pytest.raises(ValueError, match="unknown rule"):
+        lint_tree(tmp_path, files, rules=["jit-recompile-hazrd"])
+    res = lint_tree(tmp_path, files, rules=["wall-clock-duration"])
+    assert [f.rule for f in res.findings] == ["parse-error"]
+
+
+def test_metric_rule_skips_partial_package_targets(tmp_path):
+    """Regression: linting a SUBDIR of the package must not flood
+    false unregistered-reference P1s (the two-way diff is a whole-
+    package contract)."""
+    files = {
+        f"{PKG}/runtime/m.py": """
+            def setup(reg):
+                reg.counter("rtfds_engine_total", "registered here")
+        """,
+        f"{PKG}/io/dashboard.py": 'TILE = "rtfds_engine_total"\n',
+    }
+    # full-package target: contract applies, reference resolves
+    res = lint_tree(tmp_path, files, targets=[PKG],
+                    rules=["metric-name-drift"])
+    assert res.findings == [], names(res)
+    # partial target (io/ only): the rule must skip, not report the
+    # engine metric as registered-nowhere
+    res2 = lint_tree(tmp_path, files, targets=[f"{PKG}/io"],
+                     rules=["metric-name-drift"])
+    assert res2.findings == [], names(res2)
+
+
+def test_nonexistent_target_is_an_error(tmp_path):
+    (tmp_path / "pkg").mkdir()
+    (tmp_path / "pkg" / "m.py").write_text("X = 1\n")
+    with pytest.raises(FileNotFoundError, match="matched no"):
+        run_lint(str(tmp_path), targets=["no_such_dir"],
+                 baseline_path=None)
+
+
+def test_tests_only_registration_does_not_cover_dashboard(tmp_path):
+    """Regression: a metric registered only in a tests/ fixture must not
+    satisfy a dashboard/README reference — the production tile would
+    still read forever-zero."""
+    files = {
+        f"{PKG}/core/m.py": """
+            def setup(reg):
+                reg.counter("rtfds_real_total", "registered in package")
+        """,
+        f"{PKG}/io/dashboard.py": 'TILE = "rtfds_fixture_only_total"\n',
+    }
+    tests = {"test_m.py": """
+        def test_x(reg):
+            reg.counter("rtfds_fixture_only_total", "scratch")
+    """}
+    res = lint_tree(tmp_path, files, targets=[PKG], tests=tests,
+                    readme="`rtfds_real_total`\n",
+                    rules=["metric-name-drift", "undocumented-metric"])
+    drift = [f for f in res.findings if f.rule == "metric-name-drift"]
+    assert [f.context for f in drift] == ["rtfds_fixture_only_total"]
+    assert drift[0].path.endswith("io/dashboard.py")
+
+
+def test_analyzer_self_check_clean():
+    """The analyzer runs clean on its own source (no baseline)."""
+    res = run_lint(REPO, targets=["tools/rtfdslint"], baseline_path=None)
+    bad = [f for f in res.findings if f.severity in ("P0", "P1")]
+    assert bad == [], [f.render() for f in bad]

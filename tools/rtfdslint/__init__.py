@@ -1,0 +1,36 @@
+"""rtfdslint — project-native static analysis for the rtfds serving loop.
+
+The test suite can only spot-check the invariants PRs 1-7 paid for
+(zero mid-stream recompiles, typed crash classification, registry-
+grounded metric claims, single-writer thread discipline) at runtime;
+this package enforces them at review time, before the code ever runs.
+
+Pure stdlib (``ast``), no new dependencies. Entry points:
+
+* ``rtfds lint`` (CLI subcommand) / ``make lint-static``
+* ``python -m rtfdslint`` with ``tools/`` on ``sys.path``
+* :func:`run_lint` for in-process use (the tier-1 gate test).
+
+Known approximations (deliberate — the runtime detectors stay the
+backstop; see each rule module's docstring for its own list):
+
+* name resolution is lexical + one-level imports: dynamically chosen
+  step functions, ``getattr`` dispatch and containers of callables are
+  invisible to the jit/blocking reachability walks;
+* taint does not flow through containers or object attributes
+  (``state[0]``/``box.value`` holding a tracer), and hazards inside
+  lambdas defined in jit code are skipped entirely (their params
+  shadow; the pruning trades false positives for misses);
+* the race detector reasons per class over ``self`` attributes only:
+  module-global state, closures handed to ``Thread(target=…)`` and
+  cross-object aliasing are out of scope, and check-then-act races on
+  atomically-swapped references cannot be seen statically;
+* lock-order analysis is lexical plus ONE level of intra-class calls —
+  deeper call-chain acquisitions don't edge into the graph.
+"""
+
+from .finding import Finding, SEVERITIES  # noqa: F401
+from .registry import all_rules, get_rule, register  # noqa: F401
+from .runner import LintResult, run_lint  # noqa: F401
+
+__version__ = "1.0.0"

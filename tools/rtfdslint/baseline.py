@@ -1,0 +1,131 @@
+"""Checked-in baseline of accepted pre-existing findings.
+
+The baseline is the second suppression channel (the first is inline
+pragmas). Pragmas are preferred — they live next to the code and
+self-document — but some findings have no single good line to annotate
+(e.g. a cross-file metric-drift verdict) or belong to code that is
+deliberately left as-is; those go here, each with a REQUIRED reason.
+
+Entries match by fingerprint (rule + path + context + message — no
+line numbers, so edits elsewhere in the file don't invalidate them)
+with an occurrence ``count`` so N identical findings need one entry.
+A reason-less entry is a configuration error: the runner refuses it
+loudly rather than silently suppressing (acceptance rule: "every
+baseline entry carries a reason").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from .finding import Finding
+
+FORMAT_VERSION = 1
+
+
+class BaselineError(ValueError):
+    """Malformed baseline file — refuse to lint rather than mis-suppress."""
+
+
+@dataclass
+class Baseline:
+    path: str = ""
+    entries: Dict[str, dict] = field(default_factory=dict)  # fp -> entry
+    #: fingerprints consumed during this run (for stale-entry reporting)
+    _used: Dict[str, int] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not path or not os.path.exists(path):
+            return cls(path=path)
+        with open(path, encoding="utf-8") as f:
+            try:
+                data = json.load(f)
+            except ValueError as e:
+                raise BaselineError(f"{path}: not valid JSON ({e})") from e
+        if not isinstance(data, dict) \
+                or not isinstance(data.get("entries"), list):
+            raise BaselineError(
+                f"{path}: expected an object with an 'entries' list")
+        entries: Dict[str, dict] = {}
+        for ent in data["entries"]:
+            if not isinstance(ent, dict):
+                raise BaselineError(
+                    f"{path}: entry {ent!r} is not an object")
+            fp = ent.get("fingerprint", "")
+            reason = str(ent.get("reason", "")).strip()
+            if not fp:
+                raise BaselineError(f"{path}: entry without fingerprint")
+            if not reason:
+                raise BaselineError(
+                    f"{path}: entry {fp} ({ent.get('rule', '?')} at "
+                    f"{ent.get('path', '?')}) has no reason — every "
+                    "baseline entry must say why it is accepted")
+            ent.setdefault("count", 1)
+            entries[fp] = ent
+        return cls(path=path, entries=entries)
+
+    def absorb(self, finding: Finding) -> bool:
+        """True (and consume one occurrence) if the finding is baselined."""
+        ent = self.entries.get(finding.fingerprint)
+        if ent is None:
+            return False
+        used = self._used.get(finding.fingerprint, 0)
+        if used >= int(ent.get("count", 1)):
+            return False
+        self._used[finding.fingerprint] = used + 1
+        return True
+
+    def stale_entries(self) -> List[dict]:
+        """Entries that matched nothing (candidates for deletion)."""
+        out = []
+        for fp, ent in self.entries.items():
+            if self._used.get(fp, 0) == 0:
+                out.append(ent)
+        return out
+
+    @staticmethod
+    def write(path: str, findings: List[Finding],
+              prior: "Baseline", default_reason: str) -> int:
+        """``--update-baseline``: write the current P0/P1 finding set.
+
+        Reasons survive from the prior baseline where the fingerprint
+        persists; new entries take ``default_reason`` (the CLI's
+        ``--reason``, which update mode requires — a baseline entry can
+        never be born reason-less).
+        """
+        by_fp: Dict[str, dict] = {}
+        for f in findings:
+            ent = by_fp.get(f.fingerprint)
+            if ent is not None:
+                ent["count"] += 1
+                continue
+            old = prior.entries.get(f.fingerprint)
+            by_fp[f.fingerprint] = {
+                "fingerprint": f.fingerprint,
+                "rule": f.rule,
+                "severity": f.severity,
+                "path": f.path,
+                "context": f.context,
+                "message": f.message,
+                "count": 1,
+                "reason": (old or {}).get("reason") or default_reason,
+            }
+        data = {
+            "format": FORMAT_VERSION,
+            "comment": ("accepted pre-existing rtfdslint findings; every "
+                        "entry needs a reason. Regenerate with "
+                        "`rtfds lint --update-baseline --reason '...'`."),
+            "entries": sorted(by_fp.values(),
+                              key=lambda e: (e["path"], e["rule"],
+                                             e["message"])),
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=2, sort_keys=False)
+            f.write("\n")
+        os.replace(tmp, path)
+        return len(by_fp)

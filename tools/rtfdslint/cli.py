@@ -1,0 +1,109 @@
+"""Argparse front-end: ``python -m rtfdslint`` and ``rtfds lint``."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from .baseline import BaselineError
+from .registry import all_rules
+from .report import render_human, render_json
+from .runner import DEFAULT_BASELINE, run_lint, update_baseline
+
+
+def _find_root(start: str) -> str:
+    """Walk up to the repo root (the dir holding the serving package)."""
+    cur = os.path.abspath(start)
+    from .project import PACKAGE_NAME
+    while True:
+        if os.path.isdir(os.path.join(cur, PACKAGE_NAME)):
+            return cur
+        nxt = os.path.dirname(cur)
+        if nxt == cur:
+            return os.path.abspath(start)
+        cur = nxt
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="rtfds lint",
+        description=("project-native static analyzer: recompile hazards, "
+                     "cross-thread races, exception taxonomy, wall-clock "
+                     "durations, metric drift, loop-thread blocking"))
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the serving package)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: discovered from cwd)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help=f"baseline file (default {DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (show everything)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="absorb current P0/P1 findings into the baseline")
+    ap.add_argument("--reason", default="",
+                    help="reason recorded on NEW baseline entries "
+                         "(required with --update-baseline)")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--strict", action="store_true",
+                    help="P2 findings also fail the gate")
+    ap.add_argument("--verbose", action="store_true",
+                    help="also list pragma-suppressed/baselined findings")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for cls in all_rules():
+            print(f"{cls.name:32s} {cls.doc}")
+        return 0
+    root = args.root or _find_root(os.getcwd())
+    baseline = None if args.no_baseline else args.baseline
+    try:
+        result = run_lint(root, targets=args.paths or None,
+                          baseline_path=baseline, rules=args.rule,
+                          # explicit paths also narrow the finding set:
+                          # never advise deleting out-of-scope entries
+                          report_stale=not (args.rule or args.paths))
+    except (BaselineError, FileNotFoundError, ValueError) as e:
+        print(f"rtfdslint: {e}", file=sys.stderr)
+        return 2
+    if args.update_baseline:
+        if args.no_baseline:
+            # the prior baseline would not load, so its still-matching
+            # entries (any severity) could not be carried forward —
+            # the rewrite would silently drop them
+            print("rtfdslint: --update-baseline cannot be combined "
+                  "with --no-baseline (prior entries must be loaded "
+                  "to be preserved)", file=sys.stderr)
+            return 2
+        if args.rule or args.paths:
+            # a focused run matches only its own scope's findings —
+            # regenerating from it would silently delete every
+            # out-of-scope entry. Baseline updates are whole-gate only.
+            print("rtfdslint: --update-baseline must run over the full "
+                  "default gate (no --rule, no path arguments) — a "
+                  "focused run would drop every baseline entry outside "
+                  "its scope", file=sys.stderr)
+            return 2
+        if not args.reason.strip():
+            print("rtfdslint: --update-baseline requires --reason "
+                  "'why these findings are accepted' (a baseline entry "
+                  "can never be born reason-less)", file=sys.stderr)
+            return 2
+        n = update_baseline(root, result, args.baseline, args.reason.strip())
+        print(f"rtfdslint: baseline now holds {n} entr"
+              f"{'y' if n == 1 else 'ies'} at {args.baseline}")
+        return 0
+    print(render_json(result, strict=args.strict) if args.json
+          else render_human(result, verbose=args.verbose,
+                            strict=args.strict))
+    failures = result.gate_failures(strict=args.strict)
+    return 1 if failures else 0

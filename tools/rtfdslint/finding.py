@@ -1,0 +1,82 @@
+"""Finding: one rule violation at one source location.
+
+Fingerprints deliberately exclude line numbers so a baseline entry
+survives unrelated edits above the finding; they include the enclosing
+definition's qualname so two identical messages in different functions
+stay distinct.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+#: Severity policy (README "Static analysis" section is the prose copy):
+#: P0 — would break a paid-for runtime invariant (e.g. a concretization
+#:      inside a jit-reachable function: trace-time crash or silent
+#:      per-batch recompile). Gates; severity signals urgency, not
+#:      unwaivability — the analysis is approximate, so a reasoned
+#:      pragma/baseline entry remains the escape hatch (and is itself
+#:      auditable: suppressions are listed under --verbose).
+#: P1 — likely bug or taxonomy erosion (unguarded cross-thread
+#:      read-modify-write, swallowed crash signal, wall-clock duration).
+#:      Gates unless baselined/pragma'd with a reason.
+#: P2 — advisory / documentation drift. Reported, never gates unless
+#:      ``--strict``.
+SEVERITIES = ("P0", "P1", "P2")
+
+
+@dataclass
+class Finding:
+    rule: str
+    severity: str  # one of SEVERITIES
+    path: str      # repo-relative posix path
+    line: int
+    message: str
+    context: str = ""   # enclosing qualname ("module:Class.method")
+    col: int = 0
+    suppressed: str = ""  # "", "pragma", or "baseline"
+
+    def __post_init__(self) -> None:
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"bad severity {self.severity!r}")
+
+    @property
+    def fingerprint(self) -> str:
+        blob = "|".join((self.rule, self.path, self.context, self.message))
+        return hashlib.sha1(blob.encode()).hexdigest()[:16]
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "context": self.context,
+            "message": self.message,
+            "fingerprint": self.fingerprint,
+            "suppressed": self.suppressed,
+        }
+
+    def render(self) -> str:
+        where = f"{self.path}:{self.line}"
+        ctx = f" [{self.context}]" if self.context else ""
+        return f"{self.severity} {self.rule} {where}{ctx}: {self.message}"
+
+
+@dataclass
+class RuleStats:
+    """Per-rule counts for the summary block."""
+
+    active: int = 0
+    suppressed: int = 0
+    baselined: int = 0
+
+    def to_json(self) -> dict:
+        return {"active": self.active, "suppressed": self.suppressed,
+                "baselined": self.baselined}
+
+
+def severity_rank(sev: str) -> int:
+    return SEVERITIES.index(sev)

@@ -1,0 +1,119 @@
+"""Inline suppression pragmas.
+
+Grammar (one per line)::
+
+    # rtfdslint: disable=rule-a,rule-b (why this is deliberate)
+    # rtfdslint: disable-file=rule-a (why the whole file opts out)
+
+A trailing pragma (after code) suppresses findings on its OWN line; a
+pragma on a comment-only line suppresses findings on the NEXT line —
+the usual spelling above a flagged ``except``/``with``/call statement,
+where the reason won't fit in the margin.
+
+The parenthesised reason is REQUIRED: a pragma without one does not
+suppress anything and instead surfaces as a ``pragma-missing-reason``
+P1 finding — the workflow the acceptance gate enforces ("every pragma
+carries a reason"). ``disable=all`` is deliberately not supported;
+suppressions are per-rule so a pragma can never hide a future rule's
+finding for free.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set  # noqa: F401 (Dict in hints)
+
+from .finding import Finding
+
+_PRAGMA_RE = re.compile(
+    r"#\s*rtfdslint:\s*(?P<kind>disable(?:-file)?)\s*=\s*"
+    r"(?P<rules>[a-z0-9_,\- ]+?)\s*"
+    r"(?:\((?P<reason>.*)\))?\s*$"  # greedy: reasons may nest parens
+)
+_PRAGMA_HINT_RE = re.compile(r"#\s*rtfdslint\s*:")
+
+
+@dataclass
+class Pragma:
+    line: int
+    kind: str            # "disable" | "disable-file"
+    rules: List[str]
+    reason: str
+
+
+@dataclass
+class FilePragmas:
+    """All pragmas of one file + the line→rules suppression index."""
+
+    pragmas: List[Pragma] = field(default_factory=list)
+    by_line: Dict[int, Set[str]] = field(default_factory=dict)
+    file_wide: Set[str] = field(default_factory=set)
+
+    def suppresses(self, rule: str, line: int) -> bool:
+        if rule in self.file_wide:
+            return True
+        return rule in self.by_line.get(line, set())
+
+
+def parse_pragmas(relpath: str, text: str, known_rules: Set[str],
+                  stmt_cover: "Dict[int, int] | None" = None,
+                  ) -> "tuple[FilePragmas, list]":
+    """Scan a file's raw text for pragmas.
+
+    Returns the suppression index plus meta-findings (missing reason,
+    unknown rule name). A reason-less pragma is parsed but NOT entered
+    into the suppression index.
+
+    ``stmt_cover`` (start line → last line of the innermost statement
+    starting there, from the file's AST) expands each pragma to cover
+    its annotated statement's FULL physical span, so a wrapped
+    statement whose flagged expression lands on a later line is still
+    suppressed.
+    """
+    fp = FilePragmas()
+    meta: List[Finding] = []
+    for i, raw in enumerate(text.splitlines(), start=1):
+        if "rtfdslint" not in raw:
+            continue
+        m = _PRAGMA_RE.search(raw)
+        if not m:
+            if _PRAGMA_HINT_RE.search(raw):
+                meta.append(Finding(
+                    rule="pragma-malformed", severity="P1",
+                    path=relpath, line=i,
+                    message=("line looks like an rtfdslint pragma but "
+                             "does not parse — it suppresses NOTHING; "
+                             "expected comment form rtfdslint"
+                             ": disable=<rules> (<reason>)")))
+            continue
+        rules = [r.strip() for r in m.group("rules").split(",") if r.strip()]
+        reason = (m.group("reason") or "").strip()
+        fp.pragmas.append(Pragma(i, m.group("kind"), rules, reason))
+        if not reason:
+            meta.append(Finding(
+                rule="pragma-missing-reason", severity="P1",
+                path=relpath, line=i,
+                message=("rtfdslint pragma without a (reason); the "
+                         "suppression is ignored until one is given"),
+                context=",".join(rules)))
+            continue
+        unknown = [r for r in rules if known_rules and r not in known_rules]
+        for r in unknown:
+            meta.append(Finding(
+                rule="pragma-unknown-rule", severity="P2",
+                path=relpath, line=i,
+                message=f"pragma names unknown rule {r!r}", context=r))
+        live = [r for r in rules if r not in unknown]
+        if m.group("kind") == "disable-file":
+            fp.file_wide.update(live)
+            continue
+        # comment-only line: the pragma governs the NEXT line's
+        # statement; trailing form governs its own line's statement
+        anchor = i + 1 if raw.lstrip().startswith("#") else i
+        last = anchor
+        if stmt_cover:
+            last = stmt_cover.get(anchor, anchor)
+        for line in range(anchor, last + 1):
+            fp.by_line.setdefault(line, set()).update(live)
+    return fp, meta

@@ -1,0 +1,341 @@
+"""Shared project model: parsed files, symbol index, call resolution.
+
+Built once per lint run and handed to every rule. The index is
+deliberately best-effort — pure-``ast`` name resolution cannot follow
+dynamic dispatch — but it is *conservative in the right direction* for
+each rule that uses it (rules document their own approximations).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import posixpath
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .finding import Finding
+
+PACKAGE_NAME = "real_time_fraud_detection_system_tpu"
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    node: ast.ClassDef
+    file: "PyFile"
+    methods: Dict[str, "FuncDef"] = field(default_factory=dict)
+
+
+@dataclass
+class FuncDef:
+    node: ast.AST            # FunctionDef | AsyncFunctionDef | Lambda
+    file: "PyFile"
+    qualname: str            # "Class.method" / "outer.inner" / "fn"
+    class_info: Optional[ClassInfo] = None
+    parent: Optional["FuncDef"] = None
+    children: Dict[str, "FuncDef"] = field(default_factory=dict)
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.file.relpath, self.qualname)
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+    def param_names(self) -> List[str]:
+        a = self.node.args
+        names = [p.arg for p in
+                 list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return names
+
+
+@dataclass
+class PyFile:
+    relpath: str             # repo-relative posix path
+    path: str
+    text: str
+    tree: Optional[ast.Module]
+    error: str = ""
+    # symbol index (filled by _index_file)
+    functions: List[FuncDef] = field(default_factory=list)
+    top_functions: Dict[str, FuncDef] = field(default_factory=dict)
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    imports: Dict[str, str] = field(default_factory=dict)  # local -> dotted
+
+    @property
+    def module(self) -> str:
+        mod = self.relpath[:-3].replace("/", ".")
+        if mod.endswith(".__init__"):
+            mod = mod[: -len(".__init__")]
+        return mod
+
+
+class Project:
+    """All parsed sources + the cross-file symbol index."""
+
+    def __init__(self, root: str, targets: List[str],
+                 readme: str = "README.md",
+                 tests_dir: str = "tests") -> None:
+        self.root = os.path.abspath(root)
+        self.files: Dict[str, PyFile] = {}
+        self.target_paths: List[str] = []
+        self.target_specs: List[str] = [self._norm_spec(t)
+                                        for t in targets]
+        self.parse_findings: List[Finding] = []
+        self._modules: Dict[str, PyFile] = {}
+
+        for t in targets:
+            matched = False
+            for rel in self._expand(t):
+                matched = True
+                self.target_paths.append(rel)
+                self._load(rel)
+            if not matched:
+                # a typo'd target must be a hard error, never a
+                # permanently-green lint over nothing
+                raise FileNotFoundError(
+                    f"lint target {t!r} matched no .py files under "
+                    f"{self.root}")
+        # aux sources: tests participate in the metric two-way diff and
+        # may carry pragmas, but rules do not target them by default
+        self.tests_rel: List[str] = []
+        tdir = os.path.join(self.root, tests_dir)
+        if os.path.isdir(tdir):
+            for rel in sorted(self._expand(tests_dir)):
+                self.tests_rel.append(rel)
+                self._load(rel)
+        self.readme_rel = readme
+        rp = os.path.join(self.root, readme)
+        self.readme_text = ""
+        if os.path.exists(rp):
+            with open(rp, encoding="utf-8") as f:
+                self.readme_text = f.read()
+        for pf in self.files.values():
+            self._index_file(pf)
+
+    # -- loading -----------------------------------------------------------
+
+    def _norm_spec(self, target: str) -> str:
+        """Root-relative normalized spelling of a target spec, so
+        ``./pkg``, ``pkg/`` and an absolute path all compare equal
+        (rules that key on "is the whole package targeted" depend on
+        this)."""
+        spec = target.replace(os.sep, "/")
+        if os.path.isabs(target):
+            spec = os.path.relpath(target, self.root).replace(os.sep, "/")
+        return posixpath.normpath(spec).strip("/")
+
+    def _expand(self, target: str) -> Iterable[str]:
+        abspath = os.path.join(self.root, target)
+        if os.path.isfile(abspath):
+            yield target.replace(os.sep, "/")
+            return
+        for dirpath, dirnames, filenames in os.walk(abspath):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in ("__pycache__", ".git"))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    rel = os.path.relpath(os.path.join(dirpath, fn),
+                                          self.root)
+                    yield rel.replace(os.sep, "/")
+
+    def _load(self, rel: str) -> None:
+        if rel in self.files:
+            return
+        path = os.path.join(self.root, rel)
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        except OSError as e:
+            self.parse_findings.append(Finding(
+                rule="parse-error", severity="P0", path=rel, line=1,
+                message=f"unreadable: {e}"))
+            return
+        try:
+            tree = ast.parse(text, filename=rel)
+            pf = PyFile(rel, path, text, tree)
+        except SyntaxError as e:
+            pf = PyFile(rel, path, text, None, error=str(e))
+            self.parse_findings.append(Finding(
+                rule="parse-error", severity="P0", path=rel,
+                line=int(e.lineno or 1),
+                message=f"syntax error: {e.msg}"))
+        self.files[rel] = pf
+        self._modules[pf.module] = pf
+
+    # -- indexing ----------------------------------------------------------
+
+    def _index_file(self, pf: PyFile) -> None:
+        if pf.tree is None:
+            return
+
+        def visit(node: ast.AST, parent_fn: Optional[FuncDef],
+                  cls: Optional[ClassInfo], prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.Import, ast.ImportFrom)):
+                    self._index_import(pf, child)
+                if isinstance(child, ast.ClassDef):
+                    ci = ClassInfo(child.name, child, pf)
+                    pf.classes[child.name] = ci
+                    visit(child, None, ci, child.name + ".")
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    fd = FuncDef(child, pf, prefix + child.name,
+                                 class_info=cls, parent=parent_fn)
+                    pf.functions.append(fd)
+                    if cls is not None and parent_fn is None:
+                        cls.methods[child.name] = fd
+                    elif parent_fn is None:
+                        pf.top_functions[child.name] = fd
+                    else:
+                        parent_fn.children[child.name] = fd
+                    visit(child, fd, cls, fd.qualname + ".")
+                else:
+                    visit(child, parent_fn, cls, prefix)
+
+        visit(pf.tree, None, None, "")
+
+    def _index_import(self, pf: PyFile, node: ast.AST) -> None:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                pf.imports[alias.asname or alias.name.split(".")[0]] = \
+                    alias.name
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            if node.level:  # relative: resolve against this module
+                parts = pf.module.split(".")
+                parts = parts[: len(parts) - node.level]
+                base = ".".join(parts + ([node.module]
+                                         if node.module else []))
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                pf.imports[alias.asname or alias.name] = \
+                    f"{base}.{alias.name}" if base else alias.name
+
+    # -- queries -----------------------------------------------------------
+
+    def target_files(self) -> List[PyFile]:
+        return [self.files[r] for r in self.target_paths
+                if r in self.files]
+
+    def test_files(self) -> List[PyFile]:
+        return [self.files[r] for r in self.tests_rel if r in self.files]
+
+    def module_file(self, dotted: str) -> Optional[PyFile]:
+        return self._modules.get(dotted)
+
+    def qualname_at(self, pf: PyFile, line: int) -> str:
+        """Innermost definition enclosing ``line`` (finding context)."""
+        best = ""
+        best_span = None
+        for fd in pf.functions:
+            n = fd.node
+            end = getattr(n, "end_lineno", n.lineno)
+            if n.lineno <= line <= end:
+                span = end - n.lineno
+                if best_span is None or span <= best_span:
+                    best, best_span = fd.qualname, span
+        return best
+
+    # -- call resolution ---------------------------------------------------
+
+    def resolve_call(self, pf: PyFile, scope: Optional[FuncDef],
+                     call: ast.Call) -> Optional[FuncDef]:
+        """Best-effort static resolution of a call to a FuncDef.
+
+        Handles: lexical nested functions, module top-level functions,
+        ``self.method(...)`` within a class, imported package symbols
+        (``from ..ops.windows import f`` / ``from . import mod``) and
+        one-level module attribute calls (``windows.f(...)``). Returns
+        None for anything dynamic.
+        """
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            cur = scope
+            while cur is not None:
+                if fn.id in cur.children:
+                    return cur.children[fn.id]
+                cur = cur.parent
+            if scope is not None and scope.class_info is not None \
+                    and fn.id in scope.class_info.methods:
+                return scope.class_info.methods[fn.id]
+            if fn.id in pf.top_functions:
+                return pf.top_functions[fn.id]
+            dotted = pf.imports.get(fn.id)
+            if dotted and "." in dotted:
+                mod, _, sym = dotted.rpartition(".")
+                mf = self.module_file(mod)
+                if mf is not None:
+                    return mf.top_functions.get(sym)
+            return None
+        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+            base, attr = fn.value.id, fn.attr
+            if base in ("self", "cls") and scope is not None \
+                    and scope.class_info is not None:
+                return scope.class_info.methods.get(attr)
+            dotted = pf.imports.get(base)
+            if dotted:
+                mf = self.module_file(dotted)
+                if mf is not None:
+                    return mf.top_functions.get(attr)
+        return None
+
+    def reachable(self, roots: Iterable[FuncDef]) -> Set[Tuple[str, str]]:
+        """BFS closure of statically-resolvable calls from ``roots``."""
+        seen: Dict[Tuple[str, str], FuncDef] = {}
+        work = list(roots)
+        while work:
+            fd = work.pop()
+            if fd.key in seen:
+                continue
+            seen[fd.key] = fd
+            for call in walk_calls(fd.node):
+                tgt = self.resolve_call(fd.file, fd, call)
+                if tgt is not None and tgt.key not in seen:
+                    work.append(tgt)
+        self._reach_cache = seen
+        return set(seen)
+
+    def reachable_funcs(self, roots: Iterable[FuncDef]) -> List[FuncDef]:
+        keys = self.reachable(roots)
+        return [self._reach_cache[k] for k in sorted(keys)]
+
+
+def walk_calls(fn_node: ast.AST) -> Iterable[ast.Call]:
+    """Calls lexically inside a def, not descending into nested defs."""
+    for node in iter_own_nodes(fn_node):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def iter_own_nodes(fn_node: ast.AST) -> Iterable[ast.AST]:
+    """All nodes of a def excluding nested function/class bodies."""
+    body = fn_node.body if isinstance(fn_node.body, list) else [fn_node.body]
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'jnp.zeros' for Attribute chains rooted at a Name, else ''."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""

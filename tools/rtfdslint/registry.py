@@ -1,0 +1,71 @@
+"""Rule plugin registry.
+
+A rule is a class with ``name``, ``doc`` (one-line catalog entry) and a
+``run(project) -> Iterable[Finding]``; registration is the decorator::
+
+    @register
+    class MyRule:
+        name = "my-rule"
+        doc = "what invariant this protects"
+        def run(self, project): ...
+
+Rules are discovered by importing :mod:`rtfdslint.rules` (its
+``__init__`` imports every rule module); anything registered after that
+— e.g. a repo-local plugin imported by a wrapper script — participates
+identically. Names must be unique and kebab-case (they are the pragma
+and baseline vocabulary).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Type
+
+_RULES: Dict[str, type] = {}
+_loaded = False
+_NAME_RE = re.compile(r"^[a-z][a-z0-9]*(-[a-z0-9]+)*$")
+
+#: meta-rule names emitted by the framework itself (pragma hygiene);
+#: they have no plugin class but are valid pragma/baseline targets.
+META_RULES = ("pragma-missing-reason", "pragma-unknown-rule",
+              "pragma-malformed", "parse-error")
+
+
+def register(cls: Type) -> Type:
+    name = getattr(cls, "name", "")
+    if not _NAME_RE.match(name or ""):
+        raise ValueError(f"rule name {name!r} must be kebab-case")
+    if name in _RULES or name in META_RULES:
+        raise ValueError(f"duplicate rule name {name!r}")
+    if not getattr(cls, "doc", ""):
+        raise ValueError(f"rule {name!r} needs a one-line doc")
+    _RULES[name] = cls
+    return cls
+
+
+def all_rules() -> List[type]:
+    _ensure_loaded()
+    return [_RULES[k] for k in sorted(_RULES)]
+
+
+def get_rule(name: str) -> type:
+    _ensure_loaded()
+    return _RULES[name]
+
+
+def known_rule_names() -> set:
+    _ensure_loaded()
+    return set(_RULES) | set(META_RULES)
+
+
+def _ensure_loaded() -> None:
+    # a dedicated flag, NOT `if not _RULES`: a repo-local plugin may
+    # register itself before the first all_rules() call, and the
+    # built-ins must still load alongside it
+    global _loaded
+    if not _loaded:
+        from . import rules  # noqa: F401  (side effect: registration)
+
+        # only after the import SUCCEEDS: a failed first load must be
+        # retried, never remembered as "loaded" with a partial rule set
+        _loaded = True

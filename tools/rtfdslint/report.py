@@ -1,0 +1,56 @@
+"""Human and JSON reporters for a LintResult."""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+from .finding import severity_rank
+from .runner import LintResult
+
+
+def render_json(result: LintResult, strict: bool = False) -> str:
+    return json.dumps(result.to_json(strict=strict), indent=2,
+                      sort_keys=False)
+
+
+def render_human(result: LintResult, verbose: bool = False,
+                 strict: bool = False) -> str:
+    out: List[str] = []
+    findings = sorted(result.findings,
+                      key=lambda f: (severity_rank(f.severity), f.path,
+                                     f.line))
+    for f in findings:
+        out.append(f.render())
+    if verbose and result.suppressed:
+        out.append("")
+        out.append(f"-- suppressed by pragma ({len(result.suppressed)}):")
+        out.extend("   " + f.render() for f in result.suppressed)
+    if verbose and result.baselined:
+        out.append("")
+        out.append(f"-- baselined ({len(result.baselined)}):")
+        out.extend("   " + f.render() for f in result.baselined)
+    if result.stale_baseline:
+        out.append("")
+        out.append("-- stale baseline entries (matched nothing; delete "
+                   "or re-run --update-baseline):")
+        for ent in result.stale_baseline:
+            out.append(f"   {ent.get('rule')} {ent.get('path')}: "
+                       f"{ent.get('message', '')[:80]}")
+    counts = {"P0": 0, "P1": 0, "P2": 0}
+    for f in result.findings:
+        counts[f.severity] += 1
+    # the gate line MUST agree with the process exit code, so it is
+    # computed under the same strictness
+    gate = result.gate_failures(strict=strict)
+    bar = "P0/P1/P2" if strict else "P0/P1"
+    out.append("")
+    out.append(
+        f"rtfdslint: {result.files_scanned} files, "
+        f"{len(result.findings)} active finding(s) "
+        f"[P0={counts['P0']} P1={counts['P1']} P2={counts['P2']}], "
+        f"{len(result.suppressed)} pragma-suppressed, "
+        f"{len(result.baselined)} baselined")
+    out.append("gate: " + (f"FAIL — unbaselined {bar} present"
+                           if gate else f"clean (no unbaselined {bar})"))
+    return "\n".join(out)

@@ -1,0 +1,91 @@
+"""blocking-call-on-loop-thread: keep the serving loop non-blocking.
+
+PRs 3/5 moved every stall off the loop thread (async sink, prefetch,
+overlapped fetch) and pinned the wins in perf-smoke; a stray
+``time.sleep`` or subprocess call in engine-step-reachable code undoes
+them invisibly until a p99 regression lands. Entry points are the
+``run``/``process_batch``/``step`` methods of the ``*Engine`` classes
+in ``runtime/``; reachability follows the statically-resolvable call
+graph (same approximation as the jit rule). Sanctioned wait points —
+the autobatch trigger pacing credited as wait time — carry pragmas.
+
+Flagged (P1): ``time.sleep``, ``subprocess.*``, ``os.system``,
+``urllib.request.urlopen``, ``socket.create_connection``,
+``input`` in that reachable set.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..finding import Finding
+from ..project import FuncDef, Project, dotted_name, iter_own_nodes
+from ..registry import register
+
+ENTRY_METHODS = {"run", "process_batch", "step"}
+BLOCKING_DOTTED = {
+    "time.sleep", "os.system", "subprocess.run", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output",
+    "subprocess.Popen", "urllib.request.urlopen",
+    "socket.create_connection",
+}
+BLOCKING_BARE = {"input"}
+
+
+@register
+class BlockingCallOnLoopThreadRule:
+    name = "blocking-call-on-loop-thread"
+    doc = ("time.sleep / sync I/O reachable from the engine step path "
+           "(stalls the serving loop) outside sanctioned wait points")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        roots: List[FuncDef] = []
+        for rel in ("real_time_fraud_detection_system_tpu/runtime/"
+                    "engine.py",
+                    "real_time_fraud_detection_system_tpu/runtime/"
+                    "sharded_engine.py"):
+            pf = project.files.get(rel)
+            if pf is None or pf.tree is None:
+                continue
+            for ci in pf.classes.values():
+                if not ci.name.endswith("Engine"):
+                    continue
+                for m in ENTRY_METHODS:
+                    fd = ci.methods.get(m)
+                    if fd is not None:
+                        roots.append(fd)
+        if not roots:
+            return []
+        out: List[Finding] = []
+        for fd in project.reachable_funcs(roots):
+            pf = fd.file
+            for n in iter_own_nodes(fd.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                dn = _resolve_through_imports(pf, dotted_name(n.func))
+                bare = n.func.id if isinstance(n.func, ast.Name) else ""
+                if dn in BLOCKING_DOTTED or bare in BLOCKING_BARE:
+                    out.append(Finding(
+                        rule=self.name, severity="P1", path=pf.relpath,
+                        line=n.lineno,
+                        message=(f"{dn or bare}() is reachable from the "
+                                 "engine step path and blocks the "
+                                 "serving loop thread — move it off-"
+                                 "loop, or pragma the sanctioned wait "
+                                 "point with its reason"),
+                        context=f"{pf.module}:{fd.qualname}"))
+        return out
+
+
+def _resolve_through_imports(pf, dn: str) -> str:
+    """'sleep' / 'tm.sleep' → 'time.sleep' via the file's import table
+    (`from time import sleep`, `import time as tm`, plain `import
+    time` all normalize to the canonical dotted path)."""
+    if not dn:
+        return ""
+    head, _, rest = dn.partition(".")
+    target = pf.imports.get(head)
+    if target:
+        return target + ("." + rest if rest else "")
+    return dn

@@ -1,0 +1,184 @@
+"""Exception-taxonomy rules: keep the supervisor's crash classes legible.
+
+``run_with_recovery`` recovers on a *typed* tuple (``TransientError``,
+``OSError``, …) and its crash-loop breaker keys on the exception type
+at a progress point (PR 4); the checkpoint/registry planes re-raise
+original types after retry exhaustion (PR 6/7). Two code shapes erode
+that taxonomy:
+
+* ``raise RuntimeError(...)`` / ``raise Exception(...)`` in `runtime/`
+  or `io/` — the supervisor cannot tell it from a jax-internal error
+  (``TransientError`` deliberately subclasses ``RuntimeError``; a raw
+  ``RuntimeError`` is an unclassified crash). P1 there, P2 elsewhere.
+* broad catches. ``except Exception: pass`` (P1 anywhere) erases the
+  crash signal entirely — the breaker never sees the type, the flight
+  recorder never sees the event. A broad catch that does real handling
+  is P1 in `runtime/`/`io/` and P2 elsewhere, UNLESS the handler
+  re-raises via a bare ``raise`` (metering/translation wrappers keep
+  the original type — that's the taxonomy-preserving shape).
+
+``except recover_on`` / other name-typed catches are never flagged:
+the tuple is typed at its definition site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..finding import Finding
+from ..project import Project, PyFile, dotted_name
+from ..registry import register
+
+GENERIC_RAISES = {"Exception", "RuntimeError", "BaseException"}
+BROAD_CATCHES = {"Exception", "BaseException"}
+#: paths whose exceptions the supervisor/recovery plane classifies
+CLASSIFIED_SUBDIRS = ("/runtime/", "/io/")
+
+
+def _classified(relpath: str) -> bool:
+    return any(s in "/" + relpath for s in CLASSIFIED_SUBDIRS)
+
+
+def _handler_types(h: ast.ExceptHandler) -> List[str]:
+    if h.type is None:
+        return ["<bare>"]
+    nodes = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+    return [dotted_name(n) or "<expr>" for n in nodes]
+
+
+def _swallows(h: ast.ExceptHandler) -> bool:
+    for s in h.body:
+        if isinstance(s, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(s, ast.Expr) and isinstance(s.value, ast.Constant):
+            continue  # docstring / ...
+        return False
+    return True
+
+
+def _reraises(h: ast.ExceptHandler) -> bool:
+    """Does the handler ITSELF re-raise the caught exception?
+
+    A bare ``raise`` or ``raise e`` (the handler's own caught name)
+    belonging to this handler counts: both preserve the original type.
+    One inside a nested function (runs later, if ever) or inside a
+    nested ``try``'s own except block (re-raises the INNER exception)
+    does not preserve this handler's taxonomy.
+    """
+    stack: list = list(h.body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(n, ast.Raise):
+            if n.exc is None:
+                return True
+            if h.name and isinstance(n.exc, ast.Name) \
+                    and n.exc.id == h.name:
+                return True
+        if isinstance(n, ast.Try):
+            # body/else/finally still see this handler's exception
+            # context; the nested handlers have their own
+            stack.extend(n.body)
+            stack.extend(n.orelse)
+            stack.extend(n.finalbody)
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+@register
+class RaiseGenericRule:
+    name = "raise-generic-exception"
+    doc = ("raise of bare Exception/RuntimeError in supervisor-classified "
+           "paths (runtime/, io/) — the crash taxonomy can't see it")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for pf in project.target_files():
+            if pf.tree is None:
+                continue
+            for n in ast.walk(pf.tree):
+                if not isinstance(n, ast.Raise) or n.exc is None:
+                    continue
+                exc = n.exc
+                name = dotted_name(exc.func) if isinstance(exc, ast.Call) \
+                    else dotted_name(exc)
+                if name in GENERIC_RAISES:
+                    sev = "P1" if _classified(pf.relpath) else "P2"
+                    out.append(Finding(
+                        rule=self.name, severity=sev, path=pf.relpath,
+                        line=n.lineno,
+                        message=(f"raise {name} — use a typed exception "
+                                 "(TransientError subclass or a domain "
+                                 "error) so the supervisor taxonomy can "
+                                 "classify it"),
+                        context=(f"{pf.module}:"
+                                 f"{project.qualname_at(pf, n.lineno)}")))
+        return out
+
+
+@register
+class ExceptionSwallowRule:
+    name = "exception-swallow"
+    doc = ("`except Exception: pass` — erases the crash-loop breaker's "
+           "signal and the flight-record event")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for pf in project.target_files():
+            if pf.tree is None:
+                continue
+            for h in _handlers(pf):
+                types = _handler_types(h)
+                if not (set(types) & BROAD_CATCHES) and "<bare>" not in types:
+                    continue
+                if _swallows(h):
+                    out.append(Finding(
+                        rule=self.name, severity="P1", path=pf.relpath,
+                        line=h.lineno,
+                        message=("broad except silently swallows "
+                                 f"({'/'.join(types)}) — at minimum log "
+                                 "the type so crash classification and "
+                                 "triage keep their signal"),
+                        context=(f"{pf.module}:"
+                                 f"{project.qualname_at(pf, h.lineno)}")))
+        return out
+
+
+@register
+class BroadCatchRule:
+    name = "broad-exception-catch"
+    doc = ("`except Exception` without a bare re-raise in supervisor-"
+           "classified paths — narrows the taxonomy to mush")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for pf in project.target_files():
+            if pf.tree is None:
+                continue
+            for h in _handlers(pf):
+                types = _handler_types(h)
+                if not (set(types) & BROAD_CATCHES) and "<bare>" not in types:
+                    continue
+                if _swallows(h) or _reraises(h):
+                    continue  # swallow has its own rule; re-raise is fine
+                sev = "P1" if _classified(pf.relpath) else "P2"
+                out.append(Finding(
+                    rule=self.name, severity=sev, path=pf.relpath,
+                    line=h.lineno,
+                    message=(f"broad catch ({'/'.join(types)}) handles "
+                             "without re-raising — narrow to the types "
+                             "this site really expects, or pragma with "
+                             "the reason the broad net is intentional"),
+                    context=(f"{pf.module}:"
+                             f"{project.qualname_at(pf, h.lineno)}")))
+        return out
+
+
+def _handlers(pf: PyFile) -> Iterable[ast.ExceptHandler]:
+    for n in ast.walk(pf.tree):
+        if isinstance(n, ast.ExceptHandler):
+            yield n

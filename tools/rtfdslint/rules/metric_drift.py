@@ -1,0 +1,151 @@
+"""metric-name-drift: the registry, the docs and the dashboards agree.
+
+The standing ROADMAP rule is that perf/robustness claims cite
+``rtfds_*`` registry metrics. That only works if the names line up:
+a dashboard tile or test asserting on a metric that nothing registers
+reads forever-zero (silently green), and a registered metric the
+README never mentions is an operator trap. Two-way diff:
+
+* every ``rtfds_*`` token referenced in ``io/dashboard.py``, README
+  and ``tests/`` must be registered by a
+  ``.counter/.gauge/.histogram("rtfds_…")`` call somewhere in the
+  package (or, for tests, in the tests themselves — fixtures register
+  scratch metrics); histogram ``_bucket``/``_sum``/``_count`` suffixes
+  normalize to the base name. Unregistered reference → P1.
+* every name registered in the package must appear in the README —
+  literally or via a documented ``rtfds_family_*`` wildcard prefix.
+  Undocumented metric → P2 at the registration site.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Set, Tuple
+
+from ..finding import Finding
+from ..project import Project, PyFile
+from ..registry import register
+
+REGISTER_METHODS = {"counter", "gauge", "histogram"}
+HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+#: token not followed by more name chars or a literal ``*`` (wildcards
+#: are documentation prefixes, not names)
+_REF_RE = re.compile(r"rtfds_[a-z0-9_]+(?![\w*])")
+_WILD_RE = re.compile(r"(rtfds_[a-z0-9_]*)\*")
+
+
+def _registrations(pf: PyFile) -> Iterable[Tuple[str, int]]:
+    if pf.tree is None:
+        return
+    for n in ast.walk(pf.tree):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in REGISTER_METHODS and n.args:
+            a0 = n.args[0]
+            if isinstance(a0, ast.Constant) and isinstance(a0.value, str) \
+                    and a0.value.startswith("rtfds_"):
+                yield a0.value, n.lineno
+
+
+def _refs_in_text(text: str) -> Dict[str, int]:
+    """name -> first line referenced."""
+    out: Dict[str, int] = {}
+    for i, line in enumerate(text.splitlines(), start=1):
+        for m in _REF_RE.finditer(line):
+            out.setdefault(m.group(0), i)
+    return out
+
+
+@register
+class MetricNameDriftRule:
+    name = "metric-name-drift"
+    doc = ("rtfds_* name referenced in dashboard/README/tests but "
+           "registered nowhere (reads forever-zero)")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        from ..project import PACKAGE_NAME
+
+        if PACKAGE_NAME not in project.target_specs:
+            # the two-way diff is a WHOLE-package contract: on a partial
+            # run (one subdir, one file, a self-check over tools/) the
+            # registration set is incomplete and every verdict would be
+            # noise — skip rather than flood false P1s
+            return []
+        pkg_reg: Dict[str, Tuple[str, int]] = {}   # name -> first site
+        for pf in project.target_files():
+            for name, line in _registrations(pf):
+                pkg_reg.setdefault(name, (pf.relpath, line))
+        test_reg: Set[str] = set()
+        for pf in project.test_files():
+            test_reg.update(n for n, _ in _registrations(pf))
+
+        def covered(name: str, registered: Set[str]) -> bool:
+            if name in registered:
+                return True
+            for suf in HIST_SUFFIXES:
+                if name.endswith(suf) and name[: -len(suf)] in registered:
+                    return True
+            return False
+
+        out: List[Finding] = []
+        # dashboard/README references must resolve against PACKAGE
+        # registrations — a tests-only fixture metric must not satisfy a
+        # production tile (it would still read forever-zero in serving).
+        # References inside tests/ may additionally use the tests' own
+        # scratch registrations.
+        pkg_names = set(pkg_reg)
+        ref_sources: List[Tuple[str, str, Set[str]]] = []
+        dash = project.files.get(
+            "real_time_fraud_detection_system_tpu/io/dashboard.py")
+        if dash is not None:
+            ref_sources.append((dash.relpath, dash.text, pkg_names))
+        if project.readme_text:
+            ref_sources.append((project.readme_rel, project.readme_text,
+                                pkg_names))
+        for pf in project.test_files():
+            ref_sources.append((pf.relpath, pf.text,
+                                pkg_names | test_reg))
+        for rel, text, registered in ref_sources:
+            for name, line in sorted(_refs_in_text(text).items()):
+                if not covered(name, registered):
+                    out.append(Finding(
+                        rule=self.name, severity="P1", path=rel,
+                        line=line,
+                        message=(f"{name} is referenced here but no "
+                                 ".counter/.gauge/.histogram call "
+                                 "registers it — the reference reads "
+                                 "forever-zero"),
+                        context=name))
+        # direction 2: registered but undocumented
+        doc_names = set(_refs_in_text(project.readme_text))
+        doc_prefixes = {m.group(1)
+                        for m in _WILD_RE.finditer(project.readme_text)}
+        for name in sorted(pkg_reg):
+            if name in doc_names:
+                continue
+            if any(name.startswith(p) for p in doc_prefixes):
+                continue
+            rel, line = pkg_reg[name]
+            out.append(Finding(
+                rule="undocumented-metric", severity="P2", path=rel,
+                line=line,
+                message=(f"{name} is registered but the README "
+                         "observability catalog never mentions it "
+                         "(document it or fold it into a documented "
+                         "rtfds_family_* wildcard)"),
+                context=name))
+        return out
+
+
+@register
+class UndocumentedMetricRule:
+    """Catalog/pragma name holder; produced by MetricNameDriftRule
+    (the runner follows ``produced_by`` for focused ``--rule`` runs)."""
+
+    produced_by = "metric-name-drift"
+    name = "undocumented-metric"
+    doc = ("metric registered in the package but absent from the README "
+           "observability catalog")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        return []

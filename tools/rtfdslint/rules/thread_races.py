@@ -1,0 +1,534 @@
+"""cross-thread-race + lock-order-cycle: the single-writer discipline.
+
+Six modules spawn threads (async sink writer, prefetch producer,
+streaming learner, metrics HTTP server, checkpoint op-timeout, fault
+injectors); each one's contract is "loop thread owns X, worker owns Y,
+hand-offs go through a Queue/Event/lock or an atomic whole-object
+swap". This rule derives that contract per class and flags where the
+code breaks it.
+
+Thread inventory: every ``threading.Thread(target=self.X)`` /
+``executor.submit(self.X)`` inside a class marks ``X`` as a worker
+entry point. The worker side is the self-call closure of those entry
+points; the loop side is the closure of every other method
+(``__init__`` is excluded — it runs before the thread exists).
+
+An attribute shared by both sides is SAFE when every access is one of:
+* inside ``with self.<lock>`` (a lock/RLock/Condition attr, by
+  constructor or by name), including methods only ever called from
+  inside such a block;
+* an operation on a synchronization object itself (Queue/Event/
+  deque/Lock constructed in ``__init__``);
+* a plain whole-object rebind (``self.x = v``) or plain read — the
+  sanctioned GIL-atomic swap idiom.
+
+What's flagged (P1) is the remainder: read-modify-write (``+=``) or
+in-place mutation (``.append``/``[k] = v``/``del``/``.update``…) of a
+plain shared attribute with no guard on either side — exactly the
+shape of bug PR 7's review-pass hardening list kept finding at runtime.
+
+lock-order-cycle (P1): nested ``with self._a: … with self._b:``
+acquisitions (lexically, plus one level of intra-class calls) build a
+per-class acquisition graph; any cycle is a potential deadlock.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..finding import Finding
+from ..project import (ClassInfo, FuncDef, Project, PyFile, dotted_name,
+                       iter_own_nodes, walk_calls)
+from ..registry import register
+
+SYNC_CONSTRUCTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Event", "threading.Semaphore", "threading.BoundedSemaphore",
+    "threading.Barrier", "Lock", "RLock", "Condition", "Event",
+    "queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+    "queue.SimpleQueue", "Queue", "SimpleQueue",
+    "collections.deque", "deque",
+}
+LOCK_CONSTRUCTORS = {"threading.Lock", "threading.RLock",
+                     "threading.Condition", "Lock", "RLock", "Condition"}
+MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert",
+            "add", "update", "setdefault", "pop", "popitem", "popleft",
+            "remove", "discard", "clear", "sort", "reverse", "write"}
+THREAD_NAMES = {"threading.Thread", "Thread"}
+
+
+@dataclass
+class Access:
+    attr: str
+    kind: str      # "read" | "swap" | "rmw" | "mutate"
+    guarded: bool
+    method: str
+    line: int
+
+
+@dataclass
+class MethodFacts:
+    accesses: List[Access] = field(default_factory=list)
+    #: (callee-name, guarded) intra-class call sites
+    calls: List[Tuple[str, bool]] = field(default_factory=list)
+    #: lock-acquisition nesting edges (outer, inner) + held-at-call map
+    lock_edges: List[Tuple[str, str, int]] = field(default_factory=list)
+    calls_under_lock: List[Tuple[str, str, int]] = field(
+        default_factory=list)  # (callee, held lock, line)
+    acquires: List[str] = field(default_factory=list)
+
+
+@register
+class CrossThreadRaceRule:
+    name = "cross-thread-race"
+    doc = ("mutable attribute written in one thread's reachable set and "
+           "read in another's with no lock/queue/atomic-swap guard")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for pf in project.target_files():
+            if pf.tree is None:
+                continue
+            for ci in pf.classes.values():
+                out.extend(self._check_class(project, pf, ci))
+        return out
+
+    # -- per-class ---------------------------------------------------------
+
+    def _check_class(self, project: Project, pf: PyFile,
+                     ci: ClassInfo) -> List[Finding]:
+        targets = self._thread_targets(ci)
+        if not targets:
+            return []
+        sync_attrs, lock_attrs = _sync_attrs(ci)
+        facts = {name: self._method_facts(fd, sync_attrs, lock_attrs)
+                 for name, fd in ci.methods.items()}
+        self._propagate_lock_context(facts, targets)
+
+        worker_methods = self._closure(ci, targets)
+        # Loop-side roots: everything externally invocable. A PRIVATE
+        # method that only exists inside the worker closure is not an
+        # independent loop entry point — rooting it would count its
+        # accesses on both sides and report single-thread-owned code as
+        # racing with itself. (If loop-side code really calls it, it
+        # enters the loop closure through that caller's public root.)
+        loop_roots = [m for m in ci.methods
+                      if m not in ("__init__",) and m not in targets
+                      and (not m.startswith("_")
+                           or m not in worker_methods)]
+        loop_methods = self._closure(ci, loop_roots)
+
+        findings = self._race_findings(pf, ci, facts, sync_attrs,
+                                       worker_methods, loop_methods,
+                                       targets)
+        findings.extend(self._lock_cycles(pf, ci, facts))
+        return findings
+
+    def _thread_targets(self, ci: ClassInfo) -> Set[str]:
+        """Worker entry points spawned by this class."""
+        targets: Set[str] = set()
+        for fd in ci.methods.values():
+            for call in walk_calls(fd.node):
+                dn = dotted_name(call.func)
+                if dn in THREAD_NAMES:
+                    for kw in call.keywords:
+                        if kw.arg == "target":
+                            m = _self_attr(kw.value)
+                            if m:
+                                targets.add(m)
+                elif isinstance(call.func, ast.Attribute) \
+                        and call.func.attr == "submit" and call.args:
+                    m = _self_attr(call.args[0])
+                    if m:
+                        targets.add(m)
+        return {t for t in targets if t in ci.methods}
+
+    def _closure(self, ci: ClassInfo, roots: Iterable[str]) -> Set[str]:
+        seen: Set[str] = set()
+        work = [r for r in roots if r in ci.methods]
+        while work:
+            m = work.pop()
+            if m in seen:
+                continue
+            seen.add(m)
+            for call in walk_calls(ci.methods[m].node):
+                callee = _self_call(call)
+                if callee and callee in ci.methods and callee not in seen:
+                    work.append(callee)
+        return seen
+
+    # -- per-method fact extraction ---------------------------------------
+
+    def _method_facts(self, fd: FuncDef, sync_attrs: Set[str],
+                      lock_attrs: Set[str]) -> MethodFacts:
+        mf = MethodFacts()
+
+        def walk(stmts: List[ast.stmt], held: List[str]) -> None:
+            for s in stmts:
+                if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                    continue
+                if isinstance(s, ast.With):
+                    locks_here = []
+                    for item in s.items:
+                        a = _self_attr(item.context_expr)
+                        if a and (a in lock_attrs or _lockish(a)):
+                            # items acquire left-to-right: earlier
+                            # items of the SAME with are already held
+                            # (`with self._a, self._b:` is an a->b edge)
+                            for outer in held + locks_here:
+                                if outer != a:
+                                    mf.lock_edges.append(
+                                        (outer, a, s.lineno))
+                            locks_here.append(a)
+                            mf.acquires.append(a)
+                        else:
+                            self._exprs(item.context_expr, held, mf, fd)
+                    walk(s.body, held + locks_here)
+                    continue
+                if isinstance(s, ast.Try):
+                    walk(s.body, held)
+                    for h in s.handlers:
+                        walk(h.body, held)
+                    walk(s.orelse, held)
+                    walk(s.finalbody, held)
+                    continue
+                if isinstance(s, (ast.If, ast.While)):
+                    self._exprs(s.test, held, mf, fd)
+                    walk(s.body, held)
+                    walk(s.orelse, held)
+                    continue
+                if isinstance(s, ast.For):
+                    self._exprs(s.iter, held, mf, fd)
+                    self._store_targets(s.target, held, mf, fd)
+                    walk(s.body, held)
+                    walk(s.orelse, held)
+                    continue
+                if isinstance(s, ast.Match):
+                    self._exprs(s.subject, held, mf, fd)
+                    for case in s.cases:
+                        if case.guard is not None:
+                            self._exprs(case.guard, held, mf, fd)
+                        walk(case.body, held)
+                    continue
+                if isinstance(s, ast.Assign):
+                    self._exprs(s.value, held, mf, fd)
+                    for t in s.targets:
+                        self._store_targets(t, held, mf, fd)
+                    continue
+                if isinstance(s, ast.AnnAssign):
+                    if s.value is not None:
+                        self._exprs(s.value, held, mf, fd)
+                    self._store_targets(s.target, held, mf, fd)
+                    continue
+                if isinstance(s, ast.AugAssign):
+                    self._exprs(s.value, held, mf, fd)
+                    a = _self_attr(s.target)
+                    if a:
+                        mf.accesses.append(Access(a, "rmw", bool(held),
+                                                  fd.name, s.lineno))
+                    elif isinstance(s.target, ast.Subscript):
+                        base = _self_attr(s.target.value)
+                        if base:
+                            mf.accesses.append(Access(
+                                base, "mutate", bool(held), fd.name,
+                                s.lineno))
+                    continue
+                if isinstance(s, ast.Delete):
+                    for t in s.targets:
+                        a = _self_attr(t)
+                        if a:
+                            mf.accesses.append(Access(
+                                a, "mutate", bool(held), fd.name,
+                                s.lineno))
+                        elif isinstance(t, ast.Subscript):
+                            base = _self_attr(t.value)
+                            if base:
+                                mf.accesses.append(Access(
+                                    base, "mutate", bool(held), fd.name,
+                                    s.lineno))
+                    continue
+                # everything else: scan expressions
+                for child in ast.iter_child_nodes(s):
+                    if isinstance(child, ast.expr):
+                        self._exprs(child, held, mf, fd)
+
+        if isinstance(fd.node.body, list):
+            walk(fd.node.body, [])
+        return mf
+
+    def _store_targets(self, node: ast.AST, held: List[str],
+                       mf: MethodFacts, fd: FuncDef) -> None:
+        a = _self_attr(node)
+        if a:
+            mf.accesses.append(Access(a, "swap", bool(held), fd.name,
+                                      node.lineno))
+            return
+        if isinstance(node, ast.Subscript):
+            base = _self_attr(node.value)
+            if base:
+                mf.accesses.append(Access(base, "mutate", bool(held),
+                                          fd.name, node.lineno))
+            self._exprs(node.slice, held, mf, fd)
+            return
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for elt in node.elts:
+                self._store_targets(elt, held, mf, fd)
+
+    def _exprs(self, expr: ast.AST, held: List[str], mf: MethodFacts,
+               fd: FuncDef) -> None:
+        """Reads, mutating calls and intra-class calls in an expression.
+
+        Accesses inside a nested lambda/def are still recorded on the
+        defining method's side (the common queue-callback idiom runs
+        them near their definition) but ALWAYS as unguarded: the body
+        executes later, when any lock held at definition time has long
+        been released.
+        """
+        stack: List[tuple] = [(expr, False)]
+        while stack:
+            n, deferred = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                for child in ast.iter_child_nodes(n):
+                    stack.append((child, True))
+                continue
+            for child in ast.iter_child_nodes(n):
+                stack.append((child, deferred))
+            guarded = bool(held) and not deferred
+            if isinstance(n, ast.Call):
+                callee = _self_call(n)
+                if callee:
+                    mf.calls.append((callee, guarded))
+                    if guarded:
+                        mf.calls_under_lock.append((callee, held[-1],
+                                                    n.lineno))
+                if isinstance(n.func, ast.Attribute):
+                    base = _self_attr(n.func.value)
+                    if base:
+                        kind = ("mutate" if n.func.attr in MUTATORS
+                                else "read")
+                        mf.accesses.append(Access(base, kind, guarded,
+                                                  fd.name, n.lineno))
+            elif isinstance(n, ast.Attribute) and isinstance(n.ctx,
+                                                             ast.Load):
+                a = _self_attr(n)
+                if a:
+                    mf.accesses.append(Access(a, "read", guarded,
+                                              fd.name, n.lineno))
+
+    def _propagate_lock_context(self, facts: Dict[str, MethodFacts],
+                                targets: Set[str]) -> None:
+        """A private method only ever called under a lock is guarded.
+
+        Thread ENTRY POINTS are excluded: ``Thread(target=self._work)``
+        invokes ``_work`` with no lock held, so even if every in-code
+        call site is guarded, the thread's own invocation is not.
+        """
+        for _ in range(3):  # tiny fixpoint (call chains are shallow)
+            changed = False
+            for name, mf in facts.items():
+                if not name.startswith("_") or name == "__init__" \
+                        or name in targets:
+                    continue
+                sites = [g for callee, g in _all_calls(facts)
+                         if callee == name]
+                if sites and all(sites):
+                    for acc in mf.accesses:
+                        if not acc.guarded:
+                            acc.guarded = True
+                            changed = True
+                    for i, (callee, g) in enumerate(mf.calls):
+                        if not g:
+                            mf.calls[i] = (callee, True)
+                            changed = True
+            if not changed:
+                break
+
+    # -- verdicts ----------------------------------------------------------
+
+    def _race_findings(self, pf: PyFile, ci: ClassInfo,
+                       facts: Dict[str, MethodFacts],
+                       sync_attrs: Set[str], worker: Set[str],
+                       loop: Set[str], targets: Set[str]) -> List[Finding]:
+        by_attr: Dict[str, Dict[str, List[Access]]] = {}
+        for side, methods in (("worker", worker), ("loop", loop)):
+            for m in sorted(methods):  # deterministic finding messages
+                for acc in facts[m].accesses:
+                    if acc.attr in sync_attrs or _lockish(acc.attr):
+                        continue
+                    by_attr.setdefault(acc.attr, {}).setdefault(
+                        side, []).append(acc)
+        out: List[Finding] = []
+        for attr, sides in sorted(by_attr.items()):
+            w, l = sides.get("worker", []), sides.get("loop", [])
+            if not w or not l:
+                continue
+            for side_name, accs, other in (("worker", w, l),
+                                           ("loop", l, w)):
+                bad = [a for a in accs if not a.guarded
+                       and a.kind in ("rmw", "mutate")]
+                # ANY access on the other side races with an unguarded
+                # RMW/mutation — a lock only excludes other lock
+                # holders, so a fully-guarded far side does not make
+                # this side's bare `+=` safe (lost update)
+                if bad and other:
+                    a, o = bad[0], other[0]
+                    out.append(Finding(
+                        rule=self.name, severity="P1", path=pf.relpath,
+                        line=a.line,
+                        message=(
+                            f"self.{attr} is {_verb(a.kind)} WITHOUT a "
+                            f"guard in {side_name}-side "
+                            f"{ci.name}.{a.method} and "
+                            f"{_verb(o.kind)}"
+                            f"{'' if not o.guarded else ' (guarded)'} in "
+                            f"{_other(side_name)}-side "
+                            f"{ci.name}.{o.method} — a lock only "
+                            "excludes other lock holders (threads "
+                            f"spawned with target={sorted(targets)})"),
+                        context=f"{pf.module}:{ci.name}.{a.method}"))
+                    break  # one finding per attribute
+        return out
+
+    def _lock_cycles(self, pf: PyFile, ci: ClassInfo,
+                     facts: Dict[str, MethodFacts]) -> List[Finding]:
+        edges: Dict[str, Set[str]] = {}
+        lines: Dict[Tuple[str, str], int] = {}
+        for mf in facts.values():
+            for outer, inner, line in mf.lock_edges:
+                edges.setdefault(outer, set()).add(inner)
+                lines.setdefault((outer, inner), line)
+            # one level of call-aware nesting: with self._a: self.m()
+            # where m acquires self._b
+            for callee, lock, line in mf.calls_under_lock:
+                cmf = facts.get(callee)
+                if cmf is None:
+                    continue
+                for inner in cmf.acquires:
+                    if inner != lock:
+                        edges.setdefault(lock, set()).add(inner)
+                        lines.setdefault((lock, inner), line)
+        out: List[Finding] = []
+        seen_cycles: Set[frozenset] = set()
+        for start in sorted(edges):
+            cyc = _find_cycle(edges, start)
+            if cyc and frozenset(cyc) not in seen_cycles:
+                seen_cycles.add(frozenset(cyc))
+                line = lines.get((cyc[0], cyc[1]), ci.node.lineno)
+                out.append(Finding(
+                    rule="lock-order-cycle", severity="P1",
+                    path=pf.relpath, line=line,
+                    message=(f"{ci.name} acquires locks in a cycle: "
+                             + " -> ".join(f"self.{a}" for a in cyc)
+                             + " -> self." + cyc[0]
+                             + " (potential deadlock under concurrent "
+                               "entry)"),
+                    context=f"{pf.module}:{ci.name}"))
+        return out
+
+
+@register
+class LockOrderCycleRule:
+    """Registered for catalog/pragma purposes; findings are produced by
+    CrossThreadRaceRule (which owns the shared per-class facts) — the
+    runner follows ``produced_by`` so ``--rule lock-order-cycle`` runs
+    the producing analysis instead of passing vacuously."""
+
+    name = "lock-order-cycle"
+    doc = ("nested `with self._lock` acquisitions form a cycle across "
+           "a class's methods (potential deadlock)")
+    produced_by = "cross-thread-race"
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        return []
+
+
+def _verb(kind: str) -> str:
+    return {"rmw": "read-modify-written (augmented assign)",
+            "mutate": "mutated in place",
+            "swap": "rebound", "read": "read"}[kind]
+
+
+def _other(side: str) -> str:
+    return "loop" if side == "worker" else "worker"
+
+
+_LOCK_TOKENS = {"lock", "rlock", "mutex", "cond", "condition", "cv"}
+
+
+def _lockish(attr: str) -> bool:
+    """Name-convention lock detection, TOKEN-anchored: `_lock`,
+    `state_lock`, `cond` — but never `seconds` or `clock` ('cond'/'lock'
+    as substrings must not exclude plain attributes from analysis)."""
+    return bool(_LOCK_TOKENS
+                & set(attr.lower().lstrip("_").split("_")))
+
+
+def _self_attr(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return ""
+
+
+def _self_call(call: ast.Call) -> str:
+    if isinstance(call.func, ast.Attribute) \
+            and isinstance(call.func.value, ast.Name) \
+            and call.func.value.id == "self":
+        return call.func.attr
+    return ""
+
+
+def _all_calls(facts: Dict[str, MethodFacts]):
+    for mf in facts.values():
+        for c in mf.calls:
+            yield c
+
+
+def _sync_attrs(ci: ClassInfo) -> Tuple[Set[str], Set[str]]:
+    """Attrs assigned from sync-primitive constructors in __init__."""
+    sync: Set[str] = set()
+    locks: Set[str] = set()
+    init = ci.methods.get("__init__")
+    if init is None:
+        return sync, locks
+    for n in iter_own_nodes(init.node):
+        if not isinstance(n, ast.Assign):
+            continue
+        if not isinstance(n.value, ast.Call):
+            continue
+        dn = dotted_name(n.value.func)
+        if dn in SYNC_CONSTRUCTORS:
+            for t in n.targets:
+                a = _self_attr(t)
+                if a:
+                    sync.add(a)
+                    if dn in LOCK_CONSTRUCTORS:
+                        locks.add(a)
+    return sync, locks
+
+
+def _find_cycle(edges: Dict[str, Set[str]],
+                start: str) -> Optional[List[str]]:
+    path: List[str] = []
+    on_path: Set[str] = set()
+
+    def dfs(node: str) -> Optional[List[str]]:
+        if node in on_path:
+            return path[path.index(node):]
+        if node not in edges:
+            return None
+        path.append(node)
+        on_path.add(node)
+        for nxt in sorted(edges[node]):
+            got = dfs(nxt)
+            if got:
+                return got
+        path.pop()
+        on_path.discard(node)
+        return None
+
+    return dfs(start)

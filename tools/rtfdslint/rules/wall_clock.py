@@ -1,0 +1,138 @@
+"""wall-clock-duration: `time.time()` deltas measured as latency.
+
+Every latency/duration claim in this repo is registry-grounded
+(standing ROADMAP rule), and wall clock is not a duration clock: NTP
+slews it, the operator can step it, and a negative "latency" poisons
+histograms silently. Durations use ``time.perf_counter()`` (or
+``monotonic``); ``time.time()`` is for *timestamps* — manifest stamps,
+part-file names, cross-process ages.
+
+Flagged (P1): a ``time.time()`` value appearing in a ``-`` expression —
+directly (``time.time() - t0``) or through a local variable assigned
+from it in the same function. Cross-process age checks (healthz batch
+age vs a wall-clock gauge, probe-cache TTL vs a persisted stamp) are
+wall-clock *on purpose*: those carry a pragma naming that reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from ..finding import Finding
+from ..project import Project, PyFile, dotted_name, iter_own_nodes
+from ..registry import register
+
+
+def _time_aliases(pf: PyFile) -> Set[str]:
+    """Dotted spellings of wall-clock time() in this file."""
+    out = {"time.time"}
+    for local, target in pf.imports.items():
+        if target == "time":
+            out.add(f"{local}.time")
+        elif target == "time.time":
+            out.add(local)
+    return out
+
+
+def _is_wall_call(node: ast.AST, aliases: Set[str]) -> bool:
+    return isinstance(node, ast.Call) and dotted_name(node.func) in aliases
+
+
+@register
+class WallClockDurationRule:
+    name = "wall-clock-duration"
+    doc = ("time.time() delta used as a duration — use perf_counter/"
+           "monotonic; wall clock is for timestamps only")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for pf in project.target_files():
+            if pf.tree is None:
+                continue
+            aliases = _time_aliases(pf)
+            for fd in pf.functions:
+                out.extend(self._scan_scope(
+                    pf, iter_own_nodes(fd.node), aliases,
+                    f"{pf.module}:{fd.qualname}"))
+            # module level (rare but possible)
+            out.extend(self._scan_scope(
+                pf, _module_level(pf.tree), aliases, pf.module))
+        return out
+
+    def _scan_scope(self, pf: PyFile, nodes, aliases: Set[str],
+                    context: str) -> List[Finding]:
+        # single source-ordered pass: a rebind to anything else KILLS a
+        # name's wall-clock status, so `t = time.time(); ...;
+        # t = time.perf_counter(); d = perf_counter() - t` never flags
+        all_nodes = sorted(
+            (n for n in nodes
+             if isinstance(n, (ast.Assign, ast.AnnAssign, ast.BinOp))),
+            key=lambda n: (n.lineno, n.col_offset))
+        wall_names: Set[str] = set()
+        out: List[Finding] = []
+        for n in all_nodes:
+            if isinstance(n, ast.AnnAssign):
+                if isinstance(n.target, ast.Name) and n.value is not None:
+                    (wall_names.add(n.target.id)
+                     if _is_wall_call(n.value, aliases)
+                     else wall_names.discard(n.target.id))
+                continue
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    _bind_wall(t, n.value, aliases, wall_names)
+                continue
+            if not isinstance(n.op, ast.Sub):
+                continue
+            for side in (n.left, n.right):
+                if self._wallish(side, aliases, wall_names):
+                    out.append(Finding(
+                        rule=self.name, severity="P1", path=pf.relpath,
+                        line=n.lineno,
+                        message=("duration computed from time.time(); "
+                                 "use time.perf_counter() — or pragma "
+                                 "with the reason a cross-process wall-"
+                                 "clock age is really meant"),
+                        context=context))
+                    break
+        return out
+
+    def _wallish(self, node: ast.AST, aliases: Set[str],
+                 wall_names: Set[str]) -> bool:
+        """The operand IS (or directly wraps) a wall-clock value."""
+        if _is_wall_call(node, aliases):
+            return True
+        if isinstance(node, ast.Name) and node.id in wall_names:
+            return True
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id in ("float", "int") and node.args:
+            return self._wallish(node.args[0], aliases, wall_names)
+        return False
+
+
+def _bind_wall(target: ast.AST, value: ast.AST, aliases: Set[str],
+               wall_names: Set[str]) -> None:
+    """Per-name wall status for one assignment target, including the
+    ``t0, t1 = time.time(), time.time()`` tuple form; any non-wall
+    rebind kills the name's status."""
+    if isinstance(target, ast.Name):
+        (wall_names.add(target.id) if _is_wall_call(value, aliases)
+         else wall_names.discard(target.id))
+        return
+    if isinstance(target, (ast.Tuple, ast.List)):
+        elts_v = (value.elts if isinstance(value, (ast.Tuple, ast.List))
+                  and len(value.elts) == len(target.elts) else None)
+        for i, t in enumerate(target.elts):
+            _bind_wall(t, elts_v[i] if elts_v is not None
+                       else ast.Constant(value=None), aliases, wall_names)
+
+
+def _module_level(tree: ast.Module):
+    stack: List[ast.AST] = list(tree.body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))

@@ -1,0 +1,197 @@
+"""Lint orchestration: build project → run rules → pragma/baseline → verdict."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .baseline import Baseline
+from .finding import Finding, RuleStats
+from .pragmas import parse_pragmas
+from .registry import all_rules, known_rule_names
+from .project import PACKAGE_NAME, Project
+
+DEFAULT_BASELINE = "tools/rtfdslint/baseline.json"
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)   # active only
+    suppressed: List[Finding] = field(default_factory=list)  # pragma'd
+    baselined: List[Finding] = field(default_factory=list)
+    stale_baseline: List[dict] = field(default_factory=list)
+    stats: Dict[str, RuleStats] = field(default_factory=dict)
+    files_scanned: int = 0
+
+    def gate_failures(self, strict: bool = False) -> List[Finding]:
+        bad = ("P0", "P1") if not strict else ("P0", "P1", "P2")
+        return [f for f in self.findings if f.severity in bad]
+
+    def to_json(self, strict: bool = False) -> dict:
+        return {
+            "version": 1,
+            "files_scanned": self.files_scanned,
+            "strict": strict,
+            "findings": [f.to_json() for f in self.findings],
+            "suppressed": [f.to_json() for f in self.suppressed],
+            "baselined": [f.to_json() for f in self.baselined],
+            "stale_baseline_entries": self.stale_baseline,
+            "rules": {k: v.to_json() for k, v in sorted(self.stats.items())},
+            "summary": {
+                "active": len(self.findings),
+                "gate_failures": len(self.gate_failures(strict=strict)),
+                "suppressed": len(self.suppressed),
+                "baselined": len(self.baselined),
+            },
+        }
+
+
+def run_lint(root: str,
+             targets: Optional[List[str]] = None,
+             baseline_path: Optional[str] = DEFAULT_BASELINE,
+             rules: Optional[List[str]] = None,
+             report_stale: Optional[bool] = None) -> LintResult:
+    """Run the analyzer. ``targets`` defaults to the serving package.
+
+    ``baseline_path`` is repo-root-relative (or absolute); pass None to
+    lint without a baseline (the self-check test does).
+    ``report_stale`` controls the stale-baseline-entry report; the
+    default (None) enables it only on unfocused runs — a ``rules``
+    filter or an explicit ``targets`` list narrows the finding set, so
+    live out-of-scope entries would be reported as stale and the
+    "delete them" advice would be wrong.
+    """
+    focused = bool(rules) or targets is not None
+    targets = targets or [PACKAGE_NAME]
+    project = Project(root, targets)
+    selected = all_rules()
+    if rules:
+        wanted = set(rules)
+        unknown = wanted - {r.name for r in selected}
+        if unknown:
+            # same contract as a typo'd target: never a vacuous pass
+            raise ValueError(
+                f"unknown rule name(s) {sorted(unknown)} — see "
+                "--list-rules for the catalog")
+        # placeholder rules (lock-order-cycle, undocumented-metric) are
+        # produced by another rule's analysis: pull the producer in so
+        # a focused run is never a vacuous pass…
+        producers = {getattr(r, "produced_by", "") for r in selected
+                     if r.name in wanted}
+        selected = [r for r in selected
+                    if r.name in wanted or r.name in producers]
+
+    raw: List[Finding] = list(project.parse_findings)
+    for rule_cls in selected:
+        raw.extend(rule_cls().run(project))
+
+    # pragma suppression (reason-required; meta-findings join the pool)
+    known = known_rule_names()
+    pragma_idx = {}
+    for rel, pf in project.files.items():
+        fp, meta = parse_pragmas(rel, pf.text, known,
+                                 stmt_cover=_stmt_cover(pf))
+        pragma_idx[rel] = fp
+        raw.extend(meta)
+    if project.readme_text:
+        fp, meta = parse_pragmas(project.readme_rel, project.readme_text,
+                                 known)
+        pragma_idx[project.readme_rel] = fp
+        raw.extend(meta)
+    if rules:
+        # findings narrow back to exactly what was asked for — a
+        # focused run must not fail on unrelated pragma hygiene — but
+        # parse-error P0s survive: a file the analyzer cannot read
+        # invalidates ANY focused run over it
+        keep = set(rules) | {"parse-error"}
+        raw = [f for f in raw if f.rule in keep]
+
+    baseline = Baseline(path="")
+    if baseline_path:
+        bp = baseline_path if os.path.isabs(baseline_path) \
+            else os.path.join(root, baseline_path)
+        baseline = Baseline.load(bp)
+
+    result = LintResult(files_scanned=len(project.files))
+    raw.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    deduped: List[Finding] = []
+    seen = set()
+    for f in raw:
+        key = (f.rule, f.path, f.line, f.col, f.message)
+        if key in seen:
+            continue  # same site reached via two analysis paths
+        seen.add(key)
+        deduped.append(f)
+    for f in deduped:
+        stats = result.stats.setdefault(f.rule, RuleStats())
+        fp = pragma_idx.get(f.path)
+        if fp is not None and fp.suppresses(f.rule, f.line):
+            f.suppressed = "pragma"
+            result.suppressed.append(f)
+            stats.suppressed += 1
+        elif baseline.absorb(f):  # P2s absorb too (output hygiene);
+            # only P0/P1 ever gate, baselined or not
+            f.suppressed = "baseline"
+            result.baselined.append(f)
+            stats.baselined += 1
+        else:
+            result.findings.append(f)
+            stats.active += 1
+    if report_stale if report_stale is not None else not focused:
+        result.stale_baseline = baseline.stale_entries()
+    return result
+
+
+def _stmt_cover(pf) -> Dict[int, int]:
+    """start line → last covered line, for pragma span expansion.
+
+    A pragma annotates a STATEMENT; if that statement wraps across
+    physical lines (Black-style reformat, parenthesized expressions),
+    the finding may anchor below the pragma line. Simple statements
+    cover their full span; compound statements (if/with/try/def…)
+    cover only their header (through the line before the first body
+    statement) so a pragma above an `if` never blankets the body.
+    """
+    import ast
+
+    cover: Dict[int, int] = {}
+    if pf.tree is None:
+        return cover
+    compound = (ast.If, ast.While, ast.For, ast.AsyncFor, ast.With,
+                ast.AsyncWith, ast.Try, ast.FunctionDef,
+                ast.AsyncFunctionDef, ast.ClassDef)
+    match_t = getattr(ast, "Match", ())
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.stmt):
+            continue
+        start = node.lineno
+        if isinstance(node, compound) or (match_t
+                                          and isinstance(node, match_t)):
+            body = getattr(node, "body", None)
+            end = (body[0].lineno - 1 if body
+                   else getattr(node, "end_lineno", start))
+        else:
+            end = getattr(node, "end_lineno", start)
+        end = max(start, end)
+        prev = cover.get(start)
+        if prev is None or end < prev:  # innermost statement wins
+            cover[start] = end
+    return cover
+
+
+def update_baseline(root: str, result: LintResult,
+                    baseline_path: str, reason: str) -> int:
+    """Absorb the current gate failures into the baseline file.
+
+    Entries that are still matching (``result.baselined`` — whatever
+    their severity) are REWRITTEN with their existing reasons, not
+    dropped: regenerating must never resurface a previously-accepted
+    finding on the next run. Only stale entries (matched nothing this
+    run) fall out.
+    """
+    bp = baseline_path if os.path.isabs(baseline_path) \
+        else os.path.join(root, baseline_path)
+    prior = Baseline.load(bp)
+    keep = result.gate_failures() + result.baselined
+    return Baseline.write(bp, keep, prior, reason)
