@@ -165,6 +165,20 @@ def _make_batch_cols(rng, n: int) -> dict:
     }
 
 
+class _ProbsCap:
+    """Sink stub that keeps only the served probabilities — the capture
+    half of every engine-level exactness A/B."""
+
+    def __init__(self):
+        self.probs: list = []
+
+    def append(self, res):
+        self.probs.append(res.probs)
+
+    def concat(self):
+        return np.concatenate(self.probs)
+
+
 class _RandSource:
     """Pre-generated random micro-batches for the engine-loop measurement
     (generation cost excluded from the measured loop)."""
@@ -247,6 +261,21 @@ def _child_main(args) -> None:
     )
     fcfg = cfg.features
     params, predict, skl = _build_model(args.model, rng)
+    headline_z_mode = None
+    if args.model == "forest":
+        # The headline hot path measures the SERVING default arithmetic
+        # (runtime.z_mode="auto" → int8 on TPU / f32 on CPU) — what
+        # `rtfds score` actually runs since round 9, decision-identical
+        # by the gemm_leaf_sum exactness contract.
+        from real_time_fraud_detection_system_tpu.models.forest import (
+            resolve_z_mode,
+        )
+
+        headline_z_mode = resolve_z_mode("auto")
+        _forest_predict = predict
+
+        def predict(p, x, _zm=headline_z_mode):  # noqa: F811
+            return _forest_predict(p, x, _zm)
     scaler = Scaler(mean=jnp.zeros(15), scale=jnp.ones(15))
 
     def _step_body(fstate, params, batch):
@@ -305,62 +334,10 @@ def _child_main(args) -> None:
     if best_rows == 0:
         raise RuntimeError(f"no batch size succeeded ({size_error})")
 
-    # ---- z-mode shootout: bf16 vs int8 on the MXU (forest only) --------
-    # gemm_leaf_sum's dominant contraction is exact in int8 (operands are
-    # tiny integers); the int8 MXU path peaks at 2× bf16 on v5e. Measure
-    # both, assert exactness, and let the winner take the headline.
-    z_stats = None
-    if args.model == "forest" and full:
-        try:
-            from real_time_fraud_detection_system_tpu.models.forest import (
-                gemm_predict_proba,
-            )
-
-            c = _make_batch_cols(rng, best_rows)
-            zbatch = jax.tree.map(jnp.asarray, make_batch(**c))
-            z_stats = {}
-
-            def _z_step(zm):
-                def s(fstate, params, batch):
-                    fstate, feats = update_and_featurize(fstate, batch,
-                                                         fcfg)
-                    p = gemm_predict_proba(params,
-                                           transform(scaler, feats),
-                                           z_mode=zm)
-                    return fstate, jnp.where(batch.valid, p, 0.0)
-
-                return jax.jit(s, donate_argnums=(0,))
-
-            probs_by_mode = {}
-            for zm in ("bf16", "int8", "f32"):
-                _progress(f"z_mode={zm}")
-                zstep = _z_step(zm)
-                fs = init_feature_state(fcfg)
-                fs, zp = zstep(fs, params, zbatch)
-                jax.block_until_ready(zp)
-                probs_by_mode[zm] = np.asarray(zp)
-                if zm == "f32":
-                    continue  # exactness oracle only — not timed
-                t0 = time.perf_counter()
-                iters = 0
-                while time.perf_counter() - t0 < args.seconds:
-                    for _ in range(4):
-                        fs, zp = zstep(fs, params, zbatch)
-                    jax.block_until_ready(zp)
-                    iters += 4
-                wall = time.perf_counter() - t0
-                z_stats[zm] = round(iters * best_rows / wall, 1)
-            z_stats["max_abs_delta_int8_vs_f32"] = float(
-                np.abs(probs_by_mode["int8"] - probs_by_mode["f32"]).max())
-            z_stats["max_abs_delta_bf16_vs_f32"] = float(
-                np.abs(probs_by_mode["bf16"] - probs_by_mode["f32"]).max())
-            winner = max(("bf16", "int8"), key=lambda m: z_stats[m])
-            z_stats["winner"] = winner
-            if z_stats[winner] > best_tps:
-                best_tps = z_stats[winner]
-                best_ms = best_rows / best_tps * 1e3
-        except Exception as e:
-            z_stats = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+    # (The round-4 z-mode shootout — bf16 vs int8 gemm_leaf_sum microbench
+    # — graduated: z_mode is now a serving knob (runtime.z_mode) and the
+    # A/B moved to the engine-level detail.device_plane block below, which
+    # measures the serving step rather than the isolated contraction.)
 
     # ---- classify latency: p50/p99 across serving batch sizes ----------
     _progress("latency percentiles")
@@ -482,6 +459,7 @@ def _child_main(args) -> None:
     engine_stats = None
     phase_p50 = None
     host_plane = None
+    device_plane = None
     if args.model == "forest":
         from real_time_fraud_detection_system_tpu.runtime.engine import (
             ScoringEngine,
@@ -777,6 +755,80 @@ def _child_main(args) -> None:
         except Exception as e:
             host_plane = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
 
+        # ---- device plane off/on (the round-9 A/B): the SERVING engine
+        # step measured over z_mode {f32, int8} × fused Pallas step
+        # {off, on} under precompile, with exactness asserted from the
+        # served probabilities (the int8 arm must be decision-identical
+        # — on CPU bit-identical — to the f32 control). Folds the old
+        # gemm_leaf_sum z-mode microbench shootout into an engine-level
+        # measurement; per-arm mfu/mfu_of_ceiling are annotated once the
+        # roofline ceiling is computed below.
+        _progress("device plane z_mode x fused")
+
+        def _device_plane_block():
+            import dataclasses as _zdc
+
+            from real_time_fraud_detection_system_tpu.utils.metrics import (
+                MetricsRegistry,
+            )
+
+            out = {"batch_rows": engine_rows, "batches": n_eng}
+            probs_by = {}
+
+            def _arm(label, z, fused):
+                _progress(f"device plane {label}")
+                reg = MetricsRegistry()
+                acfg = Config(
+                    features=ecfg.features,
+                    runtime=_zdc.replace(ecfg.runtime, z_mode=z,
+                                         use_pallas=fused,
+                                         precompile=True))
+                e = ScoringEngine(acfg, kind="forest", params=params,
+                                  scaler=scaler, metrics=reg)
+                cap = _ProbsCap()
+                # warmup run triggers precompile: the measured stream
+                # never includes build-time compiles
+                e.run(_RandSource(1, engine_rows, seed=3),
+                      trigger_seconds=0.0)
+                s = e.run(_RandSource(n_eng, engine_rows), sink=cap,
+                          trigger_seconds=0.0)
+                rc = reg.get("rtfds_xla_recompiles_total")
+                probs_by[label] = cap.concat()
+                out[label] = {
+                    "z_mode": e.z_mode,
+                    "use_pallas": fused,
+                    "rows_per_s": round(s["rows_per_s"], 1),
+                    "latency_p50_ms": round(s["latency_p50_ms"], 3),
+                    "mid_stream_recompiles": int(rc.value) if rc else 0,
+                }
+
+            _arm("z_f32_fused_off", "f32", False)
+            _arm("z_int8_fused_off", "int8", False)
+            if on_cpu and not os.environ.get("BENCH_FULL_SECTIONS"):
+                # the fused kernel only interprets off-TPU — measuring it
+                # there times the interpreter, not the device plane
+                out["fused_arms_skipped"] = "cpu (interpret-only)"
+            else:
+                _arm("z_f32_fused_on", "f32", True)
+                _arm("z_int8_fused_on", "int8", True)
+            a, b = (probs_by["z_f32_fused_off"],
+                    probs_by["z_int8_fused_off"])
+            out["max_abs_delta_int8_vs_f32"] = float(np.abs(a - b).max())
+            out["decision_flips_int8_vs_f32"] = int(
+                ((a >= 0.5) != (b >= 0.5)).sum())
+            if "z_int8_fused_on" in probs_by:
+                f = probs_by["z_int8_fused_on"]
+                out["max_abs_delta_fused_vs_unfused"] = float(
+                    np.abs(f - b).max())
+                out["decision_flips_fused_vs_unfused"] = int(
+                    ((f >= 0.5) != (b >= 0.5)).sum())
+            return out
+
+        try:
+            device_plane = _device_plane_block()
+        except Exception as e:
+            device_plane = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+
         if full:
             _progress("engine loop alerts-only")
             _guarded("alerts_only", lambda: _engine_stats(
@@ -845,18 +897,13 @@ def _child_main(args) -> None:
                 # probe engine over the exact stream the measurement will
                 # see (same seeds, same batching) and take q99 of the
                 # probabilities it actually serves.
-                cal = []
-
-                class _Cap:
-                    def append(self, res):
-                        cal.append(res.probs)
-
+                cal = _ProbsCap()
                 probe = ScoringEngine(bcfg, kind="forest", params=params,
                                       scaler=scaler)
                 probe.run(_RandSource(1, big, seed=3), trigger_seconds=0.0)
-                probe.run(_RandSource(12, big), sink=_Cap(),
+                probe.run(_RandSource(12, big), sink=cal,
                           trigger_seconds=0.0)
-                allp = np.concatenate(cal)
+                allp = cal.concat()
                 # The forest's probability mass is discrete (tree-vote
                 # averages): the q99 VALUE can carry a fat atom, and the
                 # engine flags with >=, so thresholding AT q99 can flag
@@ -1322,14 +1369,58 @@ def _child_main(args) -> None:
     # achievable MFU ceiling for this op mix is featurize_rate ×
     # classify_flops / peak; mfu_of_ceiling says how much of the
     # achievable ceiling the headline captures (DESIGN.md §Roofline).
+    # Measured UNCONDITIONALLY (round 9): the headline detail always
+    # carries mfu/mfu_ceiling/mfu_of_ceiling, so every session's device-
+    # plane claims have the same denominator on record (the pallas_forest
+    # block's featurize figure is reused when it already measured one).
     mfu_ceiling = None
     mfu_of_ceiling = None
-    if (isinstance(pallas_forest_stats, dict) and peak > 0
+    featurize_rate = None
+    if (isinstance(pallas_forest_stats, dict)
             and pallas_forest_stats.get("featurize_only_rows_per_s")):
-        f0 = float(pallas_forest_stats["featurize_only_rows_per_s"])
-        mfu_ceiling = round(f0 * flops_row / peak, 4)
+        featurize_rate = float(
+            pallas_forest_stats["featurize_only_rows_per_s"])
+    else:
+        _progress("featurize-only roofline")
+        try:
+            feat_rows = min(best_rows, 4096 if (on_cpu or args.quick)
+                            else 262_144)
+
+            def _feat_only(fstate, batch):
+                fstate, feats = update_and_featurize(fstate, batch, fcfg)
+                return fstate, feats.sum()
+
+            jfeat = jax.jit(_feat_only, donate_argnums=(0,))
+            fbatch = jax.tree.map(
+                jnp.asarray, make_batch(**_make_batch_cols(rng, feat_rows)))
+            ffs = init_feature_state(fcfg)
+            ffs, fsum = jfeat(ffs, fbatch)
+            jax.block_until_ready(fsum)
+
+            def _feat_once():
+                nonlocal ffs
+                ffs, fsum = jfeat(ffs, fbatch)
+                return fsum
+
+            featurize_rate = _timed_rows_per_s(
+                _feat_once, feat_rows, min(args.seconds, 2.0))
+        except Exception as e:
+            _progress(f"featurize-only failed: {type(e).__name__}: "
+                      f"{str(e)[:120]}")
+    if featurize_rate and peak > 0:
+        mfu_ceiling = round(featurize_rate * flops_row / peak, 4)
         if mfu_ceiling > 0:
             mfu_of_ceiling = round(mfu / mfu_ceiling, 3)
+    if isinstance(device_plane, dict) and peak > 0:
+        # per-arm MFU annotation: the engine-level A/B reads as
+        # mfu_of_ceiling before/after, not just rows/s
+        device_plane["mfu_ceiling"] = mfu_ceiling
+        for arm in device_plane.values():
+            if isinstance(arm, dict) and "rows_per_s" in arm:
+                arm_mfu = arm["rows_per_s"] * flops_row / peak
+                arm["mfu"] = round(arm_mfu, 4)
+                if mfu_ceiling:
+                    arm["mfu_of_ceiling"] = round(arm_mfu / mfu_ceiling, 3)
 
     # ---- CPU sklearn baseline (the reference-equivalent predict_proba) --
     # Measured at the headline batch size, capped at 65,536 rows per call
@@ -1364,6 +1455,7 @@ def _child_main(args) -> None:
         "mfu": round(mfu, 4),
         "mfu_ceiling": mfu_ceiling,
         "mfu_of_ceiling": mfu_of_ceiling,
+        "headline_z_mode": headline_z_mode,
         "model_flops_per_row": flops_row,
         "peak_flops_assumed": peak,
         "device": str(dev),
@@ -1382,8 +1474,11 @@ def _child_main(args) -> None:
         # data plane off vs on (parallel decode + prefetch + overlapped
         # fetch), same run protocol — the host-gap before/after
         detail["host_plane"] = host_plane
-    if z_stats is not None:
-        detail["z_mode"] = z_stats
+    if device_plane is not None:
+        # serving-engine z_mode {f32,int8} × fused-step {off,on} A/B
+        # under precompile, exactness asserted from served probs — the
+        # engine-level successor of the round-4 z-mode microbench
+        detail["device_plane"] = device_plane
     if train_stats is not None:
         detail["train"] = train_stats
     if pallas_stats is not None:

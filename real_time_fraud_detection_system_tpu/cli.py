@@ -517,6 +517,7 @@ def cmd_score(args) -> int:
         pipeline_depth=args.pipeline_depth,
         coalesce_rows=args.coalesce_rows,
         use_pallas=args.use_pallas,
+        z_mode=args.z_mode,
         precompile=args.precompile,
         # an SLO implies the controller: the knob is the intent
         autobatch=args.autobatch or args.latency_slo_ms > 0,
@@ -575,6 +576,13 @@ def cmd_score(args) -> int:
 
     log.info("ingest decode workers: %d",
              native.set_decode_workers(args.decode_workers))
+    if model.kind in ("tree", "forest", "gbt"):
+        from real_time_fraud_detection_system_tpu.models.forest import (
+            resolve_z_mode,
+        )
+
+        log.info("device plane: z_mode=%s (requested %r), use_pallas=%s",
+                 resolve_z_mode(args.z_mode), args.z_mode, args.use_pallas)
     cpu_model = None
     if args.scorer == "cpu":
         cpu_model = model  # TrainedModel.predict_proba runs host-side numpy
@@ -1021,6 +1029,7 @@ def cmd_warmup(args) -> int:
         emit_threshold=args.emit_threshold,
         emit_dtype="bfloat16" if args.emit_bf16 else "float32",
         use_pallas=args.use_pallas,
+        z_mode=args.z_mode,
         precompile=True,
     ))
     t0 = _time.perf_counter()
@@ -2089,8 +2098,16 @@ def main(argv=None) -> int:
                         "loop thread")
     p.add_argument("--use-pallas", action="store_true",
                    help="serve with the fused Pallas kernels where "
-                        "available (tree/forest/gbt leaf-sum; logreg "
-                        "featurize+score) instead of the XLA composition")
+                        "available (tree/forest fused featurize+score, "
+                        "gbt leaf-sum, logreg featurize+score) instead "
+                        "of the XLA composition")
+    p.add_argument("--z-mode", default="auto",
+                   choices=["auto", "f32", "bf16", "int8"],
+                   help="tree-ensemble z-contraction arithmetic on the "
+                        "MXU (auto = int8 on TPU, f32 elsewhere); every "
+                        "mode is decision-identical by the exactness "
+                        "contract — int8 is additionally bit-identical "
+                        "to f32 (README § Device plane)")
     p.add_argument("--emit-threshold", type=float, default=0.0,
                    help="selective emission: transfer + persist the 15 "
                         "feature columns only for rows whose fraud "
@@ -2244,6 +2261,10 @@ def main(argv=None) -> int:
                    help="match the serving flag")
     p.add_argument("--use-pallas", action="store_true",
                    help="match the serving flag")
+    p.add_argument("--z-mode", default="auto",
+                   choices=["auto", "f32", "bf16", "int8"],
+                   help="match the serving flag (the z-contraction mode "
+                        "is part of the compiled step)")
     p.set_defaults(fn=cmd_warmup)
 
     p = sub.add_parser(

@@ -158,14 +158,25 @@ class RuntimeConfig:
     ``fraud_detection.py:208``)."""
 
     scorer: str = "tpu"  # cpu | tpu
-    # Fused Pallas featurize+score kernel (linear scorer only;
-    # ops/pallas_kernels.py). Interpreted (slow, exact) off-TPU.
-    # Stays opt-in by measurement, not neglect: on a real v5e the fused
-    # kernel and the plain-jnp composition are within ±2% (bench detail
-    # `pallas_fused`, 2026-07-30: 2.94M vs 2.91M rows/s, max|Δ| 2.4e-7)
-    # — XLA's automatic fusion already captures the win, so the hand
-    # kernel buys nothing on the default path.
+    # Fused Pallas featurize+score kernels (ops/pallas_kernels.py for the
+    # linear scorer, ops/pallas_forest.py::fused_forest_leaf_sum for tree
+    # ensembles). Interpreted (slow, exact) off-TPU.
+    # Stays opt-in by measurement, not neglect: on a real v5e the linear
+    # fused kernel and the plain-jnp composition are within ±2% (bench
+    # detail `pallas_fused`, 2026-07-30: 2.94M vs 2.91M rows/s,
+    # max|Δ| 2.4e-7) — XLA's automatic fusion already captures the win
+    # there. The forest fused step attacks the scatter boundary XLA
+    # cannot fuse through; its A/B lives in bench detail `device_plane`.
     use_pallas: bool = False
+    # MXU arithmetic for the tree-ensemble z contraction
+    # (models/forest.py::gemm_leaf_sum — the dominant classify matmul,
+    # exact in EVERY mode because its operands are tiny integers):
+    # "auto" = int8 on TPU (2× bf16 MXU peak on v5e, measured bit-exact
+    # vs f32 — bench detail z_mode/device_plane), f32 elsewhere (the
+    # only float mode CPU XLA lowers natively). Forced "int8"/"bf16"/
+    # "f32" pin the mode on any backend; decisions are identical by the
+    # exactness contract (README § Device plane).
+    z_mode: str = "auto"
     trigger_seconds: float = 0.0  # 0 => score as fast as batches arrive
     # Max micro-batches in flight on the device at once (the engine's
     # software pipeline). 2 = classic double-buffering (batch N+1's host
@@ -296,6 +307,13 @@ class RuntimeConfig:
     # doubling, capped; 0 = the legacy hot restart loop). Stall restarts
     # never back off — they already waited out the stall budget.
     restart_backoff_ms: float = 0.0
+
+    def __post_init__(self):
+        if self.z_mode not in ("auto", "f32", "bf16", "int8"):
+            raise ValueError(
+                f"z_mode must be 'auto', 'f32', 'bf16' or 'int8', "
+                f"got {self.z_mode!r}"
+            )
 
 
 @dataclass(frozen=True)

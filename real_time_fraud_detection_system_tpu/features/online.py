@@ -203,6 +203,53 @@ def update_and_score_pallas(
     return state, probs, feats
 
 
+def update_and_score_pallas_forest(
+    state: FeatureState,
+    batch: TxBatch,
+    cfg: FeatureConfig,
+    scaler_mean: jnp.ndarray,
+    scaler_scale: jnp.ndarray,
+    pf,  # ops.pallas_forest.PallasForest (tables in the serving z_mode)
+    interpret: Optional[bool] = None,
+) -> Tuple[FeatureState, jnp.ndarray, jnp.ndarray]:
+    """Scatter-update state, then run the fused forest featurize→score
+    kernel (``ops/pallas_forest.py::fused_forest_leaf_sum``) on the
+    gathered state rows.
+
+    Returns (new_state, leaf_sum [B], features [B, 15]) — the
+    tree-ensemble equivalent of :func:`update_and_featurize` + scale +
+    ``gemm_leaf_sum`` with the feature block VMEM-resident end-to-end
+    (the scatter/gather boundary XLA cannot fuse through stays in XLA,
+    whose TPU gather emitter wins). The caller divides by ``pf.n_trees``
+    (bagging) or adds the base logit (boosting) and masks invalid rows.
+    """
+    from real_time_fraud_detection_system_tpu.ops.pallas_forest import (
+        fused_forest_leaf_sum,
+    )
+    from real_time_fraud_detection_system_tpu.ops.windows import (
+        gather_state_rows,
+    )
+
+    state, cust_slot, term_slot = _update_state(state, batch, cfg)
+    c_bd, c_cnt, c_amt, _ = gather_state_rows(state.customer, cust_slot)
+    t_bd, t_cnt, _, t_frd = gather_state_rows(state.terminal, term_slot)
+    leaf_sum, feats = fused_forest_leaf_sum(
+        pf,
+        (c_bd, c_cnt, c_amt),
+        (t_bd, t_cnt, t_frd),
+        batch.day,
+        batch.tod_s,
+        batch.amount,
+        scaler_mean, scaler_scale,
+        windows=tuple(cfg.windows),
+        delay=cfg.delay_days,
+        weekend_start=cfg.weekend_start_weekday,
+        night_end=cfg.night_end_hour,
+        interpret=interpret,
+    )
+    return state, leaf_sum, feats
+
+
 def apply_feedback(
     state: FeatureState,
     terminal_key: jnp.ndarray,  # uint32 [B]

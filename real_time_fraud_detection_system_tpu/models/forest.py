@@ -252,6 +252,23 @@ def to_gemm(ens: TreeEnsemble, n_features: int) -> GemmEnsemble:
     )
 
 
+def resolve_z_mode(mode: str | None) -> str:
+    """``RuntimeConfig.z_mode`` → a concrete :func:`gemm_leaf_sum` mode.
+
+    ``"auto"`` (and None) picks int8 on TPU — the measured MXU winner
+    (bench ``detail.z_mode``: int8 peaks ~2× bf16 on v5e with
+    ``max_abs_delta_int8_vs_f32 == 0``) — and f32 elsewhere (the only
+    float mode CPU XLA lowers natively). Every mode is decision-exact by
+    the contract documented on :func:`gemm_leaf_sum`; int8 is
+    additionally BIT-identical to f32 (integer z arithmetic, same
+    onehot, same f32-HIGHEST leaf contraction)."""
+    if mode is None or mode == "auto":
+        return "int8" if jax.default_backend() == "tpu" else "f32"
+    if mode not in ("f32", "bf16", "int8"):
+        raise ValueError(f"unknown z_mode {mode!r}")
+    return mode
+
+
 def gemm_leaf_sum(
     g: GemmEnsemble, x: jnp.ndarray, z_mode: str | None = None
 ) -> jnp.ndarray:
@@ -313,17 +330,20 @@ def gemm_predict_proba(
     return gemm_leaf_sum(g, x, z_mode) / g.n_trees
 
 
-def predict_proba(params, x: jnp.ndarray) -> jnp.ndarray:
+def predict_proba(
+    params, x: jnp.ndarray, z_mode: str | None = None
+) -> jnp.ndarray:
     """Unified forest scorer: dispatches on the ensemble form.
 
     The GEMM form is ~100× faster than the gather-based descent on TPU
     (measured on v5e: 3.2M vs 31k rows/s at B=32k, T=100, depth 8) because
     XLA lowers [B, T]-indexed table gathers to a slow serial path while the
     three contractions tile straight onto the MXU. Both are decision-exact
-    vs sklearn on f32 inputs.
+    vs sklearn on f32 inputs. ``z_mode`` selects the GEMM form's z
+    arithmetic (the descent form has no contraction and ignores it).
     """
     if isinstance(params, GemmEnsemble):
-        return gemm_predict_proba(params, x)
+        return gemm_predict_proba(params, x, z_mode)
     return ensemble_predict_proba(params, x)
 
 

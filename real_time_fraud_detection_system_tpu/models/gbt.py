@@ -39,9 +39,11 @@ class GBTModel(NamedTuple):
     base_score: jnp.ndarray  # float32 [] — initial logit
 
 
-def gbt_predict_proba(model: GBTModel, x: jnp.ndarray) -> jnp.ndarray:
+def gbt_predict_proba(
+    model: GBTModel, x: jnp.ndarray, z_mode: str | None = None
+) -> jnp.ndarray:
     if isinstance(model.trees, GemmEnsemble):
-        raw = gemm_leaf_sum(model.trees, x)
+        raw = gemm_leaf_sum(model.trees, x, z_mode)
     else:
         raw = jnp.sum(ensemble_leaf_values(model.trees, x), axis=1)
     return jax.nn.sigmoid(model.base_score + raw)

@@ -1,42 +1,70 @@
-"""Fused Pallas kernel for tree-ensemble (GEMM-form) inference.
+"""Fused Pallas kernels for tree-ensemble (GEMM-form) inference.
 
-Runs the whole per-tree chain of ``models/forest.py::gemm_leaf_sum``
+Two kernels share one tree-block traversal core:
+
+**1. Classify-only** (:func:`pallas_leaf_sum`) — the per-tree chain of
+``models/forest.py::gemm_leaf_sum``
 
     proj = x @ sel[t]   (f32, HIGHEST — decision-exact, see forest.py)
-    d    = proj <= thresh[t]          (bf16: 0/1, exact)
-    z    = d @ path[t]                (bf16×bf16→f32 MXU, exact: |z| ≤ depth)
-    acc += Σ_l leaf_val[t] where |z − target[t]| < 0.5
+    d    = proj <= thresh[t]          (0/1, exact in every z dtype)
+    z    = d @ path[t]                (MXU; exact: |z| ≤ depth)
+    acc += Σ_l leaf_val[t] where z matches target[t]
 
 inside VMEM, tiling rows on the grid's first axis and streaming tree blocks
 on the second; only ``x`` (60 B/row) is read from and the leaf-sum (4 B/row)
-written to HBM.  Covers the role of the reference's sklearn
-``model.predict_proba`` inside ``scale_and_predict_udf``
-(``pyspark/scripts/fraud_detection.py:183-195``).
+written to HBM.
 
-**Measured verdict (v5e, round 4): XLA wins.** At the flagship point
-(T=100, depth 8) the plain XLA composition runs 10.7M rows/s classify-only
-at 1M-row batches vs 6.6M for this kernel (8.0M vs 5.7M at 262k) — XLA's
-automatic fusion of the three contractions is already intermediate-free and
-schedules the VPU-bound compare/select chain better than the hand-rolled
-tree loop.  The kernel therefore stays an **opt-in**
-(``RuntimeConfig.use_pallas``) proof of hand-fusibility and a template for
-deeper fusions — the same conclusion as the logreg featurize+score kernel
-(``ops/pallas_kernels.py``), now established for the flagship model, with
-the measurement recorded in ``bench.py`` (``detail.pallas_forest``).
+**Measured verdict (v5e, round 4): XLA wins classify-only.** At the
+flagship point (T=100, depth 8) the plain XLA composition runs 10.7M
+rows/s at 1M-row batches vs 6.6M for this kernel (8.0M vs 5.7M at 262k) —
+XLA's automatic fusion of the three contractions is already
+intermediate-free and schedules the VPU-bound compare/select chain better
+than the hand-rolled tree loop.
 
-Numerics match ``gemm_leaf_sum``'s documented mixed-precision contract: every
-branch decision is bit-identical to sklearn on f32 inputs (proj in f32
-HIGHEST against f32-rounded-down thresholds), the z counts are small exact
-integers in bf16, and only the final f32 accumulation order differs (per-tree
-sequential here) — a ≤1-ulp-scale difference on the bagged mean.
+**2. Fused featurize→score** (:func:`fused_forest_leaf_sum`, round 9) —
+the round-4 loss localized the remaining fusion win PAST the classify
+chain: XLA cannot fuse through the window-update scatter/gather boundary
+(``ops/windows.py``), so the feature block round-trips HBM between
+featurization and the classifier. This kernel starts from the GATHERED
+state rows (the gather stays in XLA, whose TPU gather emitter wins — same
+split as ``ops/pallas_kernels.py``) and keeps the feature block
+VMEM-resident end-to-end: window aggregates → 15-feature assembly
+(``pallas_kernels.assemble_features``) → standardize → tree traversal, one
+pass per row tile, the scaled feature block living in a VMEM scratch
+across the streamed tree blocks. Covers the reference's enrichment SQL +
+feature join + ``scale_and_predict_udf``
+(``pyspark/scripts/fraud_detection.py:100-132,183-195``) for the flagship
+RandomForest.
 
-On non-TPU backends the kernel runs in interpreter mode (slow, exact) so CPU
-tests validate the identical code path the TPU compiles.
+**Measured verdict (round 9): no TPU attached this round** — the sandbox
+served CPU only, so the honest A/B (engine-level ``detail.device_plane``
+in bench.py: z_mode off/on × fused off/on with ``mfu_of_ceiling``
+before/after) is wired and runs automatically on the next TPU session;
+interpret-mode parity vs the unfused jit composition (same rows, all
+buckets) is pinned in ``tests/test_pallas_forest.py``. The kernel stays
+**opt-in** (``RuntimeConfig.use_pallas``) until a TPU measurement says
+otherwise — the same honest-A/B culture as the round-4 classify verdict
+above.
+
+Both kernels honor the serving ``z_mode`` (``RuntimeConfig.z_mode``): the
+table layout (:func:`to_pallas`) carries ``path`` in the z dtype — int8
+(int8×int8→int32 MXU, 2× bf16 peak on v5e, bit-exact: operands are tiny
+integers), bf16 (exact: integers ≪ 2^8), or f32 — and the traversal core
+picks the matching arithmetic. Numerics match ``gemm_leaf_sum``'s
+documented mixed-precision contract: every branch decision is
+bit-identical to sklearn on f32 inputs (proj in f32 HIGHEST against
+f32-rounded-down thresholds), and only the final f32 accumulation order
+differs (per-tree sequential here) — a ≤1-ulp-scale difference on the
+bagged mean.
+
+On non-TPU backends the kernels run in interpreter mode (slow, exact) so
+CPU tests validate the identical code path the TPU compiles.
 """
 
 from __future__ import annotations
 
-from typing import TYPE_CHECKING, NamedTuple
+import functools
+from typing import TYPE_CHECKING, NamedTuple, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -49,7 +77,10 @@ if TYPE_CHECKING:  # type-only: models.forest imports would cycle through
     )
 
 
-from real_time_fraud_detection_system_tpu.ops.pallas_kernels import _on_tpu
+from real_time_fraud_detection_system_tpu.ops.pallas_kernels import (
+    _on_tpu,
+    assemble_features,
+)
 
 
 def _ceil_to(n: int, m: int) -> int:
@@ -59,6 +90,11 @@ def _ceil_to(n: int, m: int) -> int:
 # Trees per grid step: amortizes per-step grid/DMA overhead while keeping the
 # double-buffered table blocks (2 × TT·Ip·Lp bf16) small next to ~16MB VMEM.
 TREE_BLOCK = 10
+
+
+# Bytes per path-matrix element, by z_mode (see to_pallas).
+_Z_DTYPES = {"bf16": jnp.bfloat16, "int8": jnp.int8, "f32": jnp.float32}
+_Z_BYTES = {"bf16": 2, "int8": 1, "f32": 4}
 
 
 class PallasForest(NamedTuple):
@@ -73,13 +109,13 @@ class PallasForest(NamedTuple):
 
     sel: jnp.ndarray  # f32 [Tp, Fp, Ip] one-hot feature selector
     thresh: jnp.ndarray  # f32 [Tp, 1, Ip] (+inf padding)
-    path: jnp.ndarray  # bf16 [Tp, Ip, Lp] ±1/0 requirement matrix
+    path: jnp.ndarray  # z-dtype [Tp, Ip, Lp] ±1/0 requirement matrix
     target: jnp.ndarray  # f32 [Tp, 1, Lp] (#left-required; 1e9 padding)
     leaf_val: jnp.ndarray  # f32 [Tp, 1, Lp]
     n_trees: int  # REAL tree count (bagging divisor); static
 
 
-def to_pallas(g: GemmEnsemble) -> PallasForest:
+def to_pallas(g: GemmEnsemble, z_mode: str = "bf16") -> PallasForest:
     """Pad a compiled ``GemmEnsemble`` into the kernel's tile layout.
 
     Pure jnp pads, so it runs eagerly (one-time conversion) AND inside a
@@ -87,6 +123,10 @@ def to_pallas(g: GemmEnsemble) -> PallasForest:
     step (a few µs of pad writes next to ms of batch work), which keeps a
     checkpoint restore that overwrites ``state.params`` in-place serving
     the restored trees, never stale build-time copies.
+
+    ``z_mode`` picks the ``path`` dtype — and with it the traversal
+    core's z arithmetic (exact in every mode: path is ±1/0, d is 0/1,
+    z counts ≤ depth; see ``models/forest.py::gemm_leaf_sum``).
     """
     t, f, i = g.sel.shape
     l = g.path.shape[2]
@@ -99,7 +139,7 @@ def to_pallas(g: GemmEnsemble) -> PallasForest:
         thresh=jnp.pad(g.thresh, ((0, tp - t), (0, ip - i)),
                        constant_values=jnp.inf)[:, None, :],
         path=jnp.pad(g.path, ((0, tp - t), (0, ip - i), (0, lp - l))
-                     ).astype(jnp.bfloat16),
+                     ).astype(_Z_DTYPES[z_mode]),
         target=jnp.pad(g.target, ((0, tp - t), (0, lp - l)),
                        constant_values=1e9)[:, None, :],
         leaf_val=jnp.pad(g.leaf_val, ((0, tp - t), (0, lp - l)))[:, None, :],
@@ -107,16 +147,17 @@ def to_pallas(g: GemmEnsemble) -> PallasForest:
     )
 
 
-def pallas_table_bytes(g: GemmEnsemble) -> int:
+def pallas_table_bytes(g: GemmEnsemble, z_mode: str = "bf16") -> int:
     """TOTAL padded table footprint (HBM-resident; diagnostics)."""
     t = g.sel.shape[0]
-    return (_ceil_to(int(t), TREE_BLOCK) // TREE_BLOCK) * pallas_block_bytes(g)
+    blocks = _ceil_to(int(t), TREE_BLOCK) // TREE_BLOCK
+    return blocks * pallas_block_bytes(g, z_mode)
 
 
-def pallas_block_bytes(g: GemmEnsemble) -> int:
+def pallas_block_bytes(g: GemmEnsemble, z_mode: str = "bf16") -> int:
     """Padded table bytes of ONE tree block — the VMEM-residency gate.
 
-    The kernel streams (TREE_BLOCK, …) table blocks through VMEM (double-
+    The kernels stream (TREE_BLOCK, …) table blocks through VMEM (double-
     buffered), so per-step residency scales with the BLOCK, not the whole
     ensemble: T=100 depth-8 totals ~14 MB of tables in HBM but only
     ~1.5 MB/block in flight.
@@ -124,14 +165,58 @@ def pallas_block_bytes(g: GemmEnsemble) -> int:
     f, i = g.sel.shape[1:]
     l = g.path.shape[2]
     fp, ip, lp = _ceil_to(int(f), 8), _ceil_to(int(i), 128), _ceil_to(int(l), 128)
-    return TREE_BLOCK * (fp * ip * 4 + ip * lp * 2 + lp * 8 + ip * 4)
+    return TREE_BLOCK * (
+        fp * ip * 4 + ip * lp * _Z_BYTES[z_mode] + lp * 8 + ip * 4)
+
+
+def _tree_block_leaf_sum(
+    x,  # f32 [Bt, Fp] scaled feature tile (VMEM-resident)
+    sel_ref,  # f32 [TT, Fp, Ip]
+    thresh_ref,  # f32 [TT, 1, Ip]
+    path_ref,  # z-dtype [TT, Ip, Lp]
+    target_ref,  # f32 [TT, 1, Lp]
+    leaf_ref,  # f32 [TT, 1, Lp]
+    tree_block: int,
+):
+    """One tree block's leaf-sum contribution [Bt, 1] — the traversal
+    core shared by the classify-only and fused featurize→score kernels.
+    The z arithmetic follows ``path_ref``'s dtype (see ``to_pallas``):
+    int8×int8→int32 on the MXU's int8 path, or bf16/f32×→f32."""
+    hi = jax.lax.Precision.HIGHEST
+    int8_z = path_ref.dtype == jnp.int8
+
+    # Rolled loop, not a static unroll: one set of [Bt, Ip/Lp] intermediate
+    # buffers is reused across the block's trees (an unroll keeps all
+    # tree_block sets live at once — measured 17MB of scoped VMEM at
+    # Bt=2048·TT=10, over the 16MB limit).
+    def body(k, acc):
+        proj = jnp.dot(x, sel_ref[k], precision=hi)  # [Bt, Ip] f32
+        d = (proj <= thresh_ref[k]).astype(path_ref.dtype)
+        if int8_z:
+            # exact integer counts; target compares exactly in int32
+            # (the 1e9 leaf padding is representable and never matched)
+            z = jnp.dot(d, path_ref[k],
+                        preferred_element_type=jnp.int32)
+            matched = z == target_ref[k].astype(jnp.int32)
+        else:
+            z = jnp.dot(d, path_ref[k],
+                        preferred_element_type=jnp.float32)
+            matched = jnp.abs(z - target_ref[k]) < 0.5
+        # single fused select→reduce pass (VPU-bound chain: one traversal
+        # of [Bt, Lp] instead of onehot-cast + mul + reduce)
+        contrib = jnp.sum(
+            jnp.where(matched, leaf_ref[k], 0.0), axis=1, keepdims=True)
+        return acc + contrib
+
+    acc0 = jnp.zeros((x.shape[0], 1), jnp.float32)
+    return jax.lax.fori_loop(0, tree_block, body, acc0)
 
 
 def _leaf_sum_kernel(
     x_ref,  # f32 [Bt, Fp]
     sel_ref,  # f32 [TT, Fp, Ip]
     thresh_ref,  # f32 [TT, 1, Ip]
-    path_ref,  # bf16 [TT, Ip, Lp]
+    path_ref,  # z-dtype [TT, Ip, Lp]
     target_ref,  # f32 [TT, 1, Lp]
     leaf_ref,  # f32 [TT, 1, Lp]
     out_ref,  # f32 [Bt, 1]
@@ -142,26 +227,9 @@ def _leaf_sum_kernel(
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    x = x_ref[:]
-    hi = jax.lax.Precision.HIGHEST
-
-    # Rolled loop, not a static unroll: one set of [Bt, Ip/Lp] intermediate
-    # buffers is reused across the block's trees (an unroll keeps all
-    # tree_block sets live at once — measured 17MB of scoped VMEM at
-    # Bt=2048·TT=10, over the 16MB limit).
-    def body(k, acc):
-        proj = jnp.dot(x, sel_ref[k], precision=hi)  # [Bt, Ip] f32
-        d = (proj <= thresh_ref[k]).astype(jnp.bfloat16)
-        z = jnp.dot(d, path_ref[k], preferred_element_type=jnp.float32)
-        # single fused select→reduce pass (VPU-bound chain: one traversal
-        # of [Bt, Lp] instead of onehot-cast + mul + reduce)
-        contrib = jnp.sum(
-            jnp.where(jnp.abs(z - target_ref[k]) < 0.5, leaf_ref[k], 0.0),
-            axis=1, keepdims=True)
-        return acc + contrib
-
-    acc0 = jnp.zeros((x.shape[0], 1), jnp.float32)
-    out_ref[:] += jax.lax.fori_loop(0, tree_block, body, acc0)
+    out_ref[:] += _tree_block_leaf_sum(
+        x_ref[:], sel_ref, thresh_ref, path_ref, target_ref, leaf_ref,
+        tree_block)
 
 
 def pallas_leaf_sum(
@@ -214,3 +282,170 @@ def pallas_predict_proba(
 ) -> jnp.ndarray:
     """[B, F] → fraud probability [B] (bagging mean over real trees)."""
     return pallas_leaf_sum(pf, x, **kw) / pf.n_trees
+
+
+# -- fused featurize→score step (round 9) -----------------------------------
+
+
+def _fused_forest_kernel(
+    c_bd_ref,  # int32 [Bt, NB] customer bucket days
+    c_cnt_ref,  # f32 [Bt, NB]
+    c_amt_ref,  # f32 [Bt, NB]
+    t_bd_ref,  # int32 [Bt, NB] terminal bucket days
+    t_cnt_ref,  # f32 [Bt, NB]
+    t_frd_ref,  # f32 [Bt, NB]
+    ivec_ref,  # int32 [Bt, 2] (day, tod_s)
+    avec_ref,  # f32 [Bt, 1] (amount)
+    svec_ref,  # f32 [2, Fp] rows: (mean, scale); pads (0, 1) are inert
+    sel_ref,  # f32 [TT, Fp, Ip]
+    thresh_ref,  # f32 [TT, 1, Ip]
+    path_ref,  # z-dtype [TT, Ip, Lp]
+    target_ref,  # f32 [TT, 1, Lp]
+    leaf_ref,  # f32 [TT, 1, Lp]
+    out_ref,  # f32 [Bt, 1] leaf sum out
+    feats_ref,  # f32 [Bt, F] raw features out
+    x_ref,  # VMEM scratch f32 [Bt, Fp] — scaled features, lives across
+    #         the tree-block grid axis (allocated once per core)
+    *,
+    windows: Tuple[int, ...],
+    delay: int,
+    weekend_start: int,
+    night_end: int,
+    tree_block: int,
+    n_feat: int,
+):
+    @pl.when(pl.program_id(1) == 0)
+    def _featurize():
+        # First tree block of this row tile: window aggregates → feature
+        # assembly → standardize, all in VMEM. Later tree blocks reuse
+        # the scaled block from scratch — the feature matrix never
+        # round-trips HBM between featurization and the traversal (the
+        # raw features are still written out once for the host plane).
+        day = ivec_ref[:, 0:1]
+        tod = ivec_ref[:, 1:2]
+        amount = avec_ref[:, 0:1]
+        feats = assemble_features(
+            c_bd_ref[:], c_cnt_ref[:], c_amt_ref[:],
+            t_bd_ref[:], t_cnt_ref[:], t_frd_ref[:],
+            day, tod, amount,
+            windows=windows, delay=delay, weekend_start=weekend_start,
+            night_end=night_end,
+        )
+        feats_ref[:] = feats
+        mean = svec_ref[0:1, :]
+        scale = svec_ref[1:2, :]
+        fp = x_ref.shape[1]
+        if fp > n_feat:  # feature-lane padding: scaled pads are exactly 0
+            feats = jnp.concatenate(
+                [feats, jnp.zeros((feats.shape[0], fp - n_feat),
+                                  jnp.float32)], axis=1)
+        x_ref[:] = (feats - mean) / scale
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    out_ref[:] += _tree_block_leaf_sum(
+        x_ref[:], sel_ref, thresh_ref, path_ref, target_ref, leaf_ref,
+        tree_block)
+
+
+def fused_forest_leaf_sum(
+    pf: PallasForest,
+    c_rows: Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],  # (bd, cnt, amt)
+    t_rows: Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],  # (bd, cnt, frd)
+    day: jnp.ndarray,  # int32 [B]
+    tod_s: jnp.ndarray,  # int32 [B]
+    amount: jnp.ndarray,  # f32 [B]
+    scaler_mean: jnp.ndarray,  # f32 [F]
+    scaler_scale: jnp.ndarray,  # f32 [F]
+    windows: Sequence[int] = (1, 7, 30),
+    delay: int = 7,
+    weekend_start: int = 5,
+    night_end: int = 6,
+    block_rows: int = 1024,
+    interpret: bool | None = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Gathered state rows → (Σ_t leaf value [B], raw features [B, F]).
+
+    The fused featurize→score step: one kernel pass per row tile keeps
+    the (scaled) feature block VMEM-resident from window read-out through
+    the tree traversal, streaming tree blocks on the grid's second axis
+    exactly like :func:`pallas_leaf_sum` — including its row-padding
+    scheme, so any batch size works (padded rows read zeroed state rows,
+    score garbage, and are sliced off).
+    """
+    c_bd, c_cnt, c_amt = c_rows
+    t_bd, t_cnt, t_frd = t_rows
+    bsz, nb = c_bd.shape
+    tp, fp, ip = pf.sel.shape
+    lp = pf.path.shape[2]
+    tt = TREE_BLOCK
+    n_feat = int(scaler_mean.shape[0])
+    # Split bsz over the fewest blocks of ≤ block_rows, each the smallest
+    # ×8 size that covers its share (same scheme as pallas_leaf_sum).
+    nblk = max(1, -(-bsz // block_rows))
+    bt = _ceil_to(-(-bsz // nblk), 8)
+    bp = nblk * bt
+    if bp != bsz:
+        pad_rows = ((0, bp - bsz), (0, 0))
+        c_bd = jnp.pad(c_bd, pad_rows)
+        c_cnt = jnp.pad(c_cnt, pad_rows)
+        c_amt = jnp.pad(c_amt, pad_rows)
+        t_bd = jnp.pad(t_bd, pad_rows)
+        t_cnt = jnp.pad(t_cnt, pad_rows)
+        t_frd = jnp.pad(t_frd, pad_rows)
+        pad_flat = (0, bp - bsz)
+        day = jnp.pad(day, pad_flat)
+        tod_s = jnp.pad(tod_s, pad_flat)
+        amount = jnp.pad(amount, pad_flat)
+    grid = (nblk, tp // tt)
+    if interpret is None:
+        interpret = not _on_tpu()
+
+    ivec = jnp.stack([day.astype(jnp.int32), tod_s.astype(jnp.int32)],
+                     axis=1)
+    avec = amount.astype(jnp.float32)[:, None]
+    # (mean, scale) padded to the kernel's feature lanes; pad cols carry
+    # (0, 1) so padded features standardize to exactly 0 (and the padded
+    # sel rows are all-zero anyway — doubly inert).
+    svec = jnp.stack([
+        jnp.pad(scaler_mean.astype(jnp.float32), (0, fp - n_feat)),
+        jnp.pad(scaler_scale.astype(jnp.float32), (0, fp - n_feat),
+                constant_values=1.0),
+    ], axis=0)
+
+    row_spec = lambda width: pl.BlockSpec(  # noqa: E731
+        (bt, width), lambda i, t: (i, 0), memory_space=pltpu.VMEM,
+    )
+    table = lambda *dims: pl.BlockSpec(  # noqa: E731
+        (tt, *dims), lambda i, t: (t, 0, 0), memory_space=pltpu.VMEM,
+    )
+    kernel = functools.partial(
+        _fused_forest_kernel,
+        windows=tuple(windows),
+        delay=delay,
+        weekend_start=weekend_start,
+        night_end=night_end,
+        tree_block=tt,
+        n_feat=n_feat,
+    )
+    leaf, feats = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            row_spec(nb), row_spec(nb), row_spec(nb),
+            row_spec(nb), row_spec(nb), row_spec(nb),
+            row_spec(2), row_spec(1),
+            pl.BlockSpec((2, fp), lambda i, t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            table(fp, ip), table(1, ip), table(ip, lp),
+            table(1, lp), table(1, lp),
+        ],
+        out_specs=(row_spec(1), row_spec(n_feat)),
+        out_shape=(
+            jax.ShapeDtypeStruct((bp, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bp, n_feat), jnp.float32),
+        ),
+        scratch_shapes=[pltpu.VMEM((bt, fp), jnp.float32)],
+        interpret=interpret,
+    )(c_bd, c_cnt, c_amt, t_bd, t_cnt, t_frd, ivec, avec, svec,
+      pf.sel, pf.thresh, pf.path, pf.target, pf.leaf_val)
+    return leaf[:bsz, 0], feats[:bsz]

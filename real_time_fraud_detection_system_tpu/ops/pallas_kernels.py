@@ -41,6 +41,49 @@ def _on_tpu() -> bool:
         return False
 
 
+def assemble_features(
+    c_bd, c_cnt, c_amt,  # [Bt, NB] customer rows (bucket_day, count, amount)
+    t_bd, t_cnt, t_frd,  # [Bt, NB] terminal rows (bucket_day, count, fraud)
+    day, tod, amount,  # [Bt, 1] per-row scalars (int32, int32, f32)
+    *,
+    windows: Tuple[int, ...],
+    delay: int,
+    weekend_start: int,
+    night_end: int,
+) -> jnp.ndarray:
+    """Gathered state rows → raw [Bt, F] feature block (age-mask form).
+
+    The in-kernel twin of ``ops/windows.py::query_gathered`` +
+    ``features/online.py::_flags`` + column stack — pure VPU math
+    (compares, selects, lane reductions over the NB axis), shared by the
+    linear fused kernel below and the forest fused step
+    (``ops/pallas_forest.py``). Feature order matches
+    ``features/spec.py::FEATURE_NAMES``."""
+    age_c = day - c_bd  # [Bt, NB]
+    live_c = (c_bd >= 0) & (age_c >= 0)
+    age_t = day - delay - t_bd
+    live_t = (t_bd >= 0) & (age_t >= 0)
+
+    cols = [amount]
+    # flags
+    weekday = jnp.remainder(day + 3, 7)
+    cols.append((weekday >= weekend_start).astype(jnp.float32))
+    cols.append((tod // 3600 <= night_end).astype(jnp.float32))
+    for w in windows:
+        sel = jnp.where(live_c & (age_c < w), 1.0, 0.0)
+        cnt = jnp.sum(c_cnt * sel, axis=1, keepdims=True)
+        amt = jnp.sum(c_amt * sel, axis=1, keepdims=True)
+        cols.append(cnt)
+        cols.append(jnp.where(cnt > 0, amt / jnp.maximum(cnt, 1.0), 0.0))
+    for w in windows:
+        sel = jnp.where(live_t & (age_t < w), 1.0, 0.0)
+        cnt = jnp.sum(t_cnt * sel, axis=1, keepdims=True)
+        frd = jnp.sum(t_frd * sel, axis=1, keepdims=True)
+        cols.append(cnt)
+        cols.append(jnp.where(cnt > 0, frd / jnp.maximum(cnt, 1.0), 0.0))
+    return jnp.concatenate(cols, axis=1)  # [Bt, F]
+
+
 def _score_kernel(
     c_bd_ref,  # int32 [Bt, NB] customer bucket days
     c_cnt_ref,  # f32 [Bt, NB]
@@ -64,32 +107,13 @@ def _score_kernel(
     amount = fvec_ref[:, 0:1]
     valid = fvec_ref[:, 1:2]
 
-    # --- window aggregates from pre-gathered rows (age-mask form)
-    c_bd = c_bd_ref[:]
-    t_bd = t_bd_ref[:]
-    age_c = day - c_bd  # [Bt, NB]
-    live_c = (c_bd >= 0) & (age_c >= 0)
-    age_t = day - delay - t_bd
-    live_t = (t_bd >= 0) & (age_t >= 0)
-
-    cols = [amount]
-    # flags
-    weekday = jnp.remainder(day + 3, 7)
-    cols.append((weekday >= weekend_start).astype(jnp.float32))
-    cols.append((tod // 3600 <= night_end).astype(jnp.float32))
-    for w in windows:
-        sel = jnp.where(live_c & (age_c < w), 1.0, 0.0)
-        cnt = jnp.sum(c_cnt_ref[:] * sel, axis=1, keepdims=True)
-        amt = jnp.sum(c_amt_ref[:] * sel, axis=1, keepdims=True)
-        cols.append(cnt)
-        cols.append(jnp.where(cnt > 0, amt / jnp.maximum(cnt, 1.0), 0.0))
-    for w in windows:
-        sel = jnp.where(live_t & (age_t < w), 1.0, 0.0)
-        cnt = jnp.sum(t_cnt_ref[:] * sel, axis=1, keepdims=True)
-        frd = jnp.sum(t_frd_ref[:] * sel, axis=1, keepdims=True)
-        cols.append(cnt)
-        cols.append(jnp.where(cnt > 0, frd / jnp.maximum(cnt, 1.0), 0.0))
-    feats = jnp.concatenate(cols, axis=1)  # [Bt, F]
+    feats = assemble_features(
+        c_bd_ref[:], c_cnt_ref[:], c_amt_ref[:],
+        t_bd_ref[:], t_cnt_ref[:], t_frd_ref[:],
+        day, tod, amount,
+        windows=windows, delay=delay, weekend_start=weekend_start,
+        night_end=night_end,
+    )
     feats_ref[:] = feats
 
     # --- standardize + logistic score

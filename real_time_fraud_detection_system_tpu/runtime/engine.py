@@ -36,11 +36,13 @@ from real_time_fraud_detection_system_tpu.features.online import (
     init_feature_state,
     update_and_featurize,
     update_and_score_pallas,
+    update_and_score_pallas_forest,
 )
 from real_time_fraud_detection_system_tpu.features.spec import N_FEATURES
 from real_time_fraud_detection_system_tpu.models.forest import (
     TreeEnsemble,
     for_device,
+    resolve_z_mode,
 )
 from real_time_fraud_detection_system_tpu.models.forest import (
     predict_proba as forest_predict_proba,
@@ -78,6 +80,13 @@ from real_time_fraud_detection_system_tpu.utils.xla_telemetry import (
 PHASES = ("source_poll", "host_prep", "dispatch", "result_wait",
           "sink_write")
 
+# One double-buffered Pallas tree block must sit well inside ~16MB VMEM
+# next to the row tile and [Bt, 128·k] intermediates (ops/pallas_forest).
+# Decided at TRACE time from the live params' static shapes, so a
+# checkpoint restore that swaps in a deeper ensemble retraces into the
+# XLA composition instead of a VMEM-overflowing kernel.
+_PALLAS_BLOCK_BUDGET = 4 * 2 ** 20
+
 
 def device_params_for(kind: str, params):
     """Engine-ready params: tree-ensemble kinds convert to the fast GEMM
@@ -94,7 +103,11 @@ def device_params_for(kind: str, params):
     return params
 
 
-def predict_fn_for(kind: str) -> Callable:
+def predict_fn_for(kind: str, z_mode: Optional[str] = None) -> Callable:
+    """Device predict for ``kind``. ``z_mode`` (a RESOLVED mode —
+    f32/bf16/int8, see ``models/forest.resolve_z_mode``) selects the
+    tree-ensemble z-contraction arithmetic; non-ensemble kinds have no
+    contraction and ignore it."""
     if kind == "logreg":
         return logreg_predict_proba
     if kind == "mlp":
@@ -104,9 +117,13 @@ def predict_fn_for(kind: str) -> Callable:
             gbt_predict_proba,
         )
 
-        return gbt_predict_proba
+        if z_mode is None:
+            return gbt_predict_proba
+        return lambda p, x: gbt_predict_proba(p, x, z_mode)
     if kind in ("tree", "forest"):
-        return forest_predict_proba
+        if z_mode is None:
+            return forest_predict_proba
+        return lambda p, x: forest_predict_proba(p, x, z_mode)
     if kind == "autoencoder":
         from real_time_fraud_detection_system_tpu.models.autoencoder import (
             autoencoder_predict_proba,
@@ -244,6 +261,13 @@ class ScoringEngine:
         self.scorer = scorer or cfg.runtime.scorer
         self.cpu_model = cpu_model
         self.online_lr = online_lr
+        # Serving z_mode, resolved ONCE at build (auto → int8 on TPU /
+        # f32 elsewhere): the tree-ensemble z-contraction arithmetic the
+        # jitted step closes over — so precompile() compiles, and every
+        # dispatch serves, the active mode. Decision-identical to f32 by
+        # the gemm_leaf_sum exactness contract (int8 additionally
+        # BIT-identical; engine-level gate in make perf-smoke).
+        self.z_mode = resolve_z_mode(cfg.runtime.z_mode)
         # Data-plane guard (opt-in, runtime.nan_guard): rows whose step
         # outputs cross the host boundary non-finite are quarantined to
         # the dead-letter sink and the batch is re-scored from the
@@ -353,16 +377,42 @@ class ScoringEngine:
             params=params,
             scaler=scaler,
         )
-        self._predict = predict_fn_for(kind)
+        self._predict = predict_fn_for(kind, z_mode=self.z_mode)
         self._loss = loss_fn_for(kind)
         fcfg = cfg.features
+        z_mode = self.z_mode
 
         use_pallas = (
             cfg.runtime.use_pallas
             and kind == "logreg"
             and cfg.features.customer_source == "table"
         )
+        # Fused featurize→score forest step (ops/pallas_forest.py): the
+        # round-9 kernel that keeps the feature block VMEM-resident past
+        # the scatter boundary. Gated like the logreg fused kernel (table
+        # source — the CMS query has its own sketch layout) plus, at
+        # TRACE time inside the step, on GEMM-form params whose tables
+        # fit the VMEM block budget — so a hot reload to an oversized or
+        # descent-form ensemble retraces into the XLA composition.
+        use_pallas_forest = (
+            cfg.runtime.use_pallas
+            and kind in ("tree", "forest")
+            and cfg.features.customer_source == "table"
+            and self.scorer != "cpu"
+        )
+        if use_pallas_forest:
+            from real_time_fraud_detection_system_tpu.models.forest import (
+                GemmEnsemble,
+            )
+            from real_time_fraud_detection_system_tpu.ops.pallas_forest \
+                import pallas_block_bytes, to_pallas
         self._maybe_use_pallas_forest(kind, params)
+
+        def _fused_forest_fits(p) -> bool:
+            # trace-time gate (static shapes only — see use_pallas_forest)
+            return (use_pallas_forest and isinstance(p, GemmEnsemble)
+                    and pallas_block_bytes(p, z_mode)
+                    <= _PALLAS_BLOCK_BUDGET)
 
         def step(fstate: FeatureState, params, scaler: Scaler, packed):
             # One packed H2D array per batch (see core.batch.pack_batch):
@@ -374,6 +424,14 @@ class ScoringEngine:
                     params.w, params.b,
                 )
                 x = transform(scaler, feats)
+            # rtfdslint: disable=jit-recompile-hazard (trace-time gate on STATIC facts only: isinstance on the params pytree structure + pallas_block_bytes over params' static .shape tuple — no traced VALUE is branched on, and a retrace when a hot reload changes the params FORM is the intended XLA-fallback behavior, same contract as _maybe_use_pallas_forest)
+            elif _fused_forest_fits(params):
+                pf = to_pallas(params, z_mode)
+                fstate, leaf, feats = update_and_score_pallas_forest(
+                    fstate, batch, fcfg, scaler.mean, scaler.scale, pf,
+                )
+                x = transform(scaler, feats)
+                probs = jnp.where(batch.valid, leaf / pf.n_trees, 0.0)
             elif self.scorer == "cpu":
                 # Oracle serving: the classifier runs host-side on the
                 # returned features (process_batch), so don't burn device
@@ -498,6 +556,23 @@ class ScoringEngine:
         # then CLOBBERS those updates, and the operator must be able to
         # count it, not read a one-time warning.
         self._online_dirty = False
+        # Device-plane config gauges (healthz's device_plane block reads
+        # them): which z_mode the jitted step closes over, and whether
+        # the opt-in fused Pallas path is enabled.
+        self._m_zmode = {
+            m: reg.gauge(
+                "rtfds_z_mode",
+                "active tree-ensemble z-contraction mode (1 = the mode "
+                "the serving step compiled with; exactness contract in "
+                "README § Device plane)", mode=m)
+            for m in ("f32", "bf16", "int8")
+        }
+        for m, g in self._m_zmode.items():
+            g.set(1.0 if m == self.z_mode else 0.0)
+        self._m_use_pallas = reg.gauge(
+            "rtfds_use_pallas",
+            "1 when the opt-in fused Pallas scoring path is enabled")
+        self._m_use_pallas.set(1.0 if self.cfg.runtime.use_pallas else 0.0)
         self._m_reloads = {
             o: reg.counter(
                 "rtfds_model_reloads_total",
@@ -730,26 +805,23 @@ class ScoringEngine:
             to_pallas,
         )
 
-        # One double-buffered tree block must sit well inside ~16MB VMEM
-        # next to the row tile and [Bt, 128·k] intermediates. Decided at
-        # TRACE time from the live params' (static) shapes, so a checkpoint
-        # restore that swaps in a deeper ensemble retraces into the XLA
-        # fallback instead of a VMEM-overflowing kernel.
-        budget = 4 * 2 ** 20
+        budget = _PALLAS_BLOCK_BUDGET
         xla_predict = self._predict
+        z_mode = self.z_mode
 
         if kind in ("tree", "forest") and isinstance(params, GemmEnsemble):
             def _pred(p, x):
-                if pallas_block_bytes(p) <= budget:
-                    return pallas_predict_proba(to_pallas(p), x)
+                if pallas_block_bytes(p, z_mode) <= budget:
+                    return pallas_predict_proba(to_pallas(p, z_mode), x)
                 return xla_predict(p, x)
             self._predict = _pred
         elif (kind == "gbt" and isinstance(params, GBTModel)
                 and isinstance(params.trees, GemmEnsemble)):
             def _pred(p, x):
-                if pallas_block_bytes(p.trees) <= budget:
+                if pallas_block_bytes(p.trees, z_mode) <= budget:
                     return jax.nn.sigmoid(
-                        p.base_score + pallas_leaf_sum(to_pallas(p.trees), x))
+                        p.base_score
+                        + pallas_leaf_sum(to_pallas(p.trees, z_mode), x))
                 return xla_predict(p, x)
             self._predict = _pred
 
@@ -845,10 +917,11 @@ class ScoringEngine:
             # Steady-state recompile alarm: the signature keys on what
             # the jit cache keys on from the engine's side — the packed
             # batch's (shape, dtype) bucket plus the step's static facts
-            # (kind, donation layout). A compile observed inside this
-            # window after warmup is a retrace paid in the serving loop.
+            # (kind, donation layout, z_mode). A compile observed inside
+            # this window after warmup is a retrace paid in the serving
+            # loop.
             with self._recompile.step(step_signature(
-                    jbatch, static=(self.kind, "donate0"))):
+                    jbatch, static=(self.kind, "donate0", self.z_mode))):
                 fstate, params, probs, feats = self._dispatch_step(
                     ("step",) + tuple(jbatch.shape), self._step,
                     self.state.feature_state, self.state.params,
@@ -1582,6 +1655,9 @@ class ScoringEngine:
             "result_wait_p50_ms": snaps["result_wait"].get("p50_ms", 0.0),
             "sink_write_p50_ms": snaps["sink_write"].get("p50_ms", 0.0),
             "pipeline_depth": depth,
+            # the z-contraction mode the serving step compiled with —
+            # the run-report twin of the rtfds_z_mode gauge
+            "z_mode": self.z_mode,
         }
         if auto is not None:
             stats["autobatch_target_rows"] = auto.target_rows()

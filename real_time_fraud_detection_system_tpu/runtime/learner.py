@@ -155,6 +155,9 @@ class ShadowScorer:
     def __init__(self, kind: str, cfg, capacity: int = 1 << 16,
                  decision_threshold: float = 0.5,
                  divergence_threshold: float = 0.25, registry=None):
+        from real_time_fraud_detection_system_tpu.models.forest import (
+            resolve_z_mode,
+        )
         from real_time_fraud_detection_system_tpu.runtime.engine import (
             predict_fn_for,
         )
@@ -167,7 +170,11 @@ class ShadowScorer:
         self.candidate_version: Optional[int] = None
         self._cand_params = None
         self._cand_scaler = None
-        predict = predict_fn_for(kind)
+        # The candidate dual-scores with the SAME device-plane arithmetic
+        # the champion serves with (runtime.z_mode): a mode split would
+        # let the shadow diverge for arithmetic reasons, not model ones.
+        predict = predict_fn_for(
+            kind, z_mode=resolve_z_mode(cfg.runtime.z_mode))
 
         def step(params, scaler, x_raw):
             return predict(params, transform(scaler, x_raw))

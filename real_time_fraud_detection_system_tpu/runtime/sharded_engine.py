@@ -491,8 +491,11 @@ class ShardedScoringEngine(ScoringEngine):
             # The detector window covers the lazy step BUILD too: a
             # routed variant first compiled on a hot-key overflow deep
             # into serving is a real in-loop compile and must alarm.
-            sig = step_signature(jbatch,
-                                 static=(self.kind, routed, self.n_dev))
+            # z_mode rides the statics: the sharded step closes over the
+            # base engine's z-mode-aware predict.
+            sig = step_signature(
+                jbatch,
+                static=(self.kind, routed, self.n_dev, self.z_mode))
             with self._recompile.step(sig):
                 if routed:
                     if self._sharded_step_routed is None:

@@ -636,6 +636,21 @@ class MetricsServer:
             if ev is not None:
                 cache["evictions"] = ev
             extras["feature_cache"] = cache
+        # Device plane: the z-contraction mode the serving step compiled
+        # with and whether the fused Pallas path is on — present only
+        # once an engine registered the gauges, so non-serving processes
+        # stay clean.
+        active_z = None
+        for mode in ("f32", "bf16", "int8"):
+            g = self.registry.get("rtfds_z_mode", mode=mode)
+            if g is not None and g.value > 0:
+                active_z = mode
+        if active_z is not None:
+            device_plane: Dict[str, object] = {"z_mode": active_z}
+            up = self.registry.get("rtfds_use_pallas")
+            if up is not None:
+                device_plane["use_pallas"] = bool(up.value)
+            extras["device_plane"] = device_plane
         # Continuous-learning plane: which versions are serving/shadowing
         # and whether promotions/rollbacks have fired — present only once
         # a registry/learning loop exists, so other runs stay clean.

@@ -278,10 +278,144 @@ def test_table_bytes_gate():
     rng = np.random.default_rng(9)
     _, ens, _ = _fit(rng, n_trees=4, max_depth=4)
     g = to_gemm(ens, N_FEAT)
-    nbytes = pallas_table_bytes(g)
-    assert nbytes > 0
-    # one padded tree block of depth-4 trees: sel + path dominate
-    got = sum(int(np.asarray(a).nbytes) for a in
-              (to_pallas(g).sel, to_pallas(g).path, to_pallas(g).thresh,
-               to_pallas(g).target, to_pallas(g).leaf_val))
-    assert nbytes == got
+    for zm in ("bf16", "int8", "f32"):
+        nbytes = pallas_table_bytes(g, zm)
+        assert nbytes > 0
+        pf = to_pallas(g, zm)
+        # one padded tree block of depth-4 trees: sel + path dominate
+        got = sum(int(np.asarray(a).nbytes) for a in
+                  (pf.sel, pf.path, pf.thresh, pf.target, pf.leaf_val))
+        assert nbytes == got, zm
+
+
+@pytest.mark.parametrize("z_mode", ["f32", "int8", "bf16"])
+def test_classify_kernel_z_modes_match(z_mode):
+    """The traversal core follows the table z dtype (to_pallas z_mode):
+    every mode must agree with the f32 gemm composition."""
+    rng = np.random.default_rng(13)
+    clf, ens, _ = _fit(rng, n_trees=7, max_depth=5)
+    g = to_gemm(ens, N_FEAT)
+    pf = to_pallas(g, z_mode)
+    xq = rng.normal(size=(200, N_FEAT)).astype(np.float32)
+    want = np.asarray(gemm_leaf_sum(g, jnp.asarray(xq), z_mode="f32"))
+    got = np.asarray(pallas_leaf_sum(pf, jnp.asarray(xq), block_rows=64))
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    # and decisions vs sklearn stay exact through the int8 path too
+    p_skl = clf.predict_proba(xq)[:, 1]
+    p_pal = np.asarray(pallas_predict_proba(pf, jnp.asarray(xq),
+                                            block_rows=64))
+    assert np.array_equal(p_pal >= 0.5, p_skl >= 0.5)
+
+
+# -- fused featurize→score step (round 9) -----------------------------------
+
+
+def _batch_cols(rng, n):
+    return {
+        "customer_id": rng.integers(0, 100, n).astype(np.int64),
+        "terminal_id": rng.integers(0, 200, n).astype(np.int64),
+        "tx_datetime_us": (
+            (20200 * 86400 + rng.integers(0, 86400, n)).astype(np.int64)
+            * 1_000_000),
+        "amount_cents": rng.integers(100, 50000, n).astype(np.int64),
+    }
+
+
+@pytest.mark.parametrize("z_mode", ["f32", "int8", "bf16"])
+@pytest.mark.parametrize("rows", [64, 256, 300])  # 300: non-×8 row pad path
+def test_fused_step_matches_unfused_composition(z_mode, rows):
+    """Interpret-mode parity for the fused featurize→score kernel vs the
+    unfused jit composition (update_and_featurize → transform →
+    gemm_leaf_sum) — same rows, every bucket size, every z mode — so
+    tier-1 validates the exact code path the TPU compiles. Features must
+    be BIT-identical (same age-mask math); the leaf sum agrees to f32
+    accumulation order and decisions exactly."""
+    import jax
+
+    from real_time_fraud_detection_system_tpu.config import FeatureConfig
+    from real_time_fraud_detection_system_tpu.core.batch import make_batch
+    from real_time_fraud_detection_system_tpu.features.online import (
+        init_feature_state,
+        update_and_featurize,
+        update_and_score_pallas_forest,
+    )
+    from real_time_fraud_detection_system_tpu.models.scaler import (
+        Scaler,
+        transform,
+    )
+
+    rng = np.random.default_rng(17)
+    _, ens, _ = _fit(rng, n_trees=7, max_depth=5)
+    g = to_gemm(ens, N_FEAT)
+    fcfg = FeatureConfig(customer_capacity=128, terminal_capacity=256)
+    scaler = Scaler(
+        mean=jnp.asarray(rng.normal(size=N_FEAT).astype(np.float32)),
+        scale=jnp.asarray((1.0 + rng.random(N_FEAT)).astype(np.float32)))
+    batch = jax.tree.map(jnp.asarray,
+                         make_batch(**_batch_cols(rng, rows)))
+
+    def unfused(fstate, batch):
+        fstate, feats = update_and_featurize(fstate, batch, fcfg)
+        leaf = gemm_leaf_sum(g, transform(scaler, feats), z_mode=z_mode)
+        return fstate, leaf, feats
+
+    def fused(fstate, batch):
+        pf = to_pallas(g, z_mode)
+        return update_and_score_pallas_forest(
+            fstate, batch, fcfg, scaler.mean, scaler.scale, pf)
+
+    outs = {}
+    for name, fn in (("unfused", unfused), ("fused", fused)):
+        jfn = jax.jit(fn, donate_argnums=(0,))
+        fs = init_feature_state(fcfg)
+        # two chained batches: the second reads state the first scattered
+        for _ in range(2):
+            fs, leaf, feats = jfn(fs, batch)
+        outs[name] = (np.asarray(leaf), np.asarray(feats))
+
+    np.testing.assert_array_equal(outs["fused"][1], outs["unfused"][1])
+    np.testing.assert_allclose(outs["fused"][0], outs["unfused"][0],
+                               atol=1e-5)
+    n_trees = g.sel.shape[0]
+    assert np.array_equal(outs["fused"][0] / n_trees >= 0.5,
+                          outs["unfused"][0] / n_trees >= 0.5)
+
+
+def test_fused_engine_int8_matches_f32_unfused_engine(small_dataset):
+    """The full serving gate: use_pallas + z_mode=int8 (the round-9
+    device plane, both stages on) must stay decision-identical to the
+    plain f32 XLA engine over a replayed stream."""
+    import dataclasses
+
+    from real_time_fraud_detection_system_tpu.config import small_config
+    from real_time_fraud_detection_system_tpu.models.forest import fit_forest
+    from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+    from real_time_fraud_detection_system_tpu.runtime import (
+        ReplaySource,
+        ScoringEngine,
+    )
+
+    rng = np.random.default_rng(23)
+    x = rng.normal(size=(500, N_FEAT)).astype(np.float32)
+    y = (x[:, 0] > 0.3).astype(np.int32)
+    ens = fit_forest(x, y, n_trees=5, max_depth=4)
+    scaler = Scaler(mean=jnp.zeros(N_FEAT), scale=jnp.ones(N_FEAT))
+
+    _, _, _, txs = small_dataset
+    base = small_config()
+    fused = dataclasses.replace(base, runtime=dataclasses.replace(
+        base.runtime, use_pallas=True, z_mode="int8"))
+    outs = []
+    for c in (base, fused):
+        eng = ScoringEngine(c, kind="forest", params=ens, scaler=scaler)
+        src = ReplaySource(txs.slice(slice(0, 300)), 1_743_465_600,
+                           batch_rows=128)
+        probs = []
+        while True:
+            cols = src.poll_batch()
+            if cols is None:
+                break
+            probs.append(eng.process_batch(cols).probs)
+        outs.append(np.concatenate(probs))
+    np.testing.assert_allclose(outs[1], outs[0], rtol=1e-5, atol=1e-6)
+    assert np.array_equal(outs[1] >= 0.5, outs[0] >= 0.5)

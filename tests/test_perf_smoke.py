@@ -15,6 +15,11 @@ Asserts the hot-loop invariants the perf tentpoles establish:
    having usable CPU parallelism — the correctness half always runs);
    with a ``PrefetchSource`` the loop thread's ``source_poll`` phase p50
    collapses to dequeue scale (≤ 1 ms) while rows stay identical.
+4. Device plane (round 9): a forest engine with ``z_mode="int8"``
+   forced under ``--precompile`` serves decisions bit-identical to the
+   f32 control across every bucket size AND pays zero mid-stream
+   recompiles — asserted from ``rtfds_xla_recompiles_total``, not
+   prints.
 """
 
 import dataclasses
@@ -327,6 +332,59 @@ def test_prefetch_collapses_loop_thread_source_poll(small_dataset,
         f"loop-thread source_poll p50 "
         f"{h_pre.percentile(50) * 1e3:.2f} ms with prefetch on is not "
         "dequeue-scale")
+
+
+def test_device_plane_int8_decision_identical_zero_recompiles(
+        small_dataset):
+    """Device-plane gate: the promoted int8 serving path (z_mode=int8 +
+    precompile) streams through EVERY bucket — visiting the second
+    bucket only after the recompile detector's warmup — with
+
+    - probabilities BIT-identical to the f32 jit control (the
+      gemm_leaf_sum exactness contract, at engine level), and
+    - ``rtfds_xla_recompiles_total == 0`` (the AOT executables cover the
+      active z_mode), with zero AOT fallbacks.
+    """
+    from real_time_fraud_detection_system_tpu.models.forest import (
+        fit_forest,
+    )
+
+    rng = np.random.default_rng(31)
+    x = rng.normal(size=(400, 15)).astype(np.float32)
+    y = (x[:, 0] > 0.2).astype(np.int32)
+    ens = fit_forest(x, y, n_trees=5, max_depth=4)
+    scaler = Scaler(mean=np.zeros(15, np.float32),
+                    scale=np.ones(15, np.float32))
+
+    _, _, _, txs = small_dataset
+    part = txs.slice(slice(0, 4096))
+    sizes = [60] * 5 + [200, 60, 200]
+
+    def run(z_mode, precompile):
+        reg = MetricsRegistry()
+        cfg = _cfg(buckets=(64, 256), max_rows=256)
+        cfg = cfg.replace(runtime=dataclasses.replace(
+            cfg.runtime, z_mode=z_mode, precompile=precompile))
+        eng = ScoringEngine(cfg, kind="forest", params=ens, scaler=scaler,
+                            metrics=reg)
+        from real_time_fraud_detection_system_tpu.io import MemorySink
+
+        sink = MemorySink()
+        stats = eng.run(_SizedSource(part, sizes), sink=sink)
+        assert stats["batches"] == len(sizes)
+        assert stats["z_mode"] == z_mode
+        return reg, sink.concat()
+
+    reg_ctl, out_f32 = run("f32", precompile=False)
+    reg_i8, out_i8 = run("int8", precompile=True)
+    np.testing.assert_array_equal(out_i8["tx_id"], out_f32["tx_id"])
+    # bit identity, not a tolerance: int8 z arithmetic is exact
+    np.testing.assert_array_equal(out_i8["prediction"],
+                                  out_f32["prediction"])
+    assert _recompiles(reg_i8) == 0
+    assert reg_i8.get("rtfds_aot_fallbacks_total").value == 0
+    assert reg_i8.get("rtfds_precompiled_steps_total").value == 2
+    assert reg_i8.get("rtfds_z_mode", mode="int8").value == 1.0
 
 
 def test_precompile_preserves_scores(small_dataset):
