@@ -90,12 +90,28 @@ recovery-smoke:
 # static-analysis gate: the project-native analyzer (tools/rtfdslint)
 # must report ZERO unbaselined P0/P1 findings over the whole package —
 # recompile hazards, cross-thread races, exception-taxonomy erosion,
-# wall-clock durations, metric-name drift, loop-thread blocking. Runs
-# jax-free (pure stdlib ast). Accept a deliberate finding with an
-# inline `# rtfdslint: disable=<rule> (<reason>)` pragma or
+# wall-clock durations, metric/config drift, loop-thread blocking. The
+# lint pass runs jax-free (pure stdlib ast); the gate then folds in the
+# device-contract verifier (verify-static below), so one exit status
+# covers both levels. Accept a deliberate finding with an inline
+# `# rtfdslint: disable=<rule> (<reason>)` pragma or
 # `rtfds lint --update-baseline --reason '...'`.
 lint-static:
 	$(PY) -m real_time_fraud_detection_system_tpu.cli lint
+	$(MAKE) verify-static
+
+# device-contract verification gate (tools/rtfdsverify): build
+# weightless template engines, load their dispatch signature
+# inventories (the SAME enumeration precompile() compiles), and prove
+# on the traced jaxprs — no device, no weights — that (1) every
+# reachable dispatch signature is AOT-covered, (2) the int8/bf16
+# z-mode exactness contract holds structurally (integer z arithmetic,
+# f32-HIGHEST decision/leaf contractions, no laundered downcasts),
+# (3) donation is exactly the feature state and off under the
+# nan-guard, (4) Pallas VMEM block budgets and tile alignment admit
+# every use_pallas signature. Zero unbaselined P0/P1 to pass.
+verify-static:
+	JAX_PLATFORMS=cpu $(PY) -m real_time_fraud_detection_system_tpu.cli verify-device
 
 # continuous-learning gate: champion serves, the streaming learner
 # trains a candidate on injected labeled feedback, the shadow's live
@@ -146,4 +162,4 @@ install:
 clean:
 	rm -rf $(OUT)
 
-.PHONY: demo datagen train score run-all query dashboard connectors dryrun trace-demo bench perf-smoke chaos-smoke recovery-smoke learn-smoke lint-static test integration integration-up integration-down sqlcheck install clean
+.PHONY: demo datagen train score run-all query dashboard connectors dryrun trace-demo bench perf-smoke chaos-smoke recovery-smoke learn-smoke lint-static verify-static test integration integration-up integration-down sqlcheck install clean

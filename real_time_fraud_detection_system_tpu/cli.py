@@ -1962,7 +1962,7 @@ def cmd_lint(args) -> int:
     # forwarding block to be reachable via `rtfds lint`.
     fwd = ["--root", repo_root]
     for flag in ("json", "strict", "verbose", "no_baseline",
-                 "update_baseline", "list_rules"):
+                 "update_baseline", "list_rules", "verify_device"):
         if getattr(args, flag):
             fwd.append("--" + flag.replace("_", "-"))
     if args.reason:
@@ -1972,6 +1972,40 @@ def cmd_lint(args) -> int:
     for r in args.rule or ():
         fwd += ["--rule", r]
     return lint_main(fwd + list(args.paths))
+
+
+def cmd_verify_device(args) -> int:
+    """Jaxpr-level device-contract verifier (tools/rtfdsverify).
+
+    The semantic sibling of ``rtfds lint``: instead of parsing source,
+    it builds weightless template engines, loads their dispatch
+    signature inventories, and proves the device-plane contracts (AOT
+    coverage, z-mode exactness, donation safety, Pallas VMEM
+    admission) on the traced programs — CPU-only, before any stream
+    starts. Same exit contract as lint (1 = unbaselined P0/P1,
+    2 = usage/config error)."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tools_dir = os.path.join(repo_root, "tools")
+    if not os.path.isdir(os.path.join(tools_dir, "rtfdsverify")):
+        print("rtfds verify-device: tools/rtfdsverify not found beside "
+              "the package (installed without the repo checkout?) — "
+              "run from a source tree", file=sys.stderr)
+        return 2
+    sys.path.insert(0, tools_dir)
+    from rtfdsverify.cli import main as verify_main
+
+    fwd = ["--root", repo_root]
+    for flag in ("json", "strict", "verbose", "no_baseline",
+                 "update_baseline", "list_checks"):
+        if getattr(args, flag):
+            fwd.append("--" + flag.replace("_", "-"))
+    if args.reason:
+        fwd += ["--reason", args.reason]
+    if args.baseline:
+        fwd += ["--baseline", args.baseline]
+    for c in args.check or ():
+        fwd += ["--check", c]
+    return verify_main(fwd)
 
 
 def main(argv=None) -> int:
@@ -2508,7 +2542,38 @@ def main(argv=None) -> int:
                    help="run only this rule (repeatable)")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
+    p.add_argument("--verify-device", action="store_true",
+                   help="also run the jaxpr-level device-contract "
+                        "verifier (tools/rtfdsverify) and fold its "
+                        "findings into the report/gate (--json carries "
+                        "them under \"verifier\")")
     p.set_defaults(fn=cmd_lint, needs_backend=False)
+
+    p = sub.add_parser(
+        "verify-device",
+        help="device-contract verifier: prove AOT coverage, z-mode "
+             "exactness, donation safety and Pallas VMEM admission on "
+             "the traced step programs (tools/rtfdsverify; CPU-only, "
+             "no weights)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report")
+    p.add_argument("--strict", action="store_true",
+                   help="P2 findings also fail the gate")
+    p.add_argument("--verbose", action="store_true",
+                   help="also list baselined findings")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline file")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="absorb current P0/P1 findings (needs --reason)")
+    p.add_argument("--reason", default="",
+                   help="reason recorded on new baseline entries")
+    p.add_argument("--baseline", default="",
+                   help="override the baseline file path")
+    p.add_argument("--check", action="append",
+                   help="run only this check (repeatable)")
+    p.add_argument("--list-checks", action="store_true",
+                   help="print the check catalog and exit")
+    p.set_defaults(fn=cmd_verify_device, needs_backend=False)
 
     args = ap.parse_args(argv)
     _platform_setup(args.platform,

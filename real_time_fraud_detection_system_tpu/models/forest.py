@@ -363,6 +363,49 @@ def for_device(
     return ens
 
 
+def synthetic_ensemble(
+    n_trees: int = 4,
+    max_depth: int = 3,
+    n_features: int = 15,
+    seed: int = 0,
+) -> TreeEnsemble:
+    """A shape-faithful ensemble with NO training dependency.
+
+    Complete binary trees of exactly ``max_depth`` levels with random
+    (but valid) feature indices, thresholds and leaf probabilities —
+    structurally indistinguishable from an ``ensemble_from_sklearn``
+    product, so anything that needs an ensemble's SHAPES and traced
+    program (``tools/rtfdsverify``'s device-contract proofs, template
+    tests, ``to_gemm``/``to_pallas`` padding math) can build one without
+    sklearn or data. The probabilities are arbitrary: do not score real
+    traffic with it.
+    """
+    rng = np.random.default_rng(seed)
+    n = 2 ** (max_depth + 1) - 1  # complete binary tree node count
+    n_internal = 2 ** max_depth - 1
+    idx = np.arange(n, dtype=np.int32)
+    is_leaf = idx >= n_internal
+    feat = np.where(
+        is_leaf[None, :], 0,
+        rng.integers(0, n_features, size=(n_trees, n)),
+    ).astype(np.int32)
+    thresh = np.where(
+        is_leaf[None, :], 0.0,
+        rng.normal(size=(n_trees, n)),
+    ).astype(np.float32)
+    left = np.where(is_leaf, idx, idx * 2 + 1).astype(np.int32)
+    right = np.where(is_leaf, idx, idx * 2 + 2).astype(np.int32)
+    prob = rng.uniform(size=(n_trees, n)).astype(np.float32)
+    return TreeEnsemble(
+        feat=jnp.asarray(feat),
+        thresh=jnp.asarray(ftz_safe_thresholds(thresh)),
+        left=jnp.asarray(np.broadcast_to(left, (n_trees, n)).copy()),
+        right=jnp.asarray(np.broadcast_to(right, (n_trees, n)).copy()),
+        prob=jnp.asarray(prob),
+        max_depth=max_depth,
+    )
+
+
 def fit_forest(
     x: np.ndarray,
     y: np.ndarray,

@@ -56,6 +56,26 @@ def gbt_for_device(model: GBTModel, n_features: int) -> GBTModel:
     return model
 
 
+def synthetic_gbt(
+    n_trees: int = 4,
+    max_depth: int = 3,
+    n_features: int = 15,
+    seed: int = 0,
+) -> GBTModel:
+    """Shape-faithful GBT with no training dependency (the boosting twin
+    of :func:`~.forest.synthetic_ensemble` — see its caveats: valid
+    structure, arbitrary values, built for shape/traced-program
+    consumers like ``tools/rtfdsverify``)."""
+    from real_time_fraud_detection_system_tpu.models.forest import (
+        synthetic_ensemble,
+    )
+
+    return GBTModel(
+        trees=synthetic_ensemble(n_trees, max_depth, n_features, seed),
+        base_score=jnp.float32(-2.0),
+    )
+
+
 class _Node(NamedTuple):
     feat: int
     thresh: float

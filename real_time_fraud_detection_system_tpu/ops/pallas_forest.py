@@ -169,6 +169,54 @@ def pallas_block_bytes(g: GemmEnsemble, z_mode: str = "bf16") -> int:
         fp * ip * 4 + ip * lp * _Z_BYTES[z_mode] + lp * 8 + ip * 4)
 
 
+class PallasAdmission(NamedTuple):
+    """The admission verdict for serving a ``GemmEnsemble`` through the
+    fused kernels — every STATIC fact the gate decides on, in one
+    record, so the engine's trace-time gate and the device-contract
+    verifier (``tools/rtfdsverify``) consume the same predicate and can
+    never drift. Shape math only: safe to call at trace time and on a
+    weightless CPU-only verifier process."""
+
+    fits: bool           # the whole verdict: bytes within budget AND tiled
+    block_bytes: int     # one double-buffered tree block's VMEM bytes
+    budget: int          # the byte budget the verdict was taken against
+    tiles_aligned: bool  # padded dims divide the MXU/grid tile sizes
+    padded: Tuple[int, int, int, int]  # (Tp, Fp, Ip, Lp) kernel layout
+
+
+def admit_block(g: "GemmEnsemble", z_mode: str,
+                budget: int) -> PallasAdmission:
+    """Decide (statically) whether the fused kernels may serve ``g``.
+
+    Two conditions, both provable from the params' shape tuple alone:
+    the double-buffered tree-block tables must fit ``budget`` bytes of
+    VMEM next to the row tile (see :func:`pallas_block_bytes`), and the
+    padded table layout must tile exactly — ``Tp`` by ``TREE_BLOCK``
+    (the grid's second axis), ``Fp`` by 8 and ``Ip``/``Lp`` by 128 (the
+    MXU tile). The padded dims here re-derive :func:`to_pallas`'s math,
+    so ``tiles_aligned`` alone cannot catch a drifted padding
+    discipline — ``tools/rtfdsverify``'s pallas-admission check
+    cross-checks ``padded`` against the layout ``to_pallas`` actually
+    builds, which is what makes the alignment claim non-vacuous.
+    """
+    # shape tuples are static python ints even on traced values, so all
+    # of the math below is host arithmetic — safe inside a traced step
+    t, f, i = g.sel.shape
+    l = g.path.shape[2]
+    tp, fp = _ceil_to(t, TREE_BLOCK), _ceil_to(f, 8)
+    ip, lp = _ceil_to(i, 128), _ceil_to(l, 128)
+    aligned = (tp % TREE_BLOCK == 0 and fp % 8 == 0
+               and ip % 128 == 0 and lp % 128 == 0)
+    bb = pallas_block_bytes(g, z_mode)
+    return PallasAdmission(
+        fits=aligned and bb <= budget,
+        block_bytes=bb,
+        budget=budget,
+        tiles_aligned=aligned,
+        padded=(tp, fp, ip, lp),
+    )
+
+
 def _tree_block_leaf_sum(
     x,  # f32 [Bt, Fp] scaled feature tile (VMEM-resident)
     sel_ref,  # f32 [TT, Fp, Ip]

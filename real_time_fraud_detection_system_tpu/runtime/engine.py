@@ -171,6 +171,39 @@ class EngineState:
     model_version: Optional[int] = None
 
 
+@dataclass(frozen=True)
+class DispatchSignature:
+    """One (shape × static-facts) combination the engine can dispatch.
+
+    The **dispatch signature inventory** (:meth:`ScoringEngine.
+    dispatch_inventory`) enumerates every signature the runtime can ever
+    hand to the device: ``key`` is simultaneously the AOT-cache key
+    ``precompile()`` compiles under AND the key ``_dispatch_step``
+    looks up at serve time, so the coverage proof and the warmup path
+    cannot drift — there is one enumeration, and both consume it.
+    ``tools/rtfdsverify`` abstract-interprets each signature's traced
+    program (CPU-only, no weights) to prove the device-plane contracts
+    (AOT coverage, z-mode exactness, donation safety, Pallas admission)
+    before a stream ever starts."""
+
+    key: tuple           # AOT cache key == runtime dispatch key
+    variant: str         # "step" | "sharded-local" | "sharded-routed"
+    kind: str            # model kind the step closes over
+    z_mode: Optional[str]  # resolved z mode (tree-ensemble kinds; else None)
+    bucket: int          # padded batch rows of this signature
+    donate: tuple        # donated argnums of the jitted step
+    selective: bool      # selective-emission packing compiled in
+    emit_dtype: str      # emitted feature matrix dtype ("float32"/"bfloat16")
+    use_pallas: bool     # a fused Pallas kernel is reachable at trace time
+
+    def describe(self) -> str:
+        """Stable human/fingerprint label (rtfdsverify finding context)."""
+        return (f"{self.variant}[kind={self.kind} z={self.z_mode} "
+                f"bucket={self.bucket} selective={self.selective} "
+                f"emit={self.emit_dtype} pallas={self.use_pallas} "
+                f"donate={','.join(map(str, self.donate)) or '-'}]")
+
+
 @dataclass
 class BatchResult:
     tx_id: np.ndarray
@@ -405,14 +438,15 @@ class ScoringEngine:
                 GemmEnsemble,
             )
             from real_time_fraud_detection_system_tpu.ops.pallas_forest \
-                import pallas_block_bytes, to_pallas
+                import admit_block, to_pallas
         self._maybe_use_pallas_forest(kind, params)
 
         def _fused_forest_fits(p) -> bool:
-            # trace-time gate (static shapes only — see use_pallas_forest)
+            # trace-time gate (static shapes only — see use_pallas_forest);
+            # admit_block is the SAME predicate rtfdsverify proves, so the
+            # served gate and the verified budget cannot drift
             return (use_pallas_forest and isinstance(p, GemmEnsemble)
-                    and pallas_block_bytes(p, z_mode)
-                    <= _PALLAS_BLOCK_BUDGET)
+                    and admit_block(p, z_mode, _PALLAS_BLOCK_BUDGET).fits)
 
         def step(fstate: FeatureState, params, scaler: Scaler, packed):
             # One packed H2D array per batch (see core.batch.pack_batch):
@@ -609,15 +643,66 @@ class ScoringEngine:
             for leaf in jax.tree.leaves(params)
         )
 
-    def precompile(self) -> dict:
-        """AOT-compile the jitted step for EVERY configured bucket size.
+    def dispatch_inventory(self) -> "List[DispatchSignature]":
+        """Enumerate EVERY dispatch signature this engine can serve.
 
-        ``self._step.lower(...).compile()`` per ``runtime.batch_buckets``
-        entry (shape-only templates — no step executes, no state is
-        touched), so a stream that visits a bucket size for the first
-        time mid-serve dispatches a ready executable instead of paying a
-        mid-stream XLA compile (969 ms measured vs 8 ms steady-state on
-        this hardware). Composes with the persistent compilation cache
+        The single source of truth for the device plane's reachable
+        program set: every micro-batch pads to a ``runtime.batch_buckets``
+        size (``core.batch.bucket_size``), and the step's static facts
+        (kind, z_mode, selective packing, emission dtype, donation
+        layout, Pallas gating) are fixed at build — so the runtime
+        dispatch key is always ``("step", 7, bucket)`` for an enumerable
+        bucket. :meth:`precompile` compiles exactly this list and
+        ``tools/rtfdsverify`` proves contracts over exactly this list;
+        neither re-derives its own enumeration, so they cannot drift.
+        """
+        zmode_kinds = ("tree", "forest", "gbt")
+        return [
+            DispatchSignature(
+                key=("step", 7, int(b)),
+                variant="step",
+                kind=self.kind,
+                z_mode=self.z_mode if self.kind in zmode_kinds else None,
+                bucket=int(b),
+                donate=tuple(self._donate),
+                selective=bool(self._selective),
+                emit_dtype=self.cfg.runtime.emit_dtype,
+                use_pallas=bool(self.cfg.runtime.use_pallas),
+            )
+            for b in sorted(set(self.cfg.runtime.batch_buckets))
+        ]
+
+    def signature_templates(self, sig: DispatchSignature) -> tuple:
+        """Shape-only argument templates for ``sig`` — what
+        ``signature_step(sig).lower(...)`` / ``.trace(...)`` take.
+        Never touches buffers (``_sds``), so tracing is free of device
+        work; callers that need runtime-exact dtypes (precompile, the
+        verifier) must commit scalar param leaves to arrays first (see
+        :meth:`precompile`)."""
+        return (
+            self._sds(self.state.feature_state),
+            self._sds(self.state.params),
+            self._sds(self.state.scaler),
+            jax.ShapeDtypeStruct((7, sig.bucket), jnp.int32),
+        )
+
+    def signature_step(self, sig: DispatchSignature):
+        """The jitted callable ``sig`` dispatches to (one shared step
+        for the single-chip engine; the sharded engine overrides with
+        its per-variant builds)."""
+        return self._step
+
+    def precompile(self) -> dict:
+        """AOT-compile the jitted step for EVERY enumerable signature.
+
+        Iterates :meth:`dispatch_inventory` — the same enumeration the
+        device-contract verifier proves coverage over — and
+        ``.lower(...).compile()``s each signature from shape-only
+        templates (no step executes, no state is touched), so a stream
+        that visits a bucket size for the first time mid-serve
+        dispatches a ready executable instead of paying a mid-stream
+        XLA compile (969 ms measured vs 8 ms steady-state on this
+        hardware). Composes with the persistent compilation cache
         (``utils.enable_compilation_cache``): a ``rtfds warmup`` run
         leaves the cache hot for later serving processes too.
 
@@ -630,20 +715,15 @@ class ScoringEngine:
         # arrays once so runtime calls match the AOT signature.
         self.state.params = jax.tree.map(jnp.asarray, self.state.params)
         self._aot_params_sig = self._params_sig(self.state.params)
-        fstate_t = self._sds(self.state.feature_state)
-        params_t = self._sds(self.state.params)
-        scaler_t = self._sds(self.state.scaler)
         done = []
         with self.tracer.span("precompile"):
-            for b in sorted(set(self.cfg.runtime.batch_buckets)):
-                key = ("step", 7, int(b))
-                if key in self._aot:
+            for sig in self.dispatch_inventory():
+                if sig.key in self._aot:
                     continue
-                batch_t = jax.ShapeDtypeStruct((7, int(b)), jnp.int32)
-                self._aot[key] = self._step.lower(
-                    fstate_t, params_t, scaler_t, batch_t).compile()
+                self._aot[sig.key] = self.signature_step(sig).lower(
+                    *self.signature_templates(sig)).compile()
                 self._m_precompiled.inc()
-                done.append(int(b))
+                done.append(sig.bucket)
         return {
             "buckets": done,
             "variants": 1,
@@ -799,7 +879,7 @@ class ScoringEngine:
         )
         from real_time_fraud_detection_system_tpu.models.gbt import GBTModel
         from real_time_fraud_detection_system_tpu.ops.pallas_forest import (
-            pallas_block_bytes,
+            admit_block,
             pallas_leaf_sum,
             pallas_predict_proba,
             to_pallas,
@@ -811,14 +891,14 @@ class ScoringEngine:
 
         if kind in ("tree", "forest") and isinstance(params, GemmEnsemble):
             def _pred(p, x):
-                if pallas_block_bytes(p, z_mode) <= budget:
+                if admit_block(p, z_mode, budget).fits:
                     return pallas_predict_proba(to_pallas(p, z_mode), x)
                 return xla_predict(p, x)
             self._predict = _pred
         elif (kind == "gbt" and isinstance(params, GBTModel)
                 and isinstance(params.trees, GemmEnsemble)):
             def _pred(p, x):
-                if pallas_block_bytes(p.trees, z_mode) <= budget:
+                if admit_block(p.trees, z_mode, budget).fits:
                     return jax.nn.sigmoid(
                         p.base_score
                         + pallas_leaf_sum(to_pallas(p.trees, z_mode), x))

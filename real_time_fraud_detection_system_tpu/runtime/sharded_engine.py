@@ -335,21 +335,84 @@ class ShardedScoringEngine(ScoringEngine):
 
     # -- AOT precompilation over the mesh ----------------------------------
 
+    def dispatch_inventory(self) -> list:
+        """Enumerate every sharded dispatch signature — ONE shape family
+        (chunks are always ``[7, n_dev * rows_per_shard]``) × TWO step
+        variants: the owner-local step and the dense-spill ROUTED step
+        (``partition_batch_spill`` overflow re-packing). Same
+        single-source-of-truth contract as the single-chip inventory:
+        ``precompile`` compiles this list, ``_start_batch`` dispatches
+        under these keys, and ``tools/rtfdsverify`` proves contracts
+        over it. ``kind='sequence'`` has no AOT path (pytree batches) —
+        empty inventory, skipped warmup, nothing to prove."""
+        from real_time_fraud_detection_system_tpu.runtime.engine import (
+            DispatchSignature,
+        )
+
+        if self.kind == "sequence":
+            return []
+        zmode_kinds = ("tree", "forest", "gbt")
+        total = self.n_dev * self.rows_per_shard
+        return [
+            DispatchSignature(
+                key=("sharded", routed),
+                variant="sharded-routed" if routed else "sharded-local",
+                kind=self.kind,
+                z_mode=self.z_mode if self.kind in zmode_kinds else None,
+                bucket=total,
+                donate=(0,),  # make_sharded_step donates the state tree
+                selective=bool(self._selective),
+                emit_dtype=self.cfg.runtime.emit_dtype,
+                use_pallas=bool(self.cfg.runtime.use_pallas),
+            )
+            for routed in (False, True)
+        ]
+
+    def _ensure_step(self, routed: bool):
+        """THE lazy build+cache+meter point for both step variants —
+        shared by the hot path (``_start_batch``), warmup
+        (``precompile`` via ``signature_step``) and the verifier, so
+        the serving program, the compiled program and the proven
+        program are one object. Templates carry pytree structure only
+        (``_sds``); the built jit serves live arrays identically."""
+        cached = (self._sharded_step_routed if routed
+                  else self._sharded_step)
+        if cached is not None:
+            return cached
+        build = (self._sharded_build_routed if routed
+                 else self._sharded_build)
+        total = self.n_dev * self.rows_per_shard
+        step = build(
+            self._sds(self.state.feature_state),
+            self._sds(self.state.params),
+            self._sds(self.state.scaler),
+            jax.ShapeDtypeStruct((7, total), jnp.int32),
+        )
+        self._m_step_builds.inc()
+        if routed:
+            self._sharded_step_routed = step
+        else:
+            self._sharded_step = step
+        return step
+
+    def signature_step(self, sig):
+        """The shard_map step the signature dispatches to — the same
+        lazily-built jit object ``_start_batch`` serves, so a
+        lower/trace of this callable IS the serving program."""
+        return self._ensure_step(sig.variant == "sharded-routed")
+
     def precompile(self) -> dict:
         """AOT-compile BOTH sharded step variants before the first poll.
 
-        The sharded step has one shape family (chunks are always
-        ``[7, n_dev * rows_per_shard]``), but TWO lazily-built variants:
-        the owner-local step and the dense-spill ROUTED step, which
+        Iterates :meth:`dispatch_inventory` (the routed variant
         otherwise first compiles on a hot-key overflow deep into serving
-        — a real mid-stream compile (969 ms measured vs 8 ms
-        steady-state) landing exactly when load spikes. Both compile
-        here, via the same ``.lower(...).compile()`` path as the
-        single-chip engine (shape-only templates; no step executes).
+        — a real mid-stream compile, 969 ms measured vs 8 ms
+        steady-state, landing exactly when load spikes) via the same
+        ``.lower(...).compile()`` path as the single-chip engine
+        (shape-only templates; no step executes).
         """
-        if self.kind == "sequence":
-            # the sequence steps are built in __init__ with a single
-            # chunk shape; their AOT path is not wired (pytree batches)
+        inventory = self.dispatch_inventory()
+        if not inventory:  # kind='sequence' (no AOT path: pytree batches)
             return {"buckets": [], "variants": 0, "seconds": 0.0,
                     "skipped": "sequence"}
         t0 = time.perf_counter()
@@ -357,32 +420,18 @@ class ShardedScoringEngine(ScoringEngine):
         self._ensure_sharded()
         self.state.params = jax.tree.map(jnp.asarray, self.state.params)
         self._aot_params_sig = self._params_sig(self.state.params)
-        fstate_t = self._sds(self.state.feature_state)
-        params_t = self._sds(self.state.params)
-        scaler_t = self._sds(self.state.scaler)
-        total = self.n_dev * self.rows_per_shard
-        batch_t = jax.ShapeDtypeStruct((7, total), jnp.int32)
         variants = 0
         with self.tracer.span("precompile"):
-            for routed, build in ((False, self._sharded_build),
-                                  (True, self._sharded_build_routed)):
-                key = ("sharded", routed)
-                if key in self._aot:
+            for sig in inventory:
+                if sig.key in self._aot:
                     continue
-                # templates carry pytree structure only; SDS trees serve
-                step = build(fstate_t, params_t, scaler_t, batch_t)
-                if routed and self._sharded_step_routed is None:
-                    self._m_step_builds.inc()
-                    self._sharded_step_routed = step
-                elif not routed and self._sharded_step is None:
-                    self._m_step_builds.inc()
-                    self._sharded_step = step
-                self._aot[key] = step.lower(
-                    fstate_t, params_t, scaler_t, batch_t).compile()
+                step = self.signature_step(sig)
+                self._aot[sig.key] = step.lower(
+                    *self.signature_templates(sig)).compile()
                 self._m_precompiled.inc()
                 variants += 1
         return {
-            "buckets": [total],
+            "buckets": sorted({s.bucket for s in inventory}),
             "variants": variants,
             "seconds": round(time.perf_counter() - t0, 3),
         }
@@ -497,23 +546,7 @@ class ShardedScoringEngine(ScoringEngine):
                 jbatch,
                 static=(self.kind, routed, self.n_dev, self.z_mode))
             with self._recompile.step(sig):
-                if routed:
-                    if self._sharded_step_routed is None:
-                        self._m_step_builds.inc()
-                        self._sharded_step_routed = \
-                            self._sharded_build_routed(
-                                self.state.feature_state, self.state.params,
-                                self.state.scaler, jbatch,
-                            )
-                    step = self._sharded_step_routed
-                else:
-                    if self._sharded_step is None:
-                        self._m_step_builds.inc()
-                        self._sharded_step = self._sharded_build(
-                            self.state.feature_state, self.state.params,
-                            self.state.scaler, jbatch,
-                        )
-                    step = self._sharded_step
+                step = self._ensure_step(routed)
                 fstate, params, probs, feats = self._dispatch_step(
                     ("sharded", routed), step,
                     self.state.feature_state, self.state.params,

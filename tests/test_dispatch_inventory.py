@@ -1,0 +1,150 @@
+"""dispatch_inventory() ≡ what precompile() actually compiles.
+
+The PR-11 acceptance bar: the inventory is the SINGLE enumeration of
+the device plane's reachable programs — warmup compiles exactly it
+(registry-counted via ``rtfds_precompiled_steps_total``), for both
+engines, across z_modes and selective emission. A drifted inventory
+here would make the verifier's coverage proof vacuous, so this file
+pins the equivalence at runtime too.
+"""
+
+import dataclasses as dc
+
+import numpy as np
+import pytest
+
+from real_time_fraud_detection_system_tpu.config import (
+    Config,
+    FeatureConfig,
+    RuntimeConfig,
+)
+from real_time_fraud_detection_system_tpu.features.spec import N_FEATURES
+from real_time_fraud_detection_system_tpu.models.forest import (
+    for_device,
+    synthetic_ensemble,
+)
+from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+from real_time_fraud_detection_system_tpu.runtime.engine import (
+    ScoringEngine,
+)
+from real_time_fraud_detection_system_tpu.utils.metrics import (
+    MetricsRegistry,
+)
+
+
+def _cfg(**runtime_kw):
+    return Config(
+        features=FeatureConfig(customer_capacity=128,
+                               terminal_capacity=256,
+                               cms_width=1 << 10),
+        runtime=dc.replace(
+            RuntimeConfig(batch_buckets=(64, 256), max_batch_rows=256),
+            **runtime_kw),
+    )
+
+
+def _scaler():
+    return Scaler(mean=np.zeros(N_FEATURES, np.float32),
+                  scale=np.ones(N_FEATURES, np.float32))
+
+
+def _forest_params():
+    return for_device(synthetic_ensemble(4, 3, N_FEATURES), N_FEATURES)
+
+
+@pytest.mark.parametrize("z_mode,selective", [
+    ("f32", False),
+    ("int8", False),
+    ("int8", True),
+])
+def test_single_engine_inventory_matches_precompile(z_mode, selective):
+    reg = MetricsRegistry()
+    cfg = _cfg(z_mode=z_mode,
+               emit_threshold=0.9 if selective else 0.0)
+    eng = ScoringEngine(cfg, "forest", _forest_params(), _scaler(),
+                        metrics=reg)
+    inv = eng.dispatch_inventory()
+    assert [s.bucket for s in inv] == [64, 256]
+    assert all(s.z_mode == z_mode for s in inv)
+    assert all(s.selective == selective for s in inv)
+    before = reg.get("rtfds_precompiled_steps_total").value
+    eng.precompile()
+    # registry-counted: one compiled executable per inventory signature
+    assert reg.get("rtfds_precompiled_steps_total").value - before \
+        == len(inv)
+    assert sorted(eng._aot) == sorted(s.key for s in inv)
+    # idempotent: a second precompile adds nothing
+    eng.precompile()
+    assert reg.get("rtfds_precompiled_steps_total").value - before \
+        == len(inv)
+
+
+def test_sharded_engine_inventory_matches_precompile():
+    from real_time_fraud_detection_system_tpu.runtime.sharded_engine \
+        import ShardedScoringEngine
+
+    reg = MetricsRegistry()
+    eng = ShardedScoringEngine(
+        _cfg(z_mode="int8"), "forest", _forest_params(), _scaler(),
+        n_devices=2, rows_per_shard=32, metrics=reg)
+    inv = eng.dispatch_inventory()
+    assert sorted(s.key for s in inv) == [("sharded", False),
+                                          ("sharded", True)]
+    assert all(s.bucket == 64 for s in inv)  # 2 devices × 32 rows
+    before = reg.get("rtfds_precompiled_steps_total").value
+    eng.precompile()
+    assert reg.get("rtfds_precompiled_steps_total").value - before \
+        == len(inv)
+    assert sorted(eng._aot) == sorted(s.key for s in inv)
+    # BOTH lazily-built variants exist now — no hot-key overflow can
+    # pay a first compile mid-stream
+    assert eng._sharded_step is not None
+    assert eng._sharded_step_routed is not None
+    # idempotent
+    eng.precompile()
+    assert reg.get("rtfds_precompiled_steps_total").value - before \
+        == len(inv)
+
+
+def test_sharded_sequence_inventory_is_empty():
+    """kind='sequence' has no AOT path (pytree batches): the inventory
+    says so, and precompile's manifest agrees."""
+    from real_time_fraud_detection_system_tpu.models.sequence import (
+        init_transformer,
+    )
+    from real_time_fraud_detection_system_tpu.runtime.sharded_engine \
+        import ShardedScoringEngine
+
+    cfg = _cfg()
+    params = init_transformer(d_model=16, n_heads=2, n_layers=1,
+                              d_ff=32)
+    eng = ShardedScoringEngine(cfg, "sequence", params, _scaler(),
+                               n_devices=2, rows_per_shard=32,
+                               metrics=MetricsRegistry())
+    assert eng.dispatch_inventory() == []
+    assert eng.precompile().get("skipped") == "sequence"
+
+
+def test_inventory_keys_are_the_runtime_dispatch_keys():
+    """The key precompile() caches under is byte-identical to the key
+    _dispatch_step looks up: ("step", 7, pad) from the packed batch's
+    shape. A batch through every bucket must dispatch AOT (zero
+    fallbacks), which is only true if the keys agree."""
+    reg = MetricsRegistry()
+    eng = ScoringEngine(_cfg(z_mode="f32"), "forest", _forest_params(),
+                        _scaler(), metrics=reg)
+    eng.precompile()
+    rng = np.random.default_rng(0)
+    for n in (10, 200):  # pads to 64 and 256
+        cols = {
+            "tx_id": np.arange(n, dtype=np.int64),
+            "kafka_ts_ms": np.zeros(n, dtype=np.int64),
+            "customer_id": rng.integers(0, 100, n).astype(np.int64),
+            "terminal_id": rng.integers(0, 200, n).astype(np.int64),
+            "tx_datetime_us": np.arange(n, dtype=np.int64) * 1_000_000,
+            "tx_amount_cents": rng.integers(1, 10_000, n).astype(
+                np.int64),
+        }
+        eng.process_batch(cols)
+    assert reg.get("rtfds_aot_fallbacks_total").value == 0
+    assert eng._aot, "fallback path silently dropped the AOT cache"

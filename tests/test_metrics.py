@@ -519,7 +519,14 @@ def test_instrumentation_overhead_bounded():
     + 2 gauge sets + 1 latency observe, measured over 2000 synthetic
     batches. The acceptance bar is <=3% of engine throughput; at the
     tier-1 bench's ~10ms batches that allows 300µs — assert an order of
-    magnitude under it so the margin is structural, not luck."""
+    magnitude under it so the margin is structural, not luck.
+
+    Measured with ``time.process_time`` (CPU time), NOT wall clock: the
+    tier-1 suite shares host cores with whatever else CI runs, and a
+    descheduled slice mid-loop used to trip the wall-clock bound in a
+    test about OUR overhead, not the scheduler's (the one load-flaky F
+    of PRs 8-9). CPU time charges only this process.
+    """
     import time
 
     reg = MetricsRegistry()
@@ -531,7 +538,7 @@ def test_instrumentation_overhead_bounded():
     last = reg.gauge("rtfds_last_batch_unix_seconds")
     depth = reg.gauge("rtfds_queue_depth")
     n = 2000
-    t0 = time.perf_counter()
+    t0 = time.process_time()
     for i in range(n):
         for h in phases:
             h.observe(0.003)
@@ -540,7 +547,7 @@ def test_instrumentation_overhead_bounded():
         lat.observe(0.01)
         last.set(1e9)
         depth.set(2)
-    per_batch = (time.perf_counter() - t0) / n
+    per_batch = (time.process_time() - t0) / n
     assert per_batch < 30e-6, f"instrumentation {per_batch * 1e6:.1f}µs/batch"
 
 

@@ -1,13 +1,27 @@
 """Packaging surface: pyproject + Makefile (the reference's installable-
 system role, ``pyproject.toml:1-30`` + ``Makefile:1-58``)."""
 
+import importlib.util
 import os
-import tomllib
+
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# Capability skip, not a collection error: tomllib is stdlib only from
+# py3.11 — on 3.10 the pyproject test SKIPS with a precise reason
+# instead of erroring the whole file's collection under
+# --continue-on-collection-errors (the Makefile/bench tests below don't
+# need tomllib and keep running).
+_HAS_TOMLLIB = importlib.util.find_spec("tomllib") is not None
 
+
+@pytest.mark.skipif(
+    not _HAS_TOMLLIB,
+    reason="tomllib is stdlib from py3.11; pyproject parsing needs it")
 def test_pyproject_parses_and_script_resolves():
+    import tomllib
+
     with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
         meta = tomllib.load(f)
     proj = meta["project"]

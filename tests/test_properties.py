@@ -8,7 +8,15 @@ cheap and the point is input diversity, not volume.
 
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
+
+# Capability skip, not a collection error: hypothesis is an optional
+# test dependency (absent on the py3.10 CI image) — skip the property
+# suite with a precise reason; the fixed-seed differential tests in
+# the unit suites still cover the exactness claims.
+pytest.importorskip(
+    "hypothesis",
+    reason="hypothesis not installed; property-based invariants need it")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from real_time_fraud_detection_system_tpu.core import native
 from real_time_fraud_detection_system_tpu.core.batch import (

@@ -1105,10 +1105,13 @@ def test_update_baseline_roundtrip(tmp_path):
 def test_json_report_schema(tmp_path):
     res = lint_tree(tmp_path, {"pkg/mod.py": "X = 1\n"})
     d = res.to_json()
-    assert d["version"] == 1
+    assert d["version"] == 2
     assert set(d["summary"]) == {"active", "gate_failures", "suppressed",
                                 "baselined"}
     assert isinstance(d["rules"], dict)
+    # the device-contract verifier block is always present: None means
+    # "not run" (plain lint), a dict means `--verify-device` ran
+    assert d["verifier"] is None
 
 
 def test_parse_error_is_p0(tmp_path):
@@ -1238,3 +1241,99 @@ def test_analyzer_self_check_clean():
     res = run_lint(REPO, targets=["tools/rtfdslint"], baseline_path=None)
     bad = [f for f in res.findings if f.severity in ("P0", "P1")]
     assert bad == [], [f.render() for f in bad]
+
+
+# --------------------------------------------------------------------------
+# rule: config-flag-drift (CLI flags ↔ config fields ↔ README knobs)
+# --------------------------------------------------------------------------
+
+DRIFT_CLI = """
+    import argparse
+    import dataclasses as _dc
+
+    from real_time_fraud_detection_system_tpu.config import Config
+
+    def cmd_score(args):
+        cfg = Config()
+        cfg = cfg.replace(runtime=_dc.replace(
+            cfg.runtime,
+            pipeline_depth=args.pipeline_depth,
+            bogus_field=1,
+        ))
+        return args.used_flag
+
+    def main():
+        ap = argparse.ArgumentParser()
+        ap.add_argument("--pipeline-depth", type=int, default=2)
+        ap.add_argument("--used-flag")
+        ap.add_argument("--dead-flag")
+        args = ap.parse_args()
+        return cmd_score(args)
+"""
+
+DRIFT_CONFIG = """
+    from dataclasses import dataclass
+
+    @dataclass(frozen=True)
+    class RuntimeConfig:
+        pipeline_depth: int = 2
+        secret_knob: int = 0
+"""
+
+DRIFT_README = """
+    `pipeline_depth` is documented here.
+
+    ```bash
+    rtfds score --pipeline-depth 4 --ghost-flag
+    rtfds score --used-flag x --dead-flag y
+    ```
+"""
+
+
+def test_config_flag_drift_fires_on_every_direction(tmp_path):
+    res = lint_tree(
+        tmp_path,
+        {f"{PKG}/cli.py": DRIFT_CLI, f"{PKG}/config.py": DRIFT_CONFIG},
+        targets=[PKG], readme=DRIFT_README,
+        rules=["config-flag-drift", "undocumented-config-knob"])
+    got = {(f.rule, f.context) for f in res.findings}
+    # documented flag that argparse never defines
+    assert ("config-flag-drift", "--ghost-flag") in got, names(res)
+    # parsed flag nothing ever reads
+    assert ("config-flag-drift", "--dead-flag") in got, names(res)
+    # replace() keyword that is no RuntimeConfig field
+    assert ("config-flag-drift", "runtime.bogus_field") in got, names(res)
+    # RuntimeConfig field the README never mentions
+    assert ("undocumented-config-knob", "secret_knob") in got, names(res)
+    # the documented, parsed, read, real-field knob stays quiet
+    assert not any(c == "--pipeline-depth" or c == "pipeline_depth"
+                   for _, c in got), names(res)
+
+
+def test_config_flag_drift_quiet_on_consistent_surface(tmp_path):
+    clean_cli = DRIFT_CLI.replace("            bogus_field=1,\n", "") \
+        .replace('        ap.add_argument("--dead-flag")\n', "")
+    clean_readme = DRIFT_README.replace(" --ghost-flag", "") \
+        .replace("    rtfds score --used-flag x --dead-flag y\n", "") \
+        + "\n`secret_knob` is documented now.\n"
+    res = lint_tree(
+        tmp_path,
+        {f"{PKG}/cli.py": clean_cli, f"{PKG}/config.py": DRIFT_CONFIG},
+        targets=[PKG], readme=clean_readme,
+        rules=["config-flag-drift", "undocumented-config-knob"])
+    assert [f for f in res.findings
+            if f.rule in ("config-flag-drift",
+                          "undocumented-config-knob")] == [], names(res)
+
+
+def test_config_flag_drift_skips_partial_runs(tmp_path):
+    """A focused run over one subdir must not judge the whole knob
+    surface (same gating as metric-name-drift)."""
+    res = lint_tree(
+        tmp_path,
+        {f"{PKG}/cli.py": DRIFT_CLI, f"{PKG}/config.py": DRIFT_CONFIG,
+         f"{PKG}/core/x.py": "A = 1\n"},
+        targets=[f"{PKG}/core"], readme=DRIFT_README,
+        rules=["config-flag-drift"])
+    assert [f for f in res.findings
+            if f.rule == "config-flag-drift"] == [], names(res)

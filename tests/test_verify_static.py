@@ -1,0 +1,203 @@
+"""Tier-1 device-contract verification gate — the in-process twin of
+``make verify-static``.
+
+Mirrors the PR-8 lint-gate contract one level down, on traced
+programs:
+
+1. The repo's default verification matrix proves CLEAN: zero
+   unbaselined P0/P1 findings over every dispatch signature (AOT
+   coverage, z-mode exactness, donation safety, Pallas admission),
+   with no stale baseline entries and no accumulating P2s.
+2. The gate is evidence of verifier SENSITIVITY, not vacuity: a seeded
+   uncovered bucket, a laundered f32→bf16 cast inside the int8 scoring
+   path, and an over-budget Pallas block must EACH produce a P0 under
+   the same checks that just passed the repo.
+3. The coverage proof cannot drift from warmup: ``precompile()``
+   consumes ``dispatch_inventory()`` — substituting the inventory
+   changes exactly what compiles, for BOTH engines.
+4. The baseline workflow round-trips: absorbing a finding (reason
+   required) silences exactly it, and a fixed finding reports stale.
+"""
+
+import os
+import sys
+
+import jax.numpy as jnp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from rtfdsverify import run_verify  # noqa: E402
+from rtfdsverify.runner import update_baseline  # noqa: E402
+from rtfdsverify.targets import make_target  # noqa: E402
+
+
+def test_repo_default_matrix_verifies_clean():
+    res = run_verify(REPO)  # default targets + committed baseline
+    gate = res.gate_failures()
+    assert gate == [], "unbaselined P0/P1 device-contract findings:\n" \
+        + "\n".join(f.render() for f in gate)
+    assert res.stale_baseline == [], res.stale_baseline
+    p2 = [f for f in res.findings if f.severity == "P2"]
+    assert p2 == [], "advisory findings crept in:\n" + "\n".join(
+        f.render() for f in p2)
+    # the matrix actually covered signatures (a vacuous pass would
+    # verify nothing and still exit 0)
+    assert res.signatures_verified >= 10
+
+
+def _uncovered_bucket_target():
+    t = make_target("forest", name="fixture/uncovered", z_mode="int8")
+    full = t.engine.dispatch_inventory
+    t.engine.dispatch_inventory = lambda: full()[:-1]  # drop a bucket
+    return t
+
+
+def _laundered_cast_target():
+    t = make_target("forest", name="fixture/laundered", z_mode="int8")
+    orig = t.engine._predict
+    t.engine._predict = lambda p, x: orig(
+        p, x.astype(jnp.bfloat16).astype(jnp.float32))
+    return t
+
+
+def _over_budget_pallas_target():
+    from real_time_fraud_detection_system_tpu.models.forest import (
+        for_device,
+        synthetic_ensemble,
+    )
+
+    big = for_device(synthetic_ensemble(10, 10, 15), 15)
+    return make_target("forest", name="fixture/overbudget",
+                       z_mode="int8", use_pallas=True, params=big)
+
+
+def test_gate_is_sensitive_not_vacuous():
+    """The three acceptance fixtures must EACH produce a P0 under the
+    exact checks that just passed the repo."""
+    res = run_verify(REPO, targets=[
+        _uncovered_bucket_target(),
+        _laundered_cast_target(),
+        _over_budget_pallas_target(),
+    ], baseline_path=None)
+    rendered = "\n".join(f.render() for f in res.findings)
+    assert any(f.rule == "aot-coverage" and "uncovered" in f.message
+               and f.severity == "P0" for f in res.findings), rendered
+    assert any(f.rule == "zmode-exactness"
+               and "bfloat16" in f.message for f in res.findings), rendered
+    assert any(f.rule == "pallas-admission"
+               and "budget" in f.message for f in res.findings), rendered
+    assert res.gate_failures(), "seeded contract breaks did not gate"
+
+
+def test_nan_guard_donation_claim_flags():
+    """An inventory claiming donation under the nan-guard is a P0 (the
+    guard's rollback re-reads pre-batch state after dispatch). Seeded
+    by flipping the CONFIG claim under a donation-on engine — exactly
+    the drift a refactor of the guard's donation-off dance would
+    introduce."""
+    import dataclasses as dc
+
+    t = make_target("forest", z_mode="int8")
+    eng = t.engine
+    eng.cfg = eng.cfg.replace(runtime=dc.replace(
+        eng.cfg.runtime, nan_guard=True))
+    res = run_verify(REPO, targets=[t], baseline_path=None,
+                     checks=["donation-safety"])
+    assert any(f.severity == "P0" and "nan_guard" in f.message
+               for f in res.findings), [f.render() for f in res.findings]
+
+
+def test_precompile_consumes_inventory_single_engine():
+    """Acceptance: substituting dispatch_inventory() changes exactly
+    what precompile() compiles — the coverage proof and warmup share
+    one enumeration and cannot drift."""
+    t = make_target("logreg")
+    eng = t.engine
+    full = eng.dispatch_inventory()
+    assert len(full) == 2  # (64, 256) buckets in the template config
+    eng.dispatch_inventory = lambda: full[:1]
+    manifest = eng.precompile()
+    assert sorted(eng._aot) == [full[0].key]
+    assert manifest["buckets"] == [full[0].bucket]
+    # the dropped signature is exactly what the verifier's coverage
+    # check now flags as a P0
+    from rtfdsverify.checks import AotCoverageCheck
+
+    traced = {s.key: eng.signature_step(s).trace(
+        *eng.signature_templates(s)) for s in eng.dispatch_inventory()}
+    findings = list(AotCoverageCheck().run(
+        t, eng.dispatch_inventory(), traced))
+    assert any(f.severity == "P0" and str(full[1].key) in f.message
+               for f in findings), [f.render() for f in findings]
+
+
+def test_precompile_consumes_inventory_sharded_engine():
+    t = make_target("forest", sharded=True, z_mode="f32")
+    eng = t.engine
+    full = eng.dispatch_inventory()
+    assert [s.variant for s in full] == ["sharded-local",
+                                         "sharded-routed"]
+    eng.dispatch_inventory = lambda: [s for s in full
+                                      if s.variant == "sharded-local"]
+    eng.precompile()
+    assert sorted(eng._aot) == [("sharded", False)]
+
+
+def test_baseline_round_trip(tmp_path):
+    """Absorb a live P0 with a reason → gate goes clean; fix the
+    finding → the entry reports stale."""
+    bl = tmp_path / "verify_baseline.json"
+    res = run_verify(REPO, targets=[_over_budget_pallas_target()],
+                     baseline_path=None)
+    assert res.gate_failures()
+    n = update_baseline(REPO, res, str(bl),
+                        "fixture: over-budget ensemble accepted")
+    assert n >= 1
+    res2 = run_verify(REPO, targets=[_over_budget_pallas_target()],
+                      baseline_path=str(bl))
+    assert res2.gate_failures() == [], [
+        f.render() for f in res2.gate_failures()]
+    assert res2.baselined and res2.stale_baseline == []
+    # entry carries the reason (a reason-less entry refuses to load)
+    import json
+
+    data = json.loads(bl.read_text())
+    assert all(str(e.get("reason", "")).strip()
+               for e in data["entries"])
+    # fixed finding: a healthy target leaves the entry stale
+    res3 = run_verify(REPO, targets=[
+        make_target("forest", z_mode="int8")], baseline_path=str(bl))
+    assert res3.stale_baseline, "fixed finding should report stale"
+
+
+def test_inventory_facts_reflect_engine_config():
+    """The inventory's static facts are the engine's served facts."""
+    t = make_target("forest", name="selective", z_mode="int8",
+                    emit_threshold=0.9)
+    sigs = t.engine.dispatch_inventory()
+    assert all(s.selective for s in sigs)
+    assert all(s.z_mode == "int8" for s in sigs)
+    assert all(s.donate == (0,) for s in sigs)
+    assert {s.bucket for s in sigs} == {64, 256}
+    # non-ensemble kinds carry no z contraction
+    assert all(s.z_mode is None
+               for s in make_target("logreg").engine.dispatch_inventory())
+
+
+def test_lint_json_schema_carries_verifier_block():
+    """`rtfds lint --json` (the --verify-device path) embeds the
+    verifier's findings under "verifier" and folds its gate into the
+    lint verdict — one JSON, one exit status, both analysis levels."""
+    from rtfdslint.runner import LintResult
+
+    vres = run_verify(REPO, targets=[_over_budget_pallas_target()],
+                      baseline_path=None)
+    assert vres.gate_failures()
+    lres = LintResult()
+    lres.verifier = vres
+    d = lres.to_json()
+    assert d["verifier"]["summary"]["gate_failures"] >= 1
+    assert d["verifier"]["findings"][0]["rule"] == "pallas-admission"
+    # the combined gate fails even though the LINT side is clean
+    assert lres.gate_failures()

@@ -55,6 +55,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="also list pragma-suppressed/baselined findings")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
+    ap.add_argument("--verify-device", action="store_true",
+                    help="also run the jaxpr-level device-contract "
+                         "verifier (tools/rtfdsverify — needs jax, "
+                         "CPU-only) and fold its findings into the "
+                         "report and gate; --json carries them under "
+                         "\"verifier\"")
     return ap
 
 
@@ -75,6 +81,34 @@ def main(argv: Optional[List[str]] = None) -> int:
     except (BaselineError, FileNotFoundError, ValueError) as e:
         print(f"rtfdslint: {e}", file=sys.stderr)
         return 2
+    if args.verify_device:
+        if args.update_baseline:
+            # each tool owns its baseline file; folding verifier
+            # findings into the LINT baseline would mis-file them
+            print("rtfdslint: --update-baseline does not combine with "
+                  "--verify-device (use `rtfds verify-device "
+                  "--update-baseline` for verifier findings)",
+                  file=sys.stderr)
+            return 2
+        # lazy sibling import: the verifier needs jax; plain lint runs
+        # stay stdlib-only
+        tools_dir = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        if tools_dir not in sys.path:
+            sys.path.insert(0, tools_dir)
+        try:
+            from rtfdsverify.runner import run_verify
+        except ImportError as e:
+            print(f"rtfdslint: --verify-device needs tools/rtfdsverify "
+                  f"and a working jax ({e})", file=sys.stderr)
+            return 2
+        vb = (None if args.no_baseline
+              else "tools/rtfdsverify/baseline.json")
+        try:
+            result.verifier = run_verify(root, baseline_path=vb)
+        except (BaselineError, ValueError) as e:
+            print(f"rtfdslint: verify-device: {e}", file=sys.stderr)
+            return 2
     if args.update_baseline:
         if args.no_baseline:
             # the prior baseline would not load, so its still-matching
@@ -102,8 +136,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"rtfdslint: baseline now holds {n} entr"
               f"{'y' if n == 1 else 'ies'} at {args.baseline}")
         return 0
-    print(render_json(result, strict=args.strict) if args.json
-          else render_human(result, verbose=args.verbose,
-                            strict=args.strict))
+    if args.json:
+        print(render_json(result, strict=args.strict))
+    else:
+        print(render_human(result, verbose=args.verbose,
+                           strict=args.strict))
+        if result.verifier is not None:
+            from rtfdsverify.runner import render_human as verify_render
+
+            print()
+            print(verify_render(result.verifier, verbose=args.verbose,
+                                strict=args.strict))
     failures = result.gate_failures(strict=args.strict)
     return 1 if failures else 0

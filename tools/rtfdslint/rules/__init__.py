@@ -1,6 +1,7 @@
 """Rule plugins — importing this package registers every rule."""
 
 from . import blocking_calls  # noqa: F401
+from . import config_drift  # noqa: F401
 from . import exceptions  # noqa: F401
 from . import jit_hazards  # noqa: F401
 from . import metric_drift  # noqa: F401

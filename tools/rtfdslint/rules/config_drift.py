@@ -1,0 +1,253 @@
+"""config-flag-drift: CLI flags, config fields and README knob docs agree.
+
+The metric-name-drift rule's sibling for the CONFIG surface. The
+operator-facing knob path is README example → argparse flag →
+``dataclasses.replace(cfg.<plane>, field=…)`` → frozen config field;
+a break anywhere on it is silent at review time and embarrassing at
+runtime:
+
+* a ``--flag`` shown on an ``rtfds`` command line in the README that no
+  ``add_argument`` defines → the documented invocation exits 2 (P1);
+* a flag ``add_argument`` parses whose dest no code ever reads
+  (``args.<dest>`` / ``getattr(args, "<dest>")``) → a silent no-op knob
+  the operator believes they set (P1);
+* a ``replace(cfg.<plane>, keyword=…)`` keyword that is not a field of
+  that plane's dataclass → TypeError on a path that may only run in
+  production (P1);
+* a ``RuntimeConfig`` field the README never mentions (literally or as
+  its ``--dashed-flag`` spelling) → an operator-invisible serving knob,
+  the config twin of ``undocumented-metric`` (P2, reported as
+  ``undocumented-config-knob``).
+
+Approximations (deliberate): dest-read detection accepts a matching
+string constant inside a tuple/list literal (the CLI's forwarding
+loops iterate such tuples over ``getattr``); README flag extraction
+only looks at ``rtfds``-bearing command lines inside fenced code
+blocks, so prose mentions and other tools' flags never false-positive.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Set, Tuple
+
+from ..finding import Finding
+from ..project import PACKAGE_NAME, Project, PyFile
+from ..registry import register
+
+_FLAG_RE = re.compile(r"--[a-z][a-z0-9-]*")
+_FENCE_RE = re.compile(r"^```")
+
+
+def _collect_flags(pf: PyFile) -> Dict[str, Tuple[str, int]]:
+    """long flag → (dest, line) over every ``add_argument`` call."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for n in ast.walk(pf.tree):
+        if not (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "add_argument"):
+            continue
+        longs = [a.value for a in n.args
+                 if isinstance(a, ast.Constant) and isinstance(a.value, str)
+                 and a.value.startswith("--")]
+        if not longs:
+            continue  # positional argument: not a knob surface
+        dest = None
+        for kw in n.keywords:
+            if kw.arg == "dest" and isinstance(kw.value, ast.Constant):
+                dest = kw.value.value
+        if dest is None:
+            dest = longs[0].lstrip("-").replace("-", "_")
+        for f in longs:
+            out.setdefault(f, (dest, n.lineno))
+    return out
+
+
+def _collect_dest_reads(pf: PyFile) -> Set[str]:
+    """Names provably read off an ``args`` namespace."""
+    reads: Set[str] = set()
+    for n in ast.walk(pf.tree):
+        if isinstance(n, ast.Attribute) and isinstance(n.value, ast.Name) \
+                and n.value.id == "args":
+            reads.add(n.attr)
+        elif isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                and n.func.id == "getattr" and n.args \
+                and isinstance(n.args[0], ast.Name) \
+                and n.args[0].id == "args":
+            if len(n.args) > 1 and isinstance(n.args[1], ast.Constant):
+                reads.add(str(n.args[1].value))
+        elif isinstance(n, (ast.Tuple, ast.List)):
+            # forwarding-loop idiom: `for flag in ("json", ...):
+            # getattr(args, flag)` — accept tuple/list string literals
+            for el in n.elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value,
+                                                               str):
+                    reads.add(el.value)
+    return reads
+
+
+def _config_fields(pf: PyFile) -> Dict[str, Dict[str, int]]:
+    """dataclass name → {field name: line} for every class in config.py."""
+    out: Dict[str, Dict[str, int]] = {}
+    for n in ast.walk(pf.tree):
+        if not isinstance(n, ast.ClassDef):
+            continue
+        fields: Dict[str, int] = {}
+        for stmt in n.body:
+            if isinstance(stmt, ast.AnnAssign) \
+                    and isinstance(stmt.target, ast.Name):
+                fields[stmt.target.id] = stmt.lineno
+        out[n.name] = fields
+    return out
+
+
+def _readme_rtfds_flags(text: str) -> Dict[str, int]:
+    """--flags used on rtfds command lines in fenced blocks → first line."""
+    out: Dict[str, int] = {}
+    in_fence = False
+    carry = ""
+    carry_line = 0
+    for i, line in enumerate(text.splitlines(), start=1):
+        if _FENCE_RE.match(line.strip()):
+            in_fence = not in_fence
+            carry = ""
+            continue
+        if not in_fence:
+            continue
+        if carry:
+            line, lineno = carry + " " + line.strip(), carry_line
+        else:
+            lineno = i
+        if line.rstrip().endswith("\\"):
+            carry, carry_line = line.rstrip()[:-1], lineno
+            continue
+        carry = ""
+        # strip comments: a '# ... --flag' remark is prose, not a knob
+        code = line.split("#", 1)[0]
+        if "rtfds" not in code:
+            continue
+        for m in _FLAG_RE.finditer(code):
+            out.setdefault(m.group(0), lineno)
+    return out
+
+
+#: cfg attribute → config.py dataclass holding its fields
+_PLANES = {
+    "data": "DataConfig", "features": "FeatureConfig",
+    "model": "ModelConfig", "train": "TrainConfig",
+    "runtime": "RuntimeConfig", "learn": "LearnConfig",
+    "mesh": "MeshConfig",
+}
+
+
+def _replace_calls(pf: PyFile) -> Iterable[Tuple[str, List[str], int]]:
+    """(plane attr, keyword names, line) per ``*.replace(cfg.<plane>, …)``
+    and ``cfg.replace(<plane>=…)`` call."""
+    for n in ast.walk(pf.tree):
+        if not (isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "replace"):
+            continue
+        # dataclasses.replace(cfg.runtime, kw=...) — any module alias
+        if n.args and isinstance(n.args[0], ast.Attribute) \
+                and n.args[0].attr in _PLANES:
+            kws = [kw.arg for kw in n.keywords if kw.arg]
+            yield n.args[0].attr, kws, n.lineno
+        # cfg.replace(runtime=..., learn=...) carries plane OBJECTS, not
+        # field keywords — nothing to check there
+    return
+
+
+@register
+class ConfigFlagDriftRule:
+    name = "config-flag-drift"
+    doc = ("CLI flags ↔ config fields ↔ README knob docs: a documented "
+           "rtfds flag must exist, a parsed flag must be read, and "
+           "replace() keywords must be real config fields")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        if PACKAGE_NAME not in project.target_specs:
+            # whole-surface contract, same gating as metric-name-drift:
+            # a partial run sees a partial flag/field set and every
+            # verdict would be noise
+            return []
+        cli = project.files.get(f"{PACKAGE_NAME}/cli.py")
+        cfg = project.files.get(f"{PACKAGE_NAME}/config.py")
+        if cli is None or cli.tree is None or cfg is None \
+                or cfg.tree is None:
+            return []
+        out: List[Finding] = []
+        flags = _collect_flags(cli)
+        reads = _collect_dest_reads(cli)
+        classes = _config_fields(cfg)
+
+        # 1) README rtfds command lines name only real flags
+        for flag, line in sorted(_readme_rtfds_flags(
+                project.readme_text).items()):
+            if flag not in flags:
+                out.append(Finding(
+                    rule=self.name, severity="P1",
+                    path=project.readme_rel, line=line,
+                    message=(f"{flag} appears on an rtfds command line "
+                             "but no add_argument defines it — the "
+                             "documented invocation exits 2"),
+                    context=flag))
+
+        # 2) every parsed flag's dest is read somewhere
+        dests_seen: Set[str] = set()
+        for flag, (dest, line) in sorted(flags.items()):
+            if dest in dests_seen:
+                continue
+            dests_seen.add(dest)
+            if dest not in reads:
+                out.append(Finding(
+                    rule=self.name, severity="P1", path=cli.relpath,
+                    line=line,
+                    message=(f"{flag} is parsed into args.{dest} but "
+                             "nothing ever reads it — the knob is a "
+                             "silent no-op"),
+                    context=flag))
+
+        # 3) replace(cfg.<plane>, keyword=…) keywords are real fields
+        for plane, kws, line in _replace_calls(cli):
+            fields = classes.get(_PLANES[plane], {})
+            for kw in kws:
+                if fields and kw not in fields:
+                    out.append(Finding(
+                        rule=self.name, severity="P1", path=cli.relpath,
+                        line=line,
+                        message=(f"replace(cfg.{plane}, {kw}=…) names no "
+                                 f"{_PLANES[plane]} field — TypeError on "
+                                 "a path that may only run in "
+                                 "production"),
+                        context=f"{plane}.{kw}"))
+
+        # 4) every RuntimeConfig serving knob is documented in README
+        readme = project.readme_text
+        for field, line in sorted(classes.get("RuntimeConfig",
+                                              {}).items()):
+            dashed = "--" + field.replace("_", "-")
+            if field in readme or dashed in readme:
+                continue
+            out.append(Finding(
+                rule="undocumented-config-knob", severity="P2",
+                path=cfg.relpath, line=line,
+                message=(f"RuntimeConfig.{field} is a serving knob the "
+                         "README never mentions (document it, or its "
+                         f"{dashed} flag spelling)"),
+                context=field))
+        return out
+
+
+@register
+class UndocumentedConfigKnobRule:
+    """Catalog/pragma name holder; produced by ConfigFlagDriftRule
+    (the runner follows ``produced_by`` for focused ``--rule`` runs)."""
+
+    produced_by = "config-flag-drift"
+    name = "undocumented-config-knob"
+    doc = ("RuntimeConfig field absent from the README (an "
+           "operator-invisible serving knob)")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        return []

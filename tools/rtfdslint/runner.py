@@ -23,14 +23,20 @@ class LintResult:
     stale_baseline: List[dict] = field(default_factory=list)
     stats: Dict[str, RuleStats] = field(default_factory=dict)
     files_scanned: int = 0
+    #: optional rtfdsverify.VerifyResult attached by --verify-device;
+    #: its gate failures fold into this result's verdict
+    verifier: object = None
 
     def gate_failures(self, strict: bool = False) -> List[Finding]:
         bad = ("P0", "P1") if not strict else ("P0", "P1", "P2")
-        return [f for f in self.findings if f.severity in bad]
+        out = [f for f in self.findings if f.severity in bad]
+        if self.verifier is not None:
+            out += self.verifier.gate_failures(strict=strict)
+        return out
 
     def to_json(self, strict: bool = False) -> dict:
         return {
-            "version": 1,
+            "version": 2,
             "files_scanned": self.files_scanned,
             "strict": strict,
             "findings": [f.to_json() for f in self.findings],
@@ -38,6 +44,12 @@ class LintResult:
             "baselined": [f.to_json() for f in self.baselined],
             "stale_baseline_entries": self.stale_baseline,
             "rules": {k: v.to_json() for k, v in sorted(self.stats.items())},
+            # Device-contract verifier block (tools/rtfdsverify): None
+            # unless the caller ran it (`rtfds lint --verify-device`) —
+            # the key is always present so JSON consumers can detect
+            # "not run" vs "ran clean" without schema sniffing.
+            "verifier": (self.verifier.to_json(strict=strict)
+                         if self.verifier is not None else None),
             "summary": {
                 "active": len(self.findings),
                 "gate_failures": len(self.gate_failures(strict=strict)),

@@ -1,0 +1,109 @@
+"""Argparse front-end: ``python -m rtfdsverify`` / ``rtfds
+verify-device``. Forces ``JAX_PLATFORMS=cpu`` before jax initializes —
+the proofs are backend-independent shape/jaxpr facts and must never
+wait on (or wake) an accelerator."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+
+def _find_root(start: str) -> str:
+    cur = os.path.abspath(start)
+    while True:
+        if os.path.isdir(os.path.join(
+                cur, "real_time_fraud_detection_system_tpu")):
+            return cur
+        nxt = os.path.dirname(cur)
+        if nxt == cur:
+            return os.path.abspath(start)
+        cur = nxt
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="rtfds verify-device",
+        description=("jaxpr-level device-contract verifier: AOT "
+                     "coverage, z-mode exactness, donation safety, "
+                     "Pallas VMEM admission — proven on traced "
+                     "programs before a stream starts (CPU-only, no "
+                     "weights)"))
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: discovered from cwd)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default "
+                         "tools/rtfdsverify/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (show everything)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="absorb current P0/P1 findings into the "
+                         "baseline")
+    ap.add_argument("--reason", default="",
+                    help="reason recorded on NEW baseline entries "
+                         "(required with --update-baseline)")
+    ap.add_argument("--check", action="append", default=None,
+                    help="run only this check (repeatable)")
+    ap.add_argument("--strict", action="store_true",
+                    help="P2 findings also fail the gate")
+    ap.add_argument("--verbose", action="store_true",
+                    help="also list baselined findings")
+    ap.add_argument("--list-checks", action="store_true",
+                    help="print the check catalog and exit")
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    # Force CPU BEFORE jax (transitively) initializes: the verifier
+    # must run identically on a laptop, in CI, and beside a TPU.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    args = build_parser().parse_args(argv)
+    from rtfdslint.baseline import BaselineError
+
+    from .checks import all_checks
+    from .runner import (
+        DEFAULT_BASELINE,
+        render_human,
+        run_verify,
+        update_baseline,
+    )
+
+    if args.list_checks:
+        for cls in all_checks():
+            print(f"{cls.name:24s} {cls.doc}")
+        return 0
+    root = args.root or _find_root(os.getcwd())
+    baseline = None if args.no_baseline \
+        else (args.baseline or DEFAULT_BASELINE)
+    try:
+        result = run_verify(root, baseline_path=baseline,
+                            checks=args.check)
+    except (BaselineError, ValueError) as e:
+        print(f"rtfdsverify: {e}", file=sys.stderr)
+        return 2
+    if args.update_baseline:
+        if args.no_baseline:
+            print("rtfdsverify: --update-baseline cannot be combined "
+                  "with --no-baseline (prior entries must be loaded to "
+                  "be preserved)", file=sys.stderr)
+            return 2
+        if not args.reason.strip():
+            print("rtfdsverify: --update-baseline requires --reason "
+                  "'why these findings are accepted'", file=sys.stderr)
+            return 2
+        n = update_baseline(root, result,
+                            args.baseline or DEFAULT_BASELINE,
+                            args.reason.strip())
+        print(f"rtfdsverify: baseline now holds {n} entr"
+              f"{'y' if n == 1 else 'ies'}")
+        return 0
+    print(json.dumps(result.to_json(strict=args.strict), indent=2)
+          if args.json
+          else render_human(result, verbose=args.verbose,
+                            strict=args.strict))
+    return 1 if result.gate_failures(strict=args.strict) else 0

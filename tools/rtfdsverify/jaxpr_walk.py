@@ -1,0 +1,212 @@
+"""Jaxpr traversal helpers: flatten nested programs, classify dots.
+
+Everything the checks need from a traced program, in one place:
+
+* :func:`iter_eqns` — depth-first over every equation including the
+  jaxprs nested inside ``pjit``/``scan``/``while``/``cond``/custom-vjp
+  wrappers and ``pallas_call`` kernels (any eqn param that holds a
+  Jaxpr/ClosedJaxpr, recursively);
+* :func:`all_avals` — every abstract value the program touches
+  (invars, outvars, constvars, every eqn's operands/results) — the set
+  the no-laundered-downcast lattice check walks;
+* :func:`bool_derived_vars` — the transitive closure of values produced
+  by boolean comparisons through exactness-preserving ops (convert,
+  broadcast, reshape, transpose, select-of-bools) — "provably tiny
+  integer" provenance, which is what licenses a reduced-precision or
+  unpinned contraction in the z-mode contract.
+
+Pure jax introspection: no device, no compilation, no weights.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Set, Tuple
+
+from jax._src import core as jax_core
+
+#: primitives whose outputs stay exact-small-integer when their inputs
+#: are (the provenance closure follows these from a bool compare)
+_EXACTNESS_PRESERVING = {
+    "convert_element_type", "broadcast_in_dim", "reshape", "transpose",
+    "squeeze", "expand_dims", "copy", "slice", "dynamic_slice",
+    "concatenate", "rev", "gather", "select_n", "stop_gradient",
+}
+
+#: comparison primitives — their boolean outputs root the provenance
+_COMPARE_PRIMS = {"lt", "le", "gt", "ge", "eq", "ne"}
+
+
+def _sub_jaxprs(params: dict) -> Iterator["jax_core.Jaxpr"]:
+    """Every Jaxpr nested in an eqn's params (pjit/scan/cond/pallas…)."""
+    for val in params.values():
+        vals = val if isinstance(val, (tuple, list)) else (val,)
+        for v in vals:
+            if isinstance(v, jax_core.ClosedJaxpr):
+                yield v.jaxpr
+            elif isinstance(v, jax_core.Jaxpr):
+                yield v
+
+
+def iter_eqns(jaxpr) -> Iterator["jax_core.JaxprEqn"]:
+    """All equations of ``jaxpr`` and every nested sub-jaxpr."""
+    if isinstance(jaxpr, jax_core.ClosedJaxpr):
+        jaxpr = jaxpr.jaxpr
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn.params):
+            yield from iter_eqns(sub)
+
+
+def all_avals(jaxpr) -> List:
+    """Every aval the program (and nested programs) touches."""
+    if isinstance(jaxpr, jax_core.ClosedJaxpr):
+        jaxpr = jaxpr.jaxpr
+    out = [v.aval for v in jaxpr.invars + jaxpr.constvars + jaxpr.outvars]
+    for eqn in iter_eqns(jaxpr):
+        for v in eqn.invars:
+            if isinstance(v, jax_core.Var):
+                out.append(v.aval)
+        out.extend(v.aval for v in eqn.outvars)
+    return out
+
+
+def dtypes_used(jaxpr) -> Set[str]:
+    """String dtype names of every aval in the program."""
+    out: Set[str] = set()
+    for av in all_avals(jaxpr):
+        dt = getattr(av, "dtype", None)
+        if dt is not None:
+            out.add(str(dt))
+    return out
+
+
+def _walk_scope(jaxpr, bool_vars: Set[int]) -> None:
+    """One scope of the provenance closure (ids are per-Var object ids —
+    Vars are unique objects within a jaxpr)."""
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        sub = list(_sub_jaxprs(eqn.params))
+        if name in _COMPARE_PRIMS:
+            for ov in eqn.outvars:
+                bool_vars.add(id(ov))
+            continue
+        if sub:
+            # Map outer boolean operands onto each sub-jaxpr's invars so
+            # the provenance survives a pjit/scan boundary, then lift
+            # boolean sub-outputs back to the eqn's outvars.
+            for s in sub:
+                # conservative positional map (trailing args align for
+                # pjit/closed_call; scan carries consts first — a miss
+                # only makes the check stricter, never unsound)
+                scoped = set(bool_vars)
+                outer = [v for v in eqn.invars
+                         if isinstance(v, jax_core.Var)]
+                k = min(len(outer), len(s.invars))
+                for ov, iv in zip(outer[-k:], s.invars[-k:]):
+                    if id(ov) in bool_vars:
+                        scoped.add(id(iv))
+                _walk_scope(s, scoped)
+                for ov, sv in zip(eqn.outvars, s.outvars):
+                    if isinstance(sv, jax_core.Var) \
+                            and id(sv) in scoped:
+                        bool_vars.add(id(ov))
+            continue
+        if name in _EXACTNESS_PRESERVING:
+            operand_vars = [v for v in eqn.invars
+                            if isinstance(v, jax_core.Var)]
+            if operand_vars and all(
+                    id(v) in bool_vars
+                    or str(getattr(v.aval, "dtype", "")) == "bool"
+                    for v in operand_vars):
+                for ov in eqn.outvars:
+                    bool_vars.add(id(ov))
+
+
+def bool_derived_vars(jaxpr) -> Set[int]:
+    """ids of Vars whose values are provably 0/1-derived (from boolean
+    comparisons through exactness-preserving ops). Conservative: a miss
+    makes the exactness check STRICTER (flags more), never unsound."""
+    if isinstance(jaxpr, jax_core.ClosedJaxpr):
+        jaxpr = jaxpr.jaxpr
+    bool_vars: Set[int] = set()
+    # seed: any invar already boolean
+    for v in jaxpr.invars + jaxpr.constvars:
+        if str(getattr(v.aval, "dtype", "")) == "bool":
+            bool_vars.add(id(v))
+    _walk_scope(jaxpr, bool_vars)
+    return bool_vars
+
+
+def dot_report(jaxpr) -> List[dict]:
+    """One record per ``dot_general`` in the program (nested included):
+    operand/output dtypes, precision, preferred_element_type, and
+    whether the LHS is bool-derived (exact tiny integers)."""
+    out: List[dict] = []
+
+    def _scope(j, bools: Set[int]) -> None:
+        for eqn in j.eqns:
+            name = eqn.primitive.name
+            sub = list(_sub_jaxprs(eqn.params))
+            if name == "dot_general":
+                lhs, rhs = eqn.invars[0], eqn.invars[1]
+                out.append({
+                    "lhs_dtype": str(lhs.aval.dtype),
+                    "rhs_dtype": str(rhs.aval.dtype),
+                    "out_dtype": str(eqn.outvars[0].aval.dtype),
+                    "precision": eqn.params.get("precision"),
+                    "preferred": str(
+                        eqn.params.get("preferred_element_type")),
+                    # einsum may put either factor on either side: the
+                    # z-contraction license needs "one operand is the
+                    # 0/1 decision matrix", wherever it landed
+                    "lhs_bool_derived": (
+                        isinstance(lhs, jax_core.Var)
+                        and id(lhs) in bools),
+                    "rhs_bool_derived": (
+                        isinstance(rhs, jax_core.Var)
+                        and id(rhs) in bools),
+                })
+            for s in sub:
+                inner = set(bools)
+                outer = [v for v in eqn.invars
+                         if isinstance(v, jax_core.Var)]
+                k = min(len(outer), len(s.invars))
+                for ov, iv in zip(outer[-k:], s.invars[-k:]):
+                    if id(ov) in bools:
+                        inner.add(id(iv))
+                # recompute provenance inside the sub-scope too
+                inner |= bool_derived_vars(s)
+                _scope(s, inner)
+
+    if isinstance(jaxpr, jax_core.ClosedJaxpr):
+        jaxpr = jaxpr.jaxpr
+    _scope(jaxpr, bool_derived_vars(jaxpr))
+    return out
+
+
+def converts_report(jaxpr) -> List[Tuple[str, str, bool]]:
+    """(src_dtype, dst_dtype, src_bool_derived) per convert_element_type."""
+    out: List[Tuple[str, str, bool]] = []
+
+    def _scope(j, bools: Set[int]) -> None:
+        for eqn in j.eqns:
+            if eqn.primitive.name == "convert_element_type":
+                src = eqn.invars[0]
+                out.append((
+                    str(src.aval.dtype),
+                    str(eqn.outvars[0].aval.dtype),
+                    (not isinstance(src, jax_core.Var))
+                    or id(src) in bools
+                    or str(src.aval.dtype) == "bool",
+                ))
+            for s in _sub_jaxprs(eqn.params):
+                _scope(s, bools | bool_derived_vars(s))
+
+    if isinstance(jaxpr, jax_core.ClosedJaxpr):
+        jaxpr = jaxpr.jaxpr
+    _scope(jaxpr, bool_derived_vars(jaxpr))
+    return out
+
+
+def has_primitive(jaxpr, name: str) -> bool:
+    return any(eqn.primitive.name == name for eqn in iter_eqns(jaxpr))

@@ -1,0 +1,154 @@
+"""Template verification targets: weightless engines, real programs.
+
+A target is a fully-built engine (single-chip or sharded) whose params
+are SYNTHETIC but shape-faithful (``models/forest.synthetic_ensemble``/
+``models/gbt.synthetic_gbt`` — valid structure, arbitrary values), so
+the traced program is EXACTLY the serving program for that
+configuration while nothing ever needs data, training, or a device.
+
+The default matrix covers the device-plane contract surface the
+runtime can serve: the tree-ensemble kinds across the full z-mode
+lattice (f32/bf16/int8 — the exactness contract's domain), selective
+emission packing, the fused-Pallas gate, a non-ensemble control
+(logreg), and the sharded engine's local+routed variants. Buckets are
+kept small (tracing cost scales with program count, not rows — the
+contracts are shape-generic), and every engine runs ``scorer='tpu'``
+semantics on the CPU backend: same traced program, no hardware.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+ENGINE_ANCHOR = "real_time_fraud_detection_system_tpu/runtime/engine.py"
+SHARDED_ANCHOR = (
+    "real_time_fraud_detection_system_tpu/runtime/sharded_engine.py")
+
+
+@dataclass
+class VerifyTarget:
+    name: str       # stable label ("forest/int8", "sharded/forest/int8"…)
+    engine: object  # built ScoringEngine / ShardedScoringEngine
+    anchor: str     # repo-relative path findings anchor to
+    line: int = 1
+
+
+def _identity_scaler():
+    import numpy as np
+
+    from real_time_fraud_detection_system_tpu.features.spec import (
+        N_FEATURES,
+    )
+    from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+
+    return Scaler(
+        mean=np.zeros(N_FEATURES, np.float32),
+        scale=np.ones(N_FEATURES, np.float32),
+    )
+
+
+def _base_config(**runtime_kw):
+    import dataclasses as dc
+
+    from real_time_fraud_detection_system_tpu.config import (
+        Config,
+        FeatureConfig,
+        RuntimeConfig,
+    )
+
+    return Config(
+        features=FeatureConfig(customer_capacity=128,
+                               terminal_capacity=256,
+                               cms_width=1 << 10),
+        runtime=dc.replace(
+            RuntimeConfig(batch_buckets=(64, 256), max_batch_rows=256),
+            **runtime_kw),
+    )
+
+
+def _params_for(kind: str, n_trees: int = 4, depth: int = 3):
+    from real_time_fraud_detection_system_tpu.features.spec import (
+        N_FEATURES,
+    )
+
+    if kind in ("tree", "forest"):
+        from real_time_fraud_detection_system_tpu.models.forest import (
+            synthetic_ensemble,
+        )
+
+        return synthetic_ensemble(n_trees, depth, N_FEATURES)
+    if kind == "gbt":
+        from real_time_fraud_detection_system_tpu.models.gbt import (
+            synthetic_gbt,
+        )
+
+        return synthetic_gbt(n_trees, depth, N_FEATURES)
+    if kind == "logreg":
+        from real_time_fraud_detection_system_tpu.models.logreg import (
+            init_logreg,
+        )
+
+        return init_logreg(N_FEATURES)
+    raise ValueError(f"no synthetic template for kind {kind!r}")
+
+
+def make_target(kind: str, name: Optional[str] = None,
+                sharded: bool = False, n_trees: int = 4, depth: int = 3,
+                params=None, **runtime_kw) -> VerifyTarget:
+    """Build one verification target. ``runtime_kw`` land on
+    ``RuntimeConfig`` (z_mode, emit_threshold, use_pallas, …);
+    ``params`` overrides the synthetic template (the over-budget
+    Pallas fixture passes an oversized ensemble)."""
+    import jax
+    import jax.numpy as jnp
+
+    cfg = _base_config(**runtime_kw)
+    params = params if params is not None else _params_for(
+        kind, n_trees, depth)
+    if sharded:
+        from real_time_fraud_detection_system_tpu.runtime.sharded_engine \
+            import ShardedScoringEngine
+
+        eng = ShardedScoringEngine(
+            cfg, kind, params, _identity_scaler(),
+            n_devices=min(2, jax.device_count()), rows_per_shard=32)
+        anchor = SHARDED_ANCHOR
+    else:
+        from real_time_fraud_detection_system_tpu.runtime.engine import (
+            ScoringEngine,
+        )
+
+        eng = ScoringEngine(cfg, kind, params, _identity_scaler())
+        anchor = ENGINE_ANCHOR
+    # Commit scalar leaves to arrays exactly like precompile() does, so
+    # the traced dtypes are the runtime-served dtypes.
+    eng.state.params = jax.tree.map(jnp.asarray, eng.state.params)
+    label = name or (("sharded/" if sharded else "") + kind
+                     + (f"/{runtime_kw['z_mode']}"
+                        if "z_mode" in runtime_kw else ""))
+    return VerifyTarget(name=label, engine=eng, anchor=anchor)
+
+
+def build_default_targets() -> List[VerifyTarget]:
+    """The standard verification matrix (see module docstring)."""
+    out: List[VerifyTarget] = []
+    for zm in ("f32", "bf16", "int8"):
+        out.append(make_target("forest", z_mode=zm))
+    out.append(make_target("gbt", z_mode="int8"))
+    out.append(make_target("logreg"))
+    # selective emission compiles the packed-transfer program
+    out.append(make_target("forest", name="forest/int8/selective",
+                           z_mode="int8", emit_threshold=0.9))
+    # the fused-Pallas gate (trace-time admission on static shapes)
+    out.append(make_target("forest", name="forest/int8/pallas",
+                           z_mode="int8", use_pallas=True))
+    # sharded local + routed variants
+    out.append(make_target("forest", sharded=True, z_mode="int8"))
+    return out
+
+
+#: registry of named target-list builders (CLI --matrix)
+MATRICES: dict = {
+    "default": build_default_targets,
+}
