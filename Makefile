@@ -113,6 +113,17 @@ lint-static:
 verify-static:
 	JAX_PLATFORMS=cpu $(PY) -m real_time_fraud_detection_system_tpu.cli verify-device
 
+# overload-survival gate: under an injected traffic burst the
+# hysteresis ladder must climb rung-by-rung (shed optional work ->
+# largest AOT bucket + alerts-only -> whole-batch deferral to the
+# durable spill), descend fully once pressure subsides, replay every
+# deferred batch in order with gap/dup-free sink lineage, pay zero
+# mid-stream recompiles across the whole cycle, and finish with scores
+# bit-identical to an unthrottled control run (scored + deferred ==
+# polled, asserted from the registry)
+overload-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_overload_smoke.py -q
+
 # continuous-learning gate: champion serves, the streaming learner
 # trains a candidate on injected labeled feedback, the shadow's live
 # recall overtakes the champion's, promotion fires, an injected
@@ -162,4 +173,4 @@ install:
 clean:
 	rm -rf $(OUT)
 
-.PHONY: demo datagen train score run-all query dashboard connectors dryrun trace-demo bench perf-smoke chaos-smoke recovery-smoke learn-smoke lint-static verify-static test integration integration-up integration-down sqlcheck install clean
+.PHONY: demo datagen train score run-all query dashboard connectors dryrun trace-demo bench perf-smoke chaos-smoke recovery-smoke overload-smoke learn-smoke lint-static verify-static test integration integration-up integration-down sqlcheck install clean

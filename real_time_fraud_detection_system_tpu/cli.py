@@ -509,6 +509,30 @@ def cmd_score(args) -> int:
         log.error("--decode-workers and --prefetch-batches must be >= 0, "
                   "got %s / %s", args.decode_workers, args.prefetch_batches)
         return 2
+    try:
+        overload_cfg = _dc.replace(
+            cfg.runtime.overload,
+            enabled=args.overload,
+            spill_path=args.overload_spill,
+            lag_high_rows=args.overload_lag_high,
+            climb_pressure=args.overload_climb_pressure,
+            descend_pressure=args.overload_descend_pressure,
+            climb_dwell_batches=args.overload_climb_dwell,
+            descend_dwell_batches=args.overload_descend_dwell,
+            max_deferred_batches=args.overload_max_deferred,
+        )
+    except ValueError as e:
+        log.error("--overload thresholds: %s", e)
+        return 2
+    if args.overload:
+        log.info(
+            "overload ladder on: climb >= %.2f for %d, descend <= %.2f "
+            "for %d, lag high %s rows, spill %r",
+            overload_cfg.climb_pressure, overload_cfg.climb_dwell_batches,
+            overload_cfg.descend_pressure,
+            overload_cfg.descend_dwell_batches,
+            overload_cfg.lag_high_rows or "off",
+            overload_cfg.spill_path or "(memory only)")
     cfg = cfg.replace(runtime=_dc.replace(
         cfg.runtime,
         emit_features=not args.alerts_only,
@@ -534,6 +558,7 @@ def cmd_score(args) -> int:
         checkpoint_full_every=args.checkpoint_full_every,
         checkpoint_op_timeout_s=args.checkpoint_op_timeout,
         checkpoint_op_attempts=args.checkpoint_op_attempts,
+        overload=overload_cfg,
     ))
     cfg = cfg.replace(learn=_dc.replace(
         cfg.learn,
@@ -2214,6 +2239,43 @@ def main(argv=None) -> int:
                         "is re-scored without them BEFORE the running "
                         "feature state is contaminated (serializes the "
                         "pipeline to depth 1 while on)")
+    p.add_argument("--overload", action="store_true",
+                   help="overload-survival ladder: under sustained "
+                        "pressure (batch p50 vs --latency-slo-ms, "
+                        "source lag, queue fill) shed optional work, "
+                        "then force the largest AOT bucket + alerts-"
+                        "only emission, then defer whole micro-batches "
+                        "to a durable spill and replay them in order "
+                        "on recovery — degrade, never die (README "
+                        "section 'Overload survival playbook')")
+    p.add_argument("--overload-spill", default="overload_spill",
+                   help="durable spill for rung-3 deferred batches "
+                        "(*.jsonl = JSONL, else a parquet directory; "
+                        "idempotent by tx_id, reason=shed)")
+    p.add_argument("--overload-lag-high", type=int, default=0,
+                   help="source-lag normalization: this many backlogged "
+                        "rows == pressure 1.0 (0 = lag signal off)")
+    p.add_argument("--overload-climb-pressure", type=float, default=1.0,
+                   help="climb one rung after --overload-climb-dwell "
+                        "consecutive observations at or above this "
+                        "normalized pressure")
+    p.add_argument("--overload-descend-pressure", type=float,
+                   default=0.6,
+                   help="descend one rung after --overload-descend-"
+                        "dwell consecutive observations at or below "
+                        "this pressure (must be < climb: the gap is "
+                        "the anti-flap hysteresis band)")
+    p.add_argument("--overload-climb-dwell", type=int, default=3,
+                   help="consecutive high-pressure observations before "
+                        "each climb")
+    p.add_argument("--overload-descend-dwell", type=int, default=6,
+                   help="consecutive low-pressure observations before "
+                        "each descent")
+    p.add_argument("--overload-max-deferred", type=int, default=512,
+                   help="memory bound on deferred micro-batches; at the "
+                        "cap the queue head replays through scoring to "
+                        "make room and the rest of the backlog stays "
+                        "in the source/broker")
     p.add_argument("--devices", type=int, default=1,
                    help="serve on an N-device mesh (sharded engine: "
                         "customer-partitioned rows, all_to_all terminal "

@@ -152,6 +152,70 @@ class TrainConfig:
 
 
 @dataclass(frozen=True)
+class OverloadConfig:
+    """Overload-survival ladder (``runtime/overload.py``).
+
+    The reference has no overload story at all: Spark micro-batches just
+    fall behind and Kafka lag grows without bound. When enabled, the
+    engine runs an explicit hysteresis state machine over the registry
+    signals it already emits (windowed batch latency vs
+    ``latency_slo_ms``, source lag, prefetch/sink queue fill) and climbs
+    a reversible degradation ladder: rung 1 sheds optional work (shadow
+    scoring, learner training, flight-recorder sampling), rung 2 forces
+    the largest AOT batch bucket + alerts-only emission, rung 3 defers
+    whole micro-batches to a durable spill and replays them in order
+    once pressure subsides — the stream degrades and recovers, it never
+    dies and never silently drops a row (``scored + deferred ==
+    polled``)."""
+
+    enabled: bool = False
+    # Durable overflow spill for rung-3 deferral (the PR 4 dead-letter
+    # machinery, reason=shed, idempotent by tx_id): ``*.jsonl`` = JSONL
+    # file, anything else = parquet part directory. "" = memory-only
+    # deferral (still ordered and replayed, but a crash loses the
+    # spilled copy and relies on checkpoint replay alone).
+    spill_path: str = "overload_spill"
+    # Hysteresis: climb one rung after ``climb_dwell_batches``
+    # consecutive observations at pressure >= ``climb_pressure``;
+    # descend one rung after ``descend_dwell_batches`` consecutive
+    # observations at pressure <= ``descend_pressure``. The gap between
+    # the two thresholds plus the dwell counts is what makes flapping
+    # impossible: a single spike can neither climb nor descend.
+    climb_pressure: float = 1.0
+    descend_pressure: float = 0.6
+    climb_dwell_batches: int = 3
+    descend_dwell_batches: int = 6
+    # Source-lag normalization: lag of this many rows == pressure 1.0
+    # (0 disables the lag signal; latency/queue signals still work).
+    lag_high_rows: int = 0
+    # Windowed p50 batch-latency signal (vs runtime.latency_slo_ms).
+    latency_window_batches: int = 8
+    # Host-memory bound on rung-3 deferral: at most this many deferred
+    # micro-batches are held (spilled + in memory) at once. At the cap
+    # the controller replays the queue head through scoring to make
+    # room, so the backlog beyond it stays in the source/broker — the
+    # one buffer that is allowed to be unbounded, and visibly so via
+    # rtfds_source_lag_rows.
+    max_deferred_batches: int = 512
+    # Flight-recorder sampling while any rung is active (rung 1's
+    # "drop the recorder to sampled mode"): record every k-th batch.
+    recorder_sample_every: int = 16
+
+    def __post_init__(self):
+        if not 0.0 <= self.descend_pressure < self.climb_pressure:
+            raise ValueError(
+                "overload hysteresis needs 0 <= descend_pressure < "
+                f"climb_pressure, got {self.descend_pressure} / "
+                f"{self.climb_pressure}")
+        if self.climb_dwell_batches < 1 or self.descend_dwell_batches < 1:
+            raise ValueError("overload dwell counts must be >= 1")
+        if self.max_deferred_batches < 1:
+            raise ValueError("overload.max_deferred_batches must be >= 1")
+        if self.recorder_sample_every < 1:
+            raise ValueError("overload.recorder_sample_every must be >= 1")
+
+
+@dataclass(frozen=True)
 class RuntimeConfig:
     """Micro-batch engine (replaces Spark Structured Streaming triggers:
     5 s sinks ``kafka_s3_sink_customers.py:179``, 10 s scorer
@@ -307,6 +371,8 @@ class RuntimeConfig:
     # doubling, capped; 0 = the legacy hot restart loop). Stall restarts
     # never back off — they already waited out the stall budget.
     restart_backoff_ms: float = 0.0
+    # Overload-survival degradation ladder (see OverloadConfig).
+    overload: OverloadConfig = field(default_factory=OverloadConfig)
 
     def __post_init__(self):
         if self.z_mode not in ("auto", "f32", "bf16", "int8"):

@@ -451,6 +451,11 @@ _EVENT_CLASS = {"fault": "serious", "restart": "serious",
                 "poison": "serious", "dead_letter": "serious",
                 "gave_up": "serious", "checkpoint_fallback": "serious",
                 "checkpoint": "info", "feedback": "good",
+                # overload ladder (runtime/overload.py): climbs and
+                # rung-3 deferral are warnings (degraded, surviving);
+                # descents and in-order replays are recovery
+                "overload_climb": "warning", "shed": "warning",
+                "overload_descend": "good", "replay": "good",
                 # continuous-learning plane (runtime/learner.py)
                 "model_published": "info", "model_candidate": "info",
                 "model_reload": "info", "model_promoted": "good",
@@ -528,6 +533,7 @@ def render_ops_html(
         f"<style>{_CSS}"
         ".ev { stroke-width: 2; }"
         ".ev.serious { stroke: var(--st-serious); }"
+        ".ev.warning { stroke: var(--st-warn); }"
         ".ev.good { stroke: var(--st-good); }"
         ".ev.info { stroke: var(--s1); }"
         "</style></head><body class='viz'>",
@@ -605,6 +611,39 @@ def render_ops_html(
     else:
         tiles.append(("Durable state", "verified",
                       "restores re-checksummed, no fallback"))
+    # Overload tile: did the run degrade, how far, and did everything
+    # deferred come back? Only rendered when the ladder actually moved
+    # (any overload_* / shed / replay event), so steady runs keep a
+    # clean tile row. Replay deficit (shed > replayed) is the headline
+    # problem state: deferred rows never re-entered the stream.
+    climbs = [e for e in events if e.get("event") == "overload_climb"]
+    descends = [e for e in events
+                if e.get("event") == "overload_descend"]
+    shed_rows = sum(int(e.get("rows", 0)) for e in events
+                    if e.get("event") == "shed")
+    replayed_rows = sum(int(e.get("rows", 0)) for e in events
+                        if e.get("event") == "replay")
+    if climbs or descends or shed_rows or replayed_rows:
+        top_rung = max([int(e.get("rung", 0)) for e in climbs],
+                       default=0)
+        # chronological last transition (the events list is in record
+        # order): climbs+descends concatenated would misreport any run
+        # whose second overload episode climbed after a full recovery
+        moves = [e for e in events
+                 if e.get("event") in ("overload_climb",
+                                       "overload_descend")]
+        final_rung = int(moves[-1].get("rung", 0)) if moves else 0
+        if shed_rows > replayed_rows:
+            sub = (f"{_compact(shed_rows - replayed_rows)} shed rows "
+                   "NEVER replayed")
+        elif final_rung > 0:
+            sub = f"ended degraded at rung {final_rung}"
+        else:
+            sub = (f"{len(climbs)} climb(s) · "
+                   f"{_compact(shed_rows)} shed, all replayed"
+                   if shed_rows else
+                   f"{len(climbs)} climb(s), fully recovered")
+        tiles.append(("Overload", f"rung {top_rung} peak", sub))
     # Learning tile: which model versions served/shadowed and how the
     # canary ended. Only rendered when the run had a learning loop (any
     # model_* event), so plain serving runs keep a clean tile row.

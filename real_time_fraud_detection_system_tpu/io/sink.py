@@ -501,15 +501,22 @@ def _dlq_row_record(cols: dict, i: int, *, reason: str, error: str,
 class _DeadLetterTelemetry:
     """Shared DLQ instrumentation + flight-record events. The absolute
     row gauge (``rtfds_dead_letter_rows``) is what ``/healthz`` keys its
-    ``degraded`` state on."""
+    ``degraded`` state on.
 
-    def _init_dlq_metrics(self, registry=None) -> None:
+    ``recorder_fn`` overrides where flight events land (a zero-arg
+    callable returning a recorder or None): the overload spill reuses
+    this machinery with a private registry and its own ``shed`` events —
+    deferred-for-replay rows are NOT a triage backlog and must not trip
+    the DLQ ``degraded`` state or the dead-letter dashboard tile."""
+
+    def _init_dlq_metrics(self, registry=None, recorder_fn=None) -> None:
         from real_time_fraud_detection_system_tpu.utils.metrics import (
             active_recorder,
         )
 
         self._reg = registry if registry is not None else get_registry()
-        self._recorder = active_recorder
+        self._recorder = (recorder_fn if recorder_fn is not None
+                          else active_recorder)
         self._m_gauge = self._reg.gauge(
             "rtfds_dead_letter_rows",
             "rows currently quarantined in the dead-letter queue")
@@ -544,14 +551,14 @@ class DeadLetterSink(_DeadLetterTelemetry):
     ``rtfds dlq``.
     """
 
-    def __init__(self, path: str, registry=None):
+    def __init__(self, path: str, registry=None, recorder_fn=None):
         self.path = path
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
         self._lock = threading.Lock()
         self._seen: set = set()
-        self._init_dlq_metrics(registry)
+        self._init_dlq_metrics(registry, recorder_fn)
         if os.path.exists(path):
             for rec in self._iter_file():
                 self._seen.add(int(rec["tx_id"]))
@@ -639,12 +646,12 @@ class ParquetDeadLetterSink(_DeadLetterTelemetry):
     :class:`ParquetSink`. The tx_id seen-set is rebuilt from the parts
     on open (write-side idempotence across restarts)."""
 
-    def __init__(self, directory: str, registry=None):
+    def __init__(self, directory: str, registry=None, recorder_fn=None):
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
         self._lock = threading.Lock()
         self._seen: set = set()
-        self._init_dlq_metrics(registry)
+        self._init_dlq_metrics(registry, recorder_fn)
         for rec in self.read_all():
             self._seen.add(int(rec["tx_id"]))
         self._m_gauge.set(len(self._seen))
@@ -736,12 +743,14 @@ class ParquetDeadLetterSink(_DeadLetterTelemetry):
         pass
 
 
-def make_dead_letter_sink(path: str, registry=None):
+def make_dead_letter_sink(path: str, registry=None, recorder_fn=None):
     """``*.jsonl`` (or an existing plain file) → :class:`DeadLetterSink`;
     anything else → :class:`ParquetDeadLetterSink` directory."""
     if path.endswith(".jsonl") or os.path.isfile(path):
-        return DeadLetterSink(path, registry=registry)
-    return ParquetDeadLetterSink(path, registry=registry)
+        return DeadLetterSink(path, registry=registry,
+                              recorder_fn=recorder_fn)
+    return ParquetDeadLetterSink(path, registry=registry,
+                                 recorder_fn=recorder_fn)
 
 
 def read_dead_letter(path: str) -> List[dict]:

@@ -32,6 +32,10 @@ from real_time_fraud_detection_system_tpu.runtime.faults import (  # noqa: F401
 from real_time_fraud_detection_system_tpu.runtime.autobatch import (  # noqa: F401
     AutoBatchController,
 )
+from real_time_fraud_detection_system_tpu.runtime.overload import (  # noqa: F401
+    LadderActions,
+    OverloadController,
+)
 from real_time_fraud_detection_system_tpu.runtime.prefetch import (  # noqa: F401
     PrefetchSource,
 )

@@ -78,6 +78,12 @@ class AutoBatchController:
         self._window: list = []  # (rows, latency_s) at the CURRENT target
         self._rate_ema = {}  # bucket -> EMA rows/s
         self.adjustments = 0
+        # Overload rung-2 override (runtime/overload.py): while forced,
+        # the target is pinned to the largest bucket and the decision
+        # loop is suspended — the ladder, not the SLO follower, owns the
+        # batching policy under overload (the SLO is already blown; the
+        # follower would fight the ladder by stepping DOWN).
+        self._forced = False
         reg = registry if registry is not None else get_registry()
         self._m_target = reg.gauge(
             "rtfds_autobatch_target_rows",
@@ -96,6 +102,25 @@ class AutoBatchController:
     def target_rows(self) -> int:
         """The coalesce target the next assembly pass should aim for."""
         return self.buckets[self._i]
+
+    def force_max(self) -> None:
+        """Pin the target to the LARGEST bucket (overload rung 2): the
+        per-batch fixed costs amortize best there, and every dispatch
+        stays inside the precompiled AOT inventory. The move counts in
+        the adjustment metrics like any other; decisions stay suspended
+        until :meth:`release_force`."""
+        if self._forced:
+            return
+        self._forced = True
+        self._window = []
+        self._move(len(self.buckets) - 1 - self._i)
+
+    def release_force(self) -> None:
+        """Resume adaptive control from the largest bucket (the ladder
+        descends one rung at a time, so the follower re-explores from
+        where overload left it rather than snapping back)."""
+        self._forced = False
+        self._window = []
 
     def _bucket_for(self, rows: int) -> int:
         """The jit bucket ``rows`` actually padded to (smallest bucket
@@ -123,6 +148,8 @@ class AutoBatchController:
             prev = self._rate_ema.get(b)
             self._rate_ema[b] = rate if prev is None else (
                 self.ema_alpha * rate + (1 - self.ema_alpha) * prev)
+        if self._forced:
+            return  # overload rung 2 owns the target; EMAs stay fresh
         if b != self.target_rows():
             return  # in-flight stragglers from an older target / tails
         self._window.append((int(rows), float(latency_s)))

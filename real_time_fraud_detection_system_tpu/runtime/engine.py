@@ -584,6 +584,14 @@ class ScoringEngine:
         # a LearningLoop attaches.
         self.shadow = None
         self.feedback_tap = None
+        # Overload-ladder host-side degrade flags (runtime/overload.py).
+        # shadow_paused gates shadow scoring without detaching it (rung
+        # 1 sheds it, descent restores it); _shed_features switches to
+        # alerts-only emission WITHOUT touching the compiled step — the
+        # feature matrix simply stays in HBM unfetched, so every
+        # dispatch remains a signature from dispatch_inventory().
+        self.shadow_paused = False
+        self._shed_features = False
         # Param-swap accounting (hot reload × online SGD): True once any
         # online update (in-step SGD on labeled rows, or a feedback SGD
         # step) landed since the last wholesale params swap — a reload
@@ -775,6 +783,43 @@ class ScoringEngine:
     def clear_shadow(self) -> None:
         self.shadow = None
 
+    def _emit_features_now(self) -> bool:
+        """Whether the feature matrix crosses to the host for the batch
+        being finished: the static config gate AND the overload ladder's
+        dynamic rung-2 degrade (host-side only — the compiled step is
+        identical either way, the matrix just stays in HBM unfetched)."""
+        return self.cfg.runtime.emit_features and not self._shed_features
+
+    def set_degraded_emission(self, on: bool) -> bool:
+        """Overload rung 2: switch to alerts-only emission at runtime.
+
+        Refused (returns False, serving unchanged) when some consumer
+        needs host-side feature rows — the cpu oracle, a feedback
+        feature cache, selective emission's packed transfer, or the
+        sequence kind (already alerts-shaped). Shadow scoring is not a
+        blocker: the ladder pauses it at rung 1 before rung 2 can
+        degrade emission, and ``_emit_result`` additionally skips it
+        while features are shed."""
+        if not on:
+            self._shed_features = False
+            return True
+        ok = (self.kind != "sequence"
+              and self.cfg.runtime.emit_features
+              and not self._selective
+              and self.scorer != "cpu"
+              and self.feature_cache is None)
+        self._shed_features = bool(ok)
+        if not ok:
+            from real_time_fraud_detection_system_tpu.utils import (
+                get_logger,
+            )
+
+            get_logger("engine").info(
+                "overload rung 2: alerts-only degrade not applicable to "
+                "this serving mode (a host-side feature consumer is "
+                "wired); batch forcing still applies")
+        return self._shed_features
+
     def _dispatch_step(self, key, jit_fn, *args):
         """Serve from the AOT executable when one exists for ``key``;
         an input-signature rejection permanently falls back to plain jit
@@ -838,7 +883,7 @@ class ScoringEngine:
             if self.scorer != "cpu":
                 targets.append(probs)
             if (feats is not None and self.kind != "sequence"
-                    and self.cfg.runtime.emit_features):
+                    and self._emit_features_now()):
                 targets.append(feats)
         issued = False
         for x in targets:
@@ -1025,8 +1070,9 @@ class ScoringEngine:
         if self._selective:
             probs_np, feats_np = self._unpack_selective(handle)
             return self._finish_result(handle, probs_np, feats_np)
-        if not self.cfg.runtime.emit_features or self.kind == "sequence":
-            # alerts-only mode: the feature matrix stays in HBM. The
+        if not self._emit_features_now() or self.kind == "sequence":
+            # alerts-only mode (configured, or the overload ladder's
+            # rung-2 degrade): the feature matrix stays in HBM. The
             # sequence scorer's matrix is definitionally zeros (raw event
             # channels replace engineered features) — never worth a D2H,
             # and the host-side filler is a shared read-only buffer.
@@ -1164,7 +1210,7 @@ class ScoringEngine:
                 labeled=(np.asarray(in_band) >= 0)
                 if in_band is not None else None,
             )
-        if self.shadow is not None and n:
+        if self.shadow is not None and not self.shadow_paused and n:
             # Dual-score the SAME host feature rows with the candidate
             # (runtime/learner.ShadowScorer): one extra jitted predict on
             # a bucket-padded copy — the serving step's compiled program
@@ -1451,6 +1497,50 @@ class ScoringEngine:
                 registry=self.metrics)
         recorder = self.recorder if self.recorder is not None \
             else active_recorder()
+        overload = None
+        if self.cfg.runtime.overload.enabled:
+            # Overload-survival ladder (runtime/overload.py): the
+            # controller decides from registry signals; these closures
+            # are the engine-side effects of each rung, all reversible.
+            from real_time_fraud_detection_system_tpu.runtime.overload \
+                import LadderActions, OverloadController
+
+            ocfg = self.cfg.runtime.overload
+
+            def _act_shed_optional(on: bool) -> None:
+                # rung 1: optional work off the stream — shadow scoring
+                # and learner training pause through their existing
+                # hooks; the flight recorder thins to sampled records
+                self.shadow_paused = bool(on)
+                if learning is not None:
+                    if on:
+                        learning.pause()
+                    else:
+                        learning.resume()
+                if recorder is not None:
+                    recorder.set_sample_every(
+                        ocfg.recorder_sample_every if on else 1)
+
+            def _act_degrade_emission(on: bool) -> None:
+                # rung 2: alerts-only emission, host-side only (the
+                # compiled step — and dispatch_inventory() — unchanged)
+                self.set_degraded_emission(on)
+
+            def _act_force_max(on: bool) -> None:
+                # rung 2: pin autobatch to the largest AOT bucket
+                if auto is not None:
+                    if on:
+                        auto.force_max()
+                    else:
+                        auto.release_force()
+
+            overload = OverloadController(
+                self.cfg.runtime, registry=self.metrics,
+                actions=LadderActions(
+                    shed_optional=_act_shed_optional,
+                    degrade_emission=_act_degrade_emission,
+                    force_max_batch=_act_force_max),
+                recorder_fn=lambda: recorder)
         phase_hist = self._m_phase
         # Source-poll time since the last finished batch — attributed to
         # the NEXT batch's flight record so per-batch phases sum to the
@@ -1462,6 +1552,7 @@ class ScoringEngine:
         ovf0 = self.selective_overflows
         from collections import deque
 
+        # rtfdslint: disable=unbounded-queue (loop-local in-flight handle FIFO, drained to below pipeline_depth on every dispatch (`while len(q) >= depth: _finish`) — bounded at `depth` by construction; a maxlen would silently drop dispatched device work)
         q: deque = deque()  # in-flight batch handles, FIFO
         if feedback is not None and checkpointer is not None:
             # Feedback offsets must TRAIL the state checkpoint (the same
@@ -1505,6 +1596,13 @@ class ScoringEngine:
                 trackers["sink_write"].record(sink_s)
             if auto is not None:
                 auto.observe(len(res.tx_id), res.latency_s)
+            if overload is not None:
+                rr = handle.pop("overload_replay_rows", None)
+                if rr is not None:
+                    # counted at FINISH: replay accounting reflects
+                    # state updates that landed, not dispatches
+                    overload.note_replayed(rr)
+                overload.observe_batch(len(res.tx_id), res.latency_s)
             if recorder is not None:
                 extra = {}
                 if handle.get("trace_id"):
@@ -1618,7 +1716,35 @@ class ScoringEngine:
             pending["poll_s"] += dt
             return c
 
+        def _launch(cols, offs, replay_rows=None) -> None:
+            """Dispatch one assembled batch into the pipeline (shared by
+            live traffic and overload replay — a replayed deferred batch
+            takes EXACTLY the live path, so its state updates and sink
+            lineage are indistinguishable from never having deferred)."""
+            nonlocal t_last_start
+            if checkpointer is not None and any(
+                h["index"] % every == 0 for h in q
+            ):
+                # A queued batch's completion will checkpoint: drain
+                # first so no newer batch is in flight at save time.
+                _drain()
+            idx = self.state.batches_done + len(q) + 1
+            tid = self.tracer.begin_batch(idx)
+            handle = self._start_batch(cols)
+            t_last_start = time.perf_counter()
+            handle["index"] = idx
+            handle["trace_id"] = tid
+            handle["source_offsets"] = offs
+            if replay_rows is not None:
+                handle["overload_replay_rows"] = replay_rows
+            q.append(handle)
+            self._m_qdepth.set(len(q))
+            while len(q) >= depth:
+                _finish(q.popleft())
+                self._m_qdepth.set(len(q))
+
         exhausted = False
+        capped = False  # max_batches stopped the run (resumable break)
         carry = None  # (cols, offsets): a poll beyond the coalesce cap
         cap = max(self.cfg.runtime.batch_buckets)
         t_last_start = None  # previous batch's dispatch time (pacing)
@@ -1627,6 +1753,7 @@ class ScoringEngine:
                 heartbeat.beat()
             started = self.state.batches_done + len(q)
             if max_batches and started >= max_batches:
+                capped = True
                 break
             if trigger > 0 and t_last_start is not None:
                 # Trigger pacing, once per loop pass on the POLL side:
@@ -1641,6 +1768,17 @@ class ScoringEngine:
                     # rtfdslint: disable=blocking-call-on-loop-thread (sanctioned pacing wait point: --trigger-interval spacing on the poll side, slept time credited as wait; regression-pinned in test_runtime trigger-pacing tests)
                     time.sleep(dt)
                     _add_wait(dt)
+            if overload is not None and overload.want_replay():
+                # Descending from rung 3 (or the spill hit its memory
+                # cap): the deferred FIFO's head replays through the
+                # normal scoring path BEFORE any live poll — rows reach
+                # the feature state in exactly the order a
+                # never-overloaded run would have seen them.
+                item = overload.next_replay()
+                if item is not None:
+                    _launch(item.cols, item.offsets,
+                            replay_rows=item.rows)
+                    continue
             if carry is not None:
                 cols, offs = carry
                 carry = None
@@ -1655,6 +1793,12 @@ class ScoringEngine:
                     # Flush the in-flight batches (their results must not
                     # wait for future traffic), then wait a trigger.
                     _drain()
+                    if overload is not None:
+                        # the quiet period is the ladder's recovery
+                        # window: tick the controller so descend dwell
+                        # accumulates and deferred batches replay even
+                        # if live traffic never returns
+                        overload.idle_tick()
                     if trigger > 0:
                         # rtfdslint: disable=blocking-call-on-loop-thread (sanctioned wait point: idle live source with nothing in flight — sleeping one trigger IS the correct behavior, there is no work to stall)
                         time.sleep(trigger)
@@ -1689,25 +1833,45 @@ class ScoringEngine:
                 if len(parts) > 1:
                     cols = {k: np.concatenate([p[k] for p in parts])
                             for k in parts[0]}
-            if checkpointer is not None and any(
-                h["index"] % every == 0 for h in q
-            ):
-                # A queued batch's completion will checkpoint: drain
-                # first so no newer batch is in flight at save time.
+            if overload is not None and overload.should_defer():
+                # Rung 3 admission control: the whole assembled batch
+                # defers to the durable spill instead of dispatching. It
+                # consumes no batch_index (sink lineage stays gap-free)
+                # and state.offsets stays at the last SCORED batch, so a
+                # crash replays deferred rows from the checkpoint.
+                # Batches dispatched BEFORE the climb finish first —
+                # rung 3 holds nothing in flight, so their results land
+                # instead of idling in the pipeline behind the deferral.
                 _drain()
-            idx = self.state.batches_done + len(q) + 1
-            tid = self.tracer.begin_batch(idx)
-            handle = self._start_batch(cols)
-            t_last_start = time.perf_counter()
-            handle["index"] = idx
-            handle["trace_id"] = tid
-            handle["source_offsets"] = offs
-            q.append(handle)
-            self._m_qdepth.set(len(q))
-            while len(q) >= depth:
-                _finish(q.popleft())
-                self._m_qdepth.set(len(q))
-        _drain()
+                overload.defer(cols, offs)
+                continue
+            _launch(cols, offs)
+        try:
+            if overload is not None and not capped:
+                # Source exhausted with batches still deferred: the
+                # stream must not end owing rows — force-drain the FIFO
+                # through the normal scoring path (scored == polled).
+                # A max_batches stop is different: the cap wins, and the
+                # deferred rows stay durably spilled with state.offsets
+                # still BEHIND them, so a resumed run re-polls them.
+                overload.finish_stream()
+                while True:
+                    if heartbeat is not None:
+                        # a large deferred backlog drains for minutes —
+                        # beat per replayed batch so the stall watchdog
+                        # can tell this healthy drain from a wedge
+                        heartbeat.beat()
+                    item = overload.next_replay()
+                    if item is None:
+                        break
+                    _launch(item.cols, item.offsets,
+                            replay_rows=item.rows)
+            _drain()
+        finally:
+            if overload is not None:
+                # revert every engine-side degrade so a later run() on
+                # this engine starts clean (rung metrics stay honest)
+                overload.deactivate()
         self._m_qdepth.set(0)
         # Async sinks drain before run() returns: the caller's follow-up
         # (final checkpoint save, offset commits, reading the output)

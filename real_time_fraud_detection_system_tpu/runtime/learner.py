@@ -410,7 +410,9 @@ class StreamingLearner:
         # a promotion/rollback reset must never be clobbered by in-flight
         # training descended from the superseded lineage.
         self._gen = 0
+        # rtfdslint: disable=unbounded-queue (replay window: trimmed back under window_rows immediately after every append in _train_chunk — bounded by construction, and the trim must pop WHOLE chunks, which maxlen cannot express)
         self._buf_x: List[np.ndarray] = []
+        # rtfdslint: disable=unbounded-queue (same bounded replay window as _buf_x above — the two lists trim in lockstep)
         self._buf_y: List[np.ndarray] = []
         self._buf_rows = 0
         self._labels_since_publish = 0

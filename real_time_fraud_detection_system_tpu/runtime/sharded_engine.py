@@ -572,7 +572,11 @@ class ShardedScoringEngine(ScoringEngine):
     def _finish_batch(self, handle: dict) -> BatchResult:
         n = handle["n"]
         self._meter_fetch_overlap(handle)
-        emit = self.cfg.runtime.emit_features
+        # _emit_features_now, not the raw config flag: the overload
+        # ladder's rung-2 degrade (inherited run() loop) switches the
+        # mesh engine to alerts-only emission the same host-side way —
+        # the shard_map step and both AOT variants are untouched.
+        emit = self._emit_features_now()
         probs_np = np.zeros(n, dtype=np.float32)
         if self.kind == "sequence" or not emit:
             # nothing below writes the feature matrix on these paths

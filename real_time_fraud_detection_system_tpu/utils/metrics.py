@@ -400,6 +400,10 @@ class FlightRecorder:
         self.path = path
         self.max_bytes = int(max_bytes) if max_bytes else 0
         self._lock = threading.Lock()
+        # Sampled mode (overload rung 1): record every k-th batch
+        # record; event records always land. 1 = every batch.
+        self._sample_every = 1
+        self._batch_tick = 0
         self._f = open(path, "a", encoding="utf-8")
         self.manifest = dict(manifest or {})
         self.manifest.setdefault("start_unix_s", time.time())
@@ -447,9 +451,24 @@ class FlightRecorder:
                                      default=str) + "\n")
         self._f.flush()
 
+    def set_sample_every(self, k: int) -> None:
+        """Batch-record sampling (overload rung 1 drops the recorder to
+        sampled mode; 1 restores full recording). Events — rung
+        transitions, shed/replay, faults — are NEVER sampled out: the
+        record must stay a complete account of what degraded and why,
+        only the per-batch bulk thins."""
+        with self._lock:
+            self._sample_every = max(1, int(k))
+            self._batch_tick = 0
+
     def record_batch(self, batch_index: int, rows: int,
                      phases: Dict[str, float], queue_depth: int = 0,
                      **extra) -> None:
+        with self._lock:
+            self._batch_tick += 1
+            if self._sample_every > 1 \
+                    and self._batch_tick % self._sample_every != 1:
+                return
         self._write({
             "kind": "batch", "t": time.time(), "batch": int(batch_index),
             "rows": int(rows),
@@ -541,7 +560,10 @@ class MetricsServer:
     causes), ``crash_loops`` and ``dead_letter_rows`` — and a ``status``
     field: ``"ok"``, ``"unhealthy"`` (503), or ``"degraded"`` (still
     200: the stream is alive and making progress, but rows sit
-    quarantined in the dead-letter queue awaiting triage).
+    quarantined in the dead-letter queue awaiting triage, serving runs
+    off a fallback restore, or the overload ladder is active /
+    deferred rows await replay — the ``overload`` block then carries
+    the rung, shed rows pending replay, and the lag trend).
 
     ``port=0`` binds an ephemeral port (tests); the bound port is
     ``self.port`` after :meth:`start`.
@@ -681,7 +703,36 @@ class MetricsServer:
                 if v is not None:
                     learning[key] = v
             extras["learning"] = learning
+        # Overload ladder (runtime/overload.py): present only once a
+        # controller registered the rung gauge. Degraded-but-alive while
+        # any rung is active OR deferred rows await replay — the same
+        # 200-with-status-"degraded" contract as the DLQ and
+        # fallback-restore states (the stream is serving; an operator
+        # should look before the spill fills).
+        rung = self.registry.get("rtfds_overload_rung")
+        if rung is not None:
+            overload: Dict[str, float] = {"rung": rung.value}
+            pend = self.registry.get("rtfds_shed_pending_rows")
+            if pend is not None:
+                overload["shed_rows_pending_replay"] = pend.value
+            for fam, key in (("rtfds_shed_rows_total", "shed_rows"),
+                             ("rtfds_shed_replayed_rows_total",
+                              "replayed_rows"),
+                             ("rtfds_overload_transitions_total",
+                              "transitions")):
+                v = self.registry.family_total(fam)
+                if v is not None:
+                    overload[key] = v
+            trend = self.registry.get("rtfds_source_lag_trend_rows_per_s")
+            if trend is not None:
+                overload["lag_trend_rows_per_s"] = trend.value
+            extras["overload"] = overload
         status = "ok" if ok else "unhealthy"
+        if ok and rung is not None and (
+                rung.value > 0
+                or extras["overload"].get("shed_rows_pending_replay",
+                                          0) > 0):
+            status = "degraded"
         if ok and extras.get("dead_letter_rows", 0) > 0:
             # alive and progressing, but quarantined rows await triage
             status = "degraded"

@@ -395,3 +395,72 @@ def test_ops_dashboard_learning_tile(tmp_path):
     htm3 = render_ops_html({"model_kind": "logreg"}, mixed)
     assert "1 corrupt refused" in htm3
     assert "1 refused (kind/missing)" in htm3
+
+
+def test_ops_dashboard_overload_tile(tmp_path):
+    """The ops view tells the overload story: a steady run renders no
+    Overload tile; a burst run shows the peak rung and the
+    shed-vs-replayed reconciliation; a replay deficit (rows never
+    replayed) is the headline problem state."""
+    import time as _time
+
+    from real_time_fraud_detection_system_tpu.io.dashboard import (
+        _EVENT_CLASS,
+        render_ops_html,
+    )
+
+    # the four event classes the flight record emits
+    assert _EVENT_CLASS["overload_climb"] == "warning"
+    assert _EVENT_CLASS["shed"] == "warning"
+    assert _EVENT_CLASS["overload_descend"] == "good"
+    assert _EVENT_CLASS["replay"] == "good"
+
+    t0 = _time.time()
+    batches = [
+        {"kind": "batch", "t": t0 + i, "batch": i + 1, "rows": 256,
+         "phases": {"dispatch": 0.001}, "queue_depth": 0,
+         "latency_s": 0.002}
+        for i in range(4)
+    ]
+    steady = render_ops_html({"model_kind": "logreg"}, batches)
+    assert "Overload" not in steady
+
+    recovered = batches + [
+        {"kind": "event", "t": t0 + 0.5, "event": "overload_climb",
+         "rung": 1, "from_rung": 0, "pressure": 1.3, "lag": 1.3},
+        {"kind": "event", "t": t0 + 1.0, "event": "overload_climb",
+         "rung": 2, "from_rung": 1, "pressure": 1.2},
+        {"kind": "event", "t": t0 + 1.5, "event": "overload_climb",
+         "rung": 3, "from_rung": 2, "pressure": 1.1},
+        {"kind": "event", "t": t0 + 1.6, "event": "shed", "rows": 512,
+         "seq": 0, "deferred_batches": 1},
+        {"kind": "event", "t": t0 + 2.0, "event": "replay", "rows": 512,
+         "seq": 0, "deferred_batches": 0},
+        {"kind": "event", "t": t0 + 2.5, "event": "overload_descend",
+         "rung": 2, "from_rung": 3, "pressure": 0.4},
+        {"kind": "event", "t": t0 + 3.0, "event": "overload_descend",
+         "rung": 1, "from_rung": 2, "pressure": 0.3},
+        {"kind": "event", "t": t0 + 3.5, "event": "overload_descend",
+         "rung": 0, "from_rung": 1, "pressure": 0.2},
+    ]
+    htm = render_ops_html({"model_kind": "logreg"}, recovered)
+    assert "Overload" in htm and "rung 3 peak" in htm
+    assert "all replayed" in htm
+    assert "ev warning" in htm  # climb/shed marks carry the new class
+
+    deficit = recovered[:-3]  # stream died before descending/replaying
+    deficit = [e for e in deficit
+               if e.get("event") != "replay"]
+    htm2 = render_ops_html({"model_kind": "logreg"}, deficit)
+    assert "NEVER replayed" in htm2
+
+    # chronology regression: a SECOND overload episode that climbed
+    # after a full recovery must report the degraded end state, not the
+    # earlier recovery (final rung comes from the last transition in
+    # record order, not from climbs+descends concatenation)
+    relapsed = recovered + [
+        {"kind": "event", "t": t0 + 4.0, "event": "overload_climb",
+         "rung": 1, "from_rung": 0, "pressure": 1.4},
+    ]
+    htm3 = render_ops_html({"model_kind": "logreg"}, relapsed)
+    assert "ended degraded at rung 1" in htm3

@@ -249,19 +249,40 @@ def test_parallel_decode_bit_identical_and_scales():
                     "scan (needs ~2 real cores to attest the 1.5x "
                     "gate); bit-identity asserted, speedup gate skipped")
 
-    def best(workers, reps=3):
-        ts = []
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            native.decode_transaction_envelopes_native(
-                msgs, workers=workers)
-            ts.append(time.perf_counter() - t0)
-        return min(ts)
+    # INTERLEAVED serial/parallel timing, best-of-reps: a transient CI
+    # load spike then degrades both arms of the same rep instead of
+    # landing wholly on one side of the ratio (the PR-8-era flake:
+    # back-to-back timing blocks measured the scheduler, not us).
+    t1 = t4 = None
+    for _ in range(5):
+        t0 = time.perf_counter()
+        native.decode_transaction_envelopes_native(msgs, workers=1)
+        t1 = min(t1, time.perf_counter() - t0) if t1 else \
+            time.perf_counter() - t0
+        t0 = time.perf_counter()
+        native.decode_transaction_envelopes_native(msgs, workers=4)
+        t4 = min(t4, time.perf_counter() - t0) if t4 else \
+            time.perf_counter() - t0
+    if t1 / t4 < 1.5:
+        # Re-calibrate before failing (the PR-11 pattern from
+        # test_instrumentation_overhead_bounded, applied to the raw-scan
+        # guard): if concurrent CI load arrived BETWEEN the calibration
+        # above and the measurement, the raw scan has degraded too — the
+        # box changed, not the decoder. Only a box that still attests
+        # 2-thread parallelism while the 4-worker decode can't reach
+        # 1.5x is a real regression.
+        import pytest
 
-    t1, t4 = best(1), best(4)
+        raw_after = _raw_scan_parallelism()
+        if raw_after < 1.8:
+            pytest.skip(
+                f"load arrived mid-test: raw scan fell {raw:.2f}x -> "
+                f"{raw_after:.2f}x; bit-identity asserted, speedup gate "
+                "skipped")
     assert t1 / t4 >= 1.5, (
         f"4-worker decode {t4 * 1e3:.1f} ms vs serial {t1 * 1e3:.1f} ms "
-        f"({t1 / t4:.2f}x) — below the 1.5x host-plane gate")
+        f"({t1 / t4:.2f}x) — below the 1.5x host-plane gate; raw scan "
+        f"still attests {raw:.2f}x, so this is the decoder, not the box")
 
 
 def test_prefetch_collapses_loop_thread_source_poll(small_dataset,

@@ -1337,3 +1337,74 @@ def test_config_flag_drift_skips_partial_runs(tmp_path):
         rules=["config-flag-drift"])
     assert [f for f in res.findings
             if f.rule == "config-flag-drift"] == [], names(res)
+
+
+# --------------------------------------------------------------------------
+# rule: unbounded-queue
+# --------------------------------------------------------------------------
+
+UNBOUNDED_QUEUES = """
+    import queue
+    import multiprocessing
+    from collections import deque
+
+    class Hub:
+        def __init__(self, depth):
+            self.q = queue.Queue()              # unbounded: flag
+            self.ok = queue.Queue(maxsize=8)    # bounded
+            self.okv = queue.Queue(maxsize=depth)  # non-const bound: ok
+            self.zero = queue.Queue(maxsize=0)  # stdlib unbounded: flag
+            self.d = deque()                    # unbounded: flag
+            self.ring = deque(maxlen=16)        # bounded
+            self.mp = multiprocessing.Queue()   # unbounded: flag
+            self.backlog = []                   # list-as-queue: flag
+            self.scratch = []                   # plain list: ok
+
+        def put(self, x):
+            self.backlog.append(x)
+            self.scratch.append(x)
+
+        def take(self):
+            return self.backlog.pop(0)
+"""
+
+
+def test_unbounded_queue_rule_flags_serving_plane(tmp_path):
+    """The overload-PR rule: every queue in runtime//io/ carries an
+    explicit bound — seeded unbounded Queue/deque/list-as-queue must
+    all flag (sensitivity), bounded twins must not."""
+    res = lint_tree(tmp_path, {"pkg/runtime/mod.py": UNBOUNDED_QUEUES},
+                    rules=["unbounded-queue"])
+    got = names(res)
+    lines = sorted(line for _, _, line in got)
+    src_lines = textwrap.dedent(UNBOUNDED_QUEUES).splitlines()
+    flagged = {src_lines[ln - 1].split("#")[1].strip() for ln in lines}
+    assert len(got) == 5, got
+    assert all(f.severity == "P1" for f in res.findings)
+    assert flagged == {"unbounded: flag", "stdlib unbounded: flag",
+                       "list-as-queue: flag"}
+    assert res.gate_failures(), "seeded unbounded queues did not gate"
+
+
+def test_unbounded_queue_rule_scoped_to_runtime_io(tmp_path):
+    """Identical code OUTSIDE runtime//io/ is silent: models/ops/tools
+    build host-side data structures where list growth is the
+    algorithm."""
+    res = lint_tree(tmp_path, {"pkg/models/mod.py": UNBOUNDED_QUEUES},
+                    rules=["unbounded-queue"])
+    assert names(res) == []
+
+
+def test_unbounded_queue_pragma_with_reason_suppresses(tmp_path):
+    src = """
+        from collections import deque
+
+        class Loop:
+            def __init__(self):
+                # rtfdslint: disable=unbounded-queue (drained below pipeline depth on every pass - bounded by construction)
+                self.q = deque()
+    """
+    res = lint_tree(tmp_path, {"pkg/io/mod.py": src},
+                    rules=["unbounded-queue"])
+    assert names(res) == []
+    assert len(res.suppressed) == 1

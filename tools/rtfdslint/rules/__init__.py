@@ -6,4 +6,5 @@ from . import exceptions  # noqa: F401
 from . import jit_hazards  # noqa: F401
 from . import metric_drift  # noqa: F401
 from . import thread_races  # noqa: F401
+from . import unbounded_queue  # noqa: F401
 from . import wall_clock  # noqa: F401

@@ -1,0 +1,190 @@
+"""unbounded-queue: every runtime/io buffer carries an explicit bound.
+
+The overload work (PR 12) makes unbounded buffering a CORRECTNESS bug,
+not a style nit: the whole ladder exists because "just queue it" turns
+sustained traffic above capacity into silent memory growth and an OOM
+death far from the cause. Every queue the serving path owns is bounded
+and backpressured (async sink, prefetch, learner, overload spill) — a
+new ``Queue()``/``deque()`` constructed WITHOUT a bound in ``runtime/``
+or ``io/`` either gets one or carries a pragma saying why its growth is
+bounded by construction.
+
+Flagged (P1, in ``runtime/``+``io/`` only — the thread-shared serving
+planes; models/ops/tools build host-side data structures where list
+growth is the algorithm):
+
+* ``queue.Queue()`` / ``LifoQueue`` / ``PriorityQueue`` with no
+  ``maxsize`` (or a constant ``maxsize=0`` — stdlib spelling for
+  unbounded), resolved through the file's import table;
+* ``collections.deque()`` with no ``maxlen`` (positional form
+  ``deque(it, maxlen)`` counts as bounded);
+* ``multiprocessing.Queue()`` with no ``maxsize``;
+* the list-as-queue idiom: an ``x = []``/``list()`` attribute whose
+  owner also calls BOTH ``x.append(...)`` and ``x.pop(0)`` /
+  ``x.pop()``-at-head somewhere in the same file (a FIFO grown on one
+  side and drained on the other — the shape a bounded ``deque`` or
+  ``Queue`` should own).
+
+A non-constant bound expression counts as bounded (someone chose one);
+this rule only hunts the *absence* of a choice.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..finding import Finding
+from ..project import Project, dotted_name
+from ..registry import register
+
+SCOPED_SUBDIRS = ("/runtime/", "/io/")
+QUEUE_DOTTED = {
+    "queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+    "queue.SimpleQueue", "multiprocessing.Queue",
+}
+DEQUE_DOTTED = {"collections.deque"}
+
+
+def _scoped(relpath: str) -> bool:
+    return any(s in "/" + relpath for s in SCOPED_SUBDIRS)
+
+
+def _resolve(pf, dn: str) -> str:
+    """Normalize 'Queue'/'q.Queue' to the canonical dotted path via the
+    file's import table (same approximation as blocking-calls)."""
+    if not dn:
+        return ""
+    head, _, rest = dn.partition(".")
+    target = pf.imports.get(head)
+    if target:
+        return target + ("." + rest if rest else "")
+    return dn
+
+
+def _const_zero_or_none(node) -> bool:
+    return isinstance(node, ast.Constant) and node.value in (0, None)
+
+
+def _queue_unbounded(call: ast.Call) -> bool:
+    """queue.Queue(...): bounded iff a maxsize arg exists and is not a
+    constant 0/None."""
+    if call.args:
+        return _const_zero_or_none(call.args[0])
+    for kw in call.keywords:
+        if kw.arg == "maxsize":
+            return _const_zero_or_none(kw.value)
+    return True
+
+
+def _deque_unbounded(call: ast.Call) -> bool:
+    """deque(iterable, maxlen): bounded iff the 2nd positional or the
+    maxlen kw exists and is not a constant None."""
+    if len(call.args) >= 2:
+        return _const_zero_or_none(call.args[1])
+    for kw in call.keywords:
+        if kw.arg == "maxlen":
+            return _const_zero_or_none(kw.value)
+    return True
+
+
+def _attr_key(node) -> str:
+    """'self.q' / 'q' for the mutation-site heuristic, '' otherwise."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        return f"{node.value.id}.{node.attr}"
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+@register
+class UnboundedQueueRule:
+    name = "unbounded-queue"
+    doc = ("Queue()/deque()/list-as-queue without a bound in runtime/ "
+           "or io/ — unbounded buffering turns overload into silent "
+           "memory growth (the failure mode the overload ladder exists "
+           "to prevent)")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for pf in project.target_files():
+            if pf.tree is None or not _scoped(pf.relpath):
+                continue
+            # pass 1: explicit queue/deque constructions
+            for n in ast.walk(pf.tree):
+                if not isinstance(n, ast.Call):
+                    continue
+                dn = _resolve(pf, dotted_name(n.func))
+                if dn in QUEUE_DOTTED and _queue_unbounded(n):
+                    what = dn.rsplit(".", 1)[-1]
+                    if dn == "queue.SimpleQueue":
+                        hint = ("SimpleQueue cannot be bounded — use "
+                                "queue.Queue(maxsize=…)")
+                    else:
+                        hint = "pass maxsize=…"
+                    out.append(Finding(
+                        rule=self.name, severity="P1", path=pf.relpath,
+                        line=n.lineno,
+                        message=(f"{what}() constructed without a bound "
+                                 "in a serving-plane module — a stalled "
+                                 "consumer grows it until OOM; "
+                                 f"{hint}, or pragma why growth is "
+                                 "bounded by construction"),
+                        context=f"{pf.module}:"
+                                f"{project.qualname_at(pf, n.lineno)}"))
+                elif dn in DEQUE_DOTTED and _deque_unbounded(n):
+                    out.append(Finding(
+                        rule=self.name, severity="P1", path=pf.relpath,
+                        line=n.lineno,
+                        message=("deque() constructed without maxlen in "
+                                 "a serving-plane module — pass "
+                                 "maxlen=… (only where drop-oldest is "
+                                 "correct), or pragma why growth is "
+                                 "bounded by construction"),
+                        context=f"{pf.module}:"
+                                f"{project.qualname_at(pf, n.lineno)}"))
+            # pass 2: list-as-queue — [] attrs both appended and
+            # head-popped in this file
+            empties = {}   # key -> first assignment line
+            appends = set()
+            pops = set()
+            for n in ast.walk(pf.tree):
+                if isinstance(n, (ast.Assign, ast.AnnAssign)):
+                    val = n.value
+                    is_empty = (isinstance(val, ast.List)
+                                and not val.elts) or (
+                        isinstance(val, ast.Call)
+                        and isinstance(val.func, ast.Name)
+                        and val.func.id == "list" and not val.args)
+                    if not is_empty:
+                        continue
+                    targets = (n.targets if isinstance(n, ast.Assign)
+                               else [n.target])
+                    for t in targets:
+                        key = _attr_key(t)
+                        if key:
+                            empties.setdefault(key, n.lineno)
+                elif isinstance(n, ast.Call) and isinstance(
+                        n.func, ast.Attribute):
+                    key = _attr_key(n.func.value)
+                    if not key:
+                        continue
+                    if n.func.attr == "append":
+                        appends.add(key)
+                    elif n.func.attr == "pop" and (
+                            not n.args
+                            or (isinstance(n.args[0], ast.Constant)
+                                and n.args[0].value == 0)):
+                        pops.add(key)
+            for key, line in sorted(empties.items()):
+                if key in appends and key in pops:
+                    out.append(Finding(
+                        rule=self.name, severity="P1", path=pf.relpath,
+                        line=line,
+                        message=(f"{key} is a list used as a queue "
+                                 "(append + pop at an end) in a "
+                                 "serving-plane module — use a bounded "
+                                 "Queue/deque, or pragma why growth is "
+                                 "bounded by construction"),
+                        context=f"{pf.module}:{key}"))
+        return out
