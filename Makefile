@@ -124,6 +124,15 @@ verify-static:
 overload-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_overload_smoke.py -q
 
+# tiered-feature-store gate: a Zipf stream over a key universe 100x the
+# hot tier must complete under --precompile with ZERO mid-stream
+# recompiles (compaction + sketch-tier overflow active, both enumerated
+# in dispatch_inventory), exact tier counters from the registry
+# (dense + cms == rows x keyspaces), compaction firing AND reclaiming,
+# and gap/dup-free sink lineage
+state-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_state_smoke.py -q
+
 # continuous-learning gate: champion serves, the streaming learner
 # trains a candidate on injected labeled feedback, the shadow's live
 # recall overtakes the champion's, promotion fires, an injected
@@ -173,4 +182,4 @@ install:
 clean:
 	rm -rf $(OUT)
 
-.PHONY: demo datagen train score run-all query dashboard connectors dryrun trace-demo bench perf-smoke chaos-smoke recovery-smoke overload-smoke learn-smoke lint-static verify-static test integration integration-up integration-down sqlcheck install clean
+.PHONY: demo datagen train score run-all query dashboard connectors dryrun trace-demo bench perf-smoke chaos-smoke recovery-smoke overload-smoke state-smoke learn-smoke lint-static verify-static test integration integration-up integration-down sqlcheck install clean

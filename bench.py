@@ -1422,6 +1422,22 @@ def _child_main(args) -> None:
                 if mfu_ceiling:
                     arm["mfu_of_ceiling"] = round(arm_mfu / mfu_ceiling, 3)
 
+    # ---- tiered feature-store scale curve (detail.state_scale) ----------
+    # ROADMAP item 2's proof shape: key universe 64k → 10M × Zipf skew
+    # with a BOUNDED hot tier (key_mode="exact") — loop rows/s must stay
+    # flat (the state never grows past the working set), per-tier state
+    # bytes must hold under --state-hbm-budget-mb (validated at engine
+    # build), and the dense-tier hit rate quantifies what the sketch
+    # tier absorbs. Also measures v2 delta-checkpoint bytes + restore
+    # time of the bounded state against the dense-at-10M control's
+    # static footprint.
+    _progress("state scale")
+    state_scale = None
+    try:
+        state_scale = _state_scale_block(args, on_cpu)
+    except Exception as e:
+        state_scale = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+
     # ---- CPU sklearn baseline (the reference-equivalent predict_proba) --
     # Measured at the headline batch size, capped at 65,536 rows per call
     # to bound a single predict_proba's cost; sklearn RF throughput is
@@ -1492,6 +1508,8 @@ def _child_main(args) -> None:
         detail["cpu_baseline_rows"] = base_rows
     if size_error:
         detail["size_scale_stopped"] = size_error
+    if state_scale is not None:
+        detail["state_scale"] = state_scale
 
     # Registry snapshot beside the headline (ROADMAP PR-1 note): the
     # engine loops above populated rtfds_phase_seconds / rtfds_batch_
@@ -1530,12 +1548,173 @@ def _child_main(args) -> None:
     }))
 
 
+class _ZipfSource:
+    """Pre-generated Zipf-skewed micro-batches over an ``n_keys``
+    universe with the day advancing every few batches (so recency
+    compaction has dead history to reclaim). Generation cost stays
+    outside the measured loop, like ``_RandSource``."""
+
+    def __init__(self, n_batches: int, rows: int, sampler, day_every: int,
+                 seed: int = 2):
+        from real_time_fraud_detection_system_tpu.data.generator import (
+            zipf_stream_cols,
+        )
+
+        rng = np.random.default_rng(seed)
+        self._batches = [
+            zipf_stream_cols(rng, rows, sampler,
+                             n_terminals=max(sampler.n_keys // 8, 64),
+                             day=20200 + b // day_every,
+                             tx_id_start=b * rows)
+            for b in range(n_batches)
+        ]
+        self._i = 0
+
+    def poll_batch(self):
+        if self._i >= len(self._batches):
+            return None
+        b = self._batches[self._i]
+        self._i += 1
+        return b
+
+    @property
+    def offsets(self):
+        return [self._i]
+
+    def seek(self, offsets):
+        self._i = int(offsets[0])
+
+
+def _state_scale_block(args, on_cpu: bool) -> dict:
+    """The ``detail.state_scale`` measurement (see call-site comment)."""
+    import dataclasses as _dc
+    import tempfile
+
+    from real_time_fraud_detection_system_tpu.config import (
+        Config,
+        FeatureConfig,
+        RuntimeConfig,
+    )
+    from real_time_fraud_detection_system_tpu.data.generator import (
+        ZipfKeySampler,
+    )
+    from real_time_fraud_detection_system_tpu.features.online import (
+        state_bytes,
+    )
+    from real_time_fraud_detection_system_tpu.io.checkpoint import (
+        Checkpointer,
+    )
+    from real_time_fraud_detection_system_tpu.models.logreg import (
+        init_logreg,
+    )
+    from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+    from real_time_fraud_detection_system_tpu.runtime.engine import (
+        ScoringEngine,
+    )
+    from real_time_fraud_detection_system_tpu.utils.metrics import (
+        MetricsRegistry,
+    )
+
+    small = on_cpu or args.quick
+    rows = 4096 if small else 65536
+    n_batches = 8 if small else 24
+    skew = 1.1
+    budget_mb = args.state_hbm_budget_mb or 256.0
+    fcfg = FeatureConfig(
+        key_mode="exact",
+        customer_capacity=1 << 15,
+        terminal_capacity=1 << 15,
+        cms_width=1 << 15,
+        compact_every=4,
+        state_hbm_budget_mb=budget_mb,
+    )
+    cfg = Config(
+        features=fcfg,
+        runtime=RuntimeConfig(batch_buckets=(rows,), max_batch_rows=rows,
+                              precompile=True),
+    )
+    scaler = Scaler(mean=np.zeros(15, np.float32),
+                    scale=np.ones(15, np.float32))
+    sb = state_bytes(fcfg)
+    out = {
+        "skew": skew,
+        "batch_rows": rows,
+        "hot_tier_slots": fcfg.customer_capacity + fcfg.terminal_capacity,
+        "hbm_budget_mb": budget_mb,
+        "state_bytes": sb,
+        "within_budget": sb["total"] <= budget_mb * 2 ** 20,
+        "universes": {},
+    }
+    base_rate = None
+    last_engine = None
+    for n_keys in (65536, 1 << 20, 10_000_000):
+        _progress(f"state scale universe {n_keys}")
+        sampler = ZipfKeySampler(n_keys, skew)
+        reg = MetricsRegistry()
+        eng = ScoringEngine(cfg, kind="logreg", params=init_logreg(15),
+                            scaler=scaler, metrics=reg)
+        eng.run(_ZipfSource(2, rows, sampler, day_every=1, seed=7))  # warm
+        stats = eng.run(_ZipfSource(n_batches, rows, sampler,
+                                    day_every=max(n_batches // 6, 1)))
+        dense = reg.get("rtfds_feature_tier_rows_total", tier="dense")
+        cms = reg.get("rtfds_feature_tier_rows_total", tier="cms")
+        d = dense.value if dense is not None else 0.0
+        c = cms.value if cms is not None else 0.0
+        rec = reg.family_total("rtfds_feature_slots_reclaimed_total") or 0
+        recompiles = reg.get("rtfds_xla_recompiles_total")
+        rate = stats["rows_per_s"]
+        if base_rate is None:
+            base_rate = rate
+        out["universes"][str(n_keys)] = {
+            "rows_per_s": round(rate, 1),
+            "vs_64k": round(rate / base_rate, 3) if base_rate else None,
+            "dense_hit_rate": round(d / (d + c), 4) if d + c else 1.0,
+            "slots_reclaimed": int(rec),
+            "mid_stream_recompiles": (recompiles.value
+                                      if recompiles is not None else 0.0),
+        }
+        last_engine = eng
+    # delta-checkpoint cost of the bounded state vs the dense-at-10M
+    # control (static accounting: direct mode needs capacity >= universe)
+    dense_cap = 1 << 24  # next pow2 >= 10M
+    dense_fcfg = _dc.replace(fcfg, key_mode="direct",
+                             customer_capacity=dense_cap,
+                             compact_every=0, state_hbm_budget_mb=0.0)
+    out["dense_control_state_bytes"] = state_bytes(dense_fcfg)
+    with tempfile.TemporaryDirectory() as td:
+        ck = Checkpointer(td, full_every=4)
+        ck.save(last_engine.state)  # full
+        sizes0 = {f: os.path.getsize(os.path.join(td, f))
+                  for f in os.listdir(td) if f.endswith(".npz")}
+        sampler = ZipfKeySampler(10_000_000, skew)
+        last_engine.run(_ZipfSource(2, rows, sampler, day_every=1,
+                                    seed=11))
+        ck.save(last_engine.state)  # delta vs the full above
+        sizes1 = {f: os.path.getsize(os.path.join(td, f))
+                  for f in os.listdir(td) if f.endswith(".npz")}
+        delta_files = sorted(set(sizes1) - set(sizes0))
+        t0 = time.perf_counter()
+        ck.restore(last_engine.state)
+        restore_s = time.perf_counter() - t0
+        out["checkpoint"] = {
+            "full_bytes": max(sizes0.values()),
+            "delta_bytes": (sizes1[delta_files[0]] if delta_files
+                            else None),
+            "restore_s": round(restore_s, 3),
+        }
+    return out
+
+
 def _parse_args(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--model", default="forest",
                     choices=["forest", "logreg"])
     ap.add_argument("--seconds", type=float, default=5.0)
+    ap.add_argument("--state-hbm-budget-mb", type=float, default=0.0,
+                    help="HBM budget for the detail.state_scale curve's "
+                         "tiered feature state, validated at engine "
+                         "build (0 = the block's 256 MB default)")
     ap.add_argument("--probe-timeout", type=float, default=0.0,
                     help="liveness budget (s) for the FIRST TPU attempt "
                          "— how long backend bring-up may take before "
@@ -1593,7 +1772,8 @@ def _run_child(args, platform, liveness_s, settle_s, hard_cap_s):
     if platform is not None:
         env["JAX_PLATFORMS"] = platform
     cmd = [sys.executable, os.path.abspath(__file__),
-           "--model", args.model, "--seconds", str(args.seconds)]
+           "--model", args.model, "--seconds", str(args.seconds),
+           "--state-hbm-budget-mb", str(args.state_hbm_budget_mb)]
     if args.quick:
         cmd.append("--quick")
 

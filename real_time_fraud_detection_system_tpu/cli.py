@@ -560,6 +560,54 @@ def cmd_score(args) -> int:
         checkpoint_op_attempts=args.checkpoint_op_attempts,
         overload=overload_cfg,
     ))
+    # Feature-plane knobs (the tiered device-resident feature store).
+    if args.state_compact_every > 0 and args.key_mode != "exact":
+        log.error("--state-compact-every only applies to --key-mode "
+                  "exact (direct/hash tables have no slot allocator to "
+                  "reclaim into)")
+        return 2
+    try:
+        cfg = cfg.replace(features=_dc.replace(
+            cfg.features,
+            key_mode=args.key_mode,
+            compact_every=args.state_compact_every,
+            state_hbm_budget_mb=args.state_hbm_budget_mb,
+        ))
+    except ValueError as e:
+        log.error("feature-plane config: %s", e)
+        return 2
+    if args.state_hbm_budget_mb > 0:
+        # pre-validate with the CLI convention (rc 2 + a log line, not a
+        # constructor traceback); the engines enforce the same check at
+        # build for non-CLI callers
+        from real_time_fraud_detection_system_tpu.features.online import (
+            state_bytes as _state_bytes,
+        )
+
+        need = _state_bytes(cfg.features)["total"]
+        if need > args.state_hbm_budget_mb * 2 ** 20:
+            log.error(
+                "--state-hbm-budget-mb %g cannot hold the configured "
+                "feature state (%.1f MB: run with a larger budget, or "
+                "shrink customer/terminal capacity or cms_width)",
+                args.state_hbm_budget_mb, need / 2 ** 20)
+            return 2
+    if args.key_mode == "exact":
+        from real_time_fraud_detection_system_tpu.features.online import (
+            state_bytes,
+        )
+
+        sb = state_bytes(cfg.features)
+        log.info(
+            "tiered feature store: hot tier %d+%d slots, compaction "
+            "every %s batches, state %.1f MB (dense %.1f, directory "
+            "%.1f, cms %.1f)%s",
+            cfg.features.customer_capacity, cfg.features.terminal_capacity,
+            args.state_compact_every or "off",
+            sb["total"] / 2 ** 20, sb["dense"] / 2 ** 20,
+            sb["directory"] / 2 ** 20, sb["cms"] / 2 ** 20,
+            f" of {args.state_hbm_budget_mb:g} MB budget"
+            if args.state_hbm_budget_mb > 0 else "")
     cfg = cfg.replace(learn=_dc.replace(
         cfg.learn,
         registry_path=args.learn_registry,
@@ -2106,6 +2154,28 @@ def main(argv=None) -> int:
                         "parquet table at this directory (the reference's "
                         "nessie.payment.transactions)")
     p.add_argument("--batch-rows", type=int, default=4096)
+    p.add_argument("--key-mode", default="direct",
+                   choices=["direct", "hash", "exact"],
+                   help="feature-state key→slot placement: direct "
+                        "(dense serial ids, capacity >= key universe), "
+                        "hash (bounded memory, colliding keys MERGE "
+                        "windows), exact (tiered store: on-device key "
+                        "directory, hot tier sized to the working set, "
+                        "admission misses served from the count-min "
+                        "sketch — README 'Feature-state playbook')")
+    p.add_argument("--state-compact-every", type=int, default=0,
+                   help="recency compaction cadence for --key-mode "
+                        "exact: every N batches a full-table vector "
+                        "pass reclaims hot-tier slots whose newest day "
+                        "is older than delay + max(window) (dead "
+                        "history; counted in "
+                        "rtfds_feature_slots_reclaimed_total). 0 = off")
+    p.add_argument("--state-hbm-budget-mb", type=float, default=0.0,
+                   help="HBM budget for the whole feature state (dense "
+                        "tier + directories + sketches), validated at "
+                        "engine build from the static state_bytes() "
+                        "accounting — fail fast instead of OOMing "
+                        "mid-stream. 0 = unchecked")
     p.add_argument("--alerts-only", action="store_true",
                    help="serve predictions only: the feature matrix "
                         "never leaves the device (the highest-throughput "

@@ -68,8 +68,28 @@ class FeatureConfig:
     # Slot placement: "direct" (key & (cap-1)) is collision-free for dense
     # serial PKs (the reference's SERIAL ids, postgres/init.sql) as long as
     # capacity >= #keys; "hash" mixes first — use for sparse/adversarial key
-    # spaces (collisions then merge keys, CMS bounds the error story).
+    # spaces (collisions then merge keys, CMS bounds the error story);
+    # "exact" routes through the on-device key directory (ops/keydir.py):
+    # the hot tier is sized to the ACTIVE WORKING SET (capacity = hot-tier
+    # slots, decoupled from the key universe), admitted keys are
+    # collision-exact, and rows that miss admission are served from the
+    # count-min sketch tier (overestimate-only degradation, observable via
+    # rtfds_feature_tier_rows_total).
     key_mode: str = "direct"
+    # key_mode="exact" knobs: fixed probe depth of the directory's double
+    # hashing (the directory is 2x the slot capacity, load factor <= 0.5,
+    # so 8 probes make admission misses vanishingly rare until the free
+    # list itself runs dry), and the recency-compaction cadence — every
+    # N batches a full-table vector pass reclaims slots whose newest
+    # bucket_day is older than delay_days + max(windows) (dead history:
+    # no query can ever see it). 0 = compaction off.
+    keydir_probes: int = 8
+    compact_every: int = 0
+    # HBM budget for the whole feature state (dense tier + directory +
+    # sketches), validated at ENGINE BUILD against the static
+    # state_bytes() accounting — a config that cannot fit fails fast
+    # instead of OOMing mid-stream. 0 = no budget check.
+    state_hbm_budget_mb: float = 0.0
     # Count-min sketch for unbounded key cardinality (velocity features).
     cms_depth: int = 4
     cms_width: int = 1 << 15
@@ -101,10 +121,32 @@ class FeatureConfig:
                 f"customer_source must be 'table' or 'cms', "
                 f"got {self.customer_source!r}"
             )
-        if self.key_mode not in ("direct", "hash"):
+        if self.key_mode not in ("direct", "hash", "exact"):
             raise ValueError(
-                f"key_mode must be 'direct' or 'hash', got {self.key_mode!r}"
+                f"key_mode must be 'direct', 'hash' or 'exact', "
+                f"got {self.key_mode!r}"
             )
+        # direct mode masks with (capacity - 1) (features/online._slot) and
+        # the hash/exact placements assume pow2 tables — a non-pow2
+        # capacity would silently ALIAS keys today, so refuse it loudly.
+        for name in ("customer_capacity", "terminal_capacity"):
+            cap = getattr(self, name)
+            if cap < 1 or cap & (cap - 1):
+                raise ValueError(
+                    f"{name} must be a power of two (direct mode masks "
+                    f"with capacity-1; non-pow2 silently aliases keys), "
+                    f"got {cap}")
+        if self.keydir_probes < 1:
+            raise ValueError(
+                f"keydir_probes must be >= 1, got {self.keydir_probes}")
+        if self.compact_every < 0:
+            raise ValueError(
+                f"compact_every must be >= 0 (0 = off), "
+                f"got {self.compact_every}")
+        if self.state_hbm_budget_mb < 0:
+            raise ValueError(
+                f"state_hbm_budget_mb must be >= 0 (0 = unchecked), "
+                f"got {self.state_hbm_budget_mb}")
         if self.seq_attn not in ("naive", "blockwise", "auto"):
             raise ValueError(
                 f"seq_attn must be 'naive', 'blockwise' or 'auto', "

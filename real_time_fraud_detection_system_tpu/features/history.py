@@ -5,7 +5,11 @@ reference's dormant seq2seq fraud model, ``shared_functions.py:
 1312-1707``) scores a transaction from its card's event history. Offline
 that history comes from ``build_sequences`` over a full table; ONLINE it
 must live on-device and update per micro-batch, exactly like the window
-state. This module is that state:
+state. (The tiered ``key_mode="exact"`` store applies to the WINDOWS
+plane only — histories keep their direct/hash slotting, and the engine
+refuses the combination rather than serve a half-tiered state; growing
+this ring a directory + sketch-summary tier is the natural follow-up
+once the windows-plane tiering is sharded.) This module is that state:
 
 - a ring buffer of the last K event-feature vectors per customer slot
   (``events [C+1, K, 8]``), with each cell's absolute event index

@@ -21,10 +21,19 @@ from real_time_fraud_detection_system_tpu.config import FeatureConfig
 from real_time_fraud_detection_system_tpu.core.batch import TxBatch
 from real_time_fraud_detection_system_tpu.ops.cms import (
     CountMinSketch,
+    cms_add_fraud,
     cms_init,
+    cms_query_fraud,
     cms_update,
 )
 from real_time_fraud_detection_system_tpu.ops.hashing import slot_of
+from real_time_fraud_detection_system_tpu.ops.keydir import (
+    KeyDirectory,
+    admit_slots,
+    init_keydir,
+    lookup_slots,
+    reclaim_entries,
+)
 from real_time_fraud_detection_system_tpu.ops.windows import (
     WindowState,
     init_window_state,
@@ -34,33 +43,106 @@ from real_time_fraud_detection_system_tpu.ops.windows import (
 
 
 class FeatureState(NamedTuple):
-    """All HBM-resident feature state (a pytree; shard over the mesh)."""
+    """All HBM-resident feature state (a pytree; shard over the mesh).
+
+    The three trailing fields exist only under ``key_mode="exact"`` (the
+    tiered feature store): exact key→slot directories for both hot-tier
+    tables and a fraud-tracking terminal sketch for graceful overflow.
+    ``None`` defaults keep the pytree leaf structure — and therefore
+    every existing checkpoint — identical for direct/hash configs."""
 
     customer: WindowState
     terminal: WindowState
     cms: Optional[CountMinSketch]
+    customer_dir: Optional[KeyDirectory] = None
+    terminal_dir: Optional[KeyDirectory] = None
+    terminal_cms: Optional[CountMinSketch] = None
 
 
 def init_feature_state(
     cfg: FeatureConfig, with_cms: Optional[bool] = None
 ) -> FeatureState:
+    exact = cfg.key_mode == "exact"
     if with_cms is None:
-        with_cms = cfg.customer_source == "cms"
+        # exact mode always carries the customer sketch: it is the
+        # overflow tier for rows that miss hot-tier admission
+        with_cms = cfg.customer_source == "cms" or exact
+    customer_dir = terminal_dir = terminal_cms = None
+    if exact:
+        # Directory at 2x the slot capacity: load factor <= 0.5 keeps
+        # fixed-depth probing effectively lossless until the free-slot
+        # list itself runs dry (THE admission bound).
+        if cfg.customer_source != "cms":
+            customer_dir = init_keydir(2 * cfg.customer_capacity,
+                                       cfg.customer_capacity)
+        terminal_dir = init_keydir(2 * cfg.terminal_capacity,
+                                   cfg.terminal_capacity)
+        terminal_cms = cms_init(cfg.cms_depth, cfg.cms_width,
+                                cfg.n_day_buckets, track_fraud=True)
     return FeatureState(
         customer=init_window_state(cfg.customer_capacity, cfg.n_day_buckets),
         terminal=init_window_state(cfg.terminal_capacity, cfg.n_day_buckets),
         cms=cms_init(cfg.cms_depth, cfg.cms_width, cfg.n_day_buckets)
         if with_cms
         else None,
+        customer_dir=customer_dir,
+        terminal_dir=terminal_dir,
+        terminal_cms=terminal_cms,
     )
 
 
 def _slot(key: jnp.ndarray, capacity: int, mode: str) -> jnp.ndarray:
     """Key → table slot. 'direct' is exact for dense serial ids (< capacity);
-    'hash' mixes for sparse key universes."""
+    'hash' mixes for sparse key universes. 'exact' never comes through
+    here — it routes through the key directory (admit_slots)."""
+    if mode == "exact":
+        raise ValueError(
+            "key_mode='exact' routes through the key directory "
+            "(ops/keydir.admit_slots), not the static slot map")
     if mode == "direct":
         return (key & jnp.uint32(capacity - 1)).astype(jnp.int32)
     return slot_of(key, capacity)
+
+
+def state_bytes(cfg: FeatureConfig) -> dict:
+    """Static per-tier HBM accounting for the feature state a config
+    would build (init_feature_state shapes × dtype bytes; no device
+    access, no allocation). Keys: ``dense`` (window tables),
+    ``directory`` (key directories + free lists), ``cms`` (all
+    sketches), ``total``. The ``--state-hbm-budget-mb`` engine-build
+    check and bench's ``detail.state_scale`` both read this, so the
+    budget the operator sets and the bytes the bench reports cannot
+    drift."""
+    exact = cfg.key_mode == "exact"
+    nb = cfg.n_day_buckets
+    # WindowState: bucket_day i32 + count/amount/fraud f32 = 16 B/bucket.
+    dense = (cfg.customer_capacity + cfg.terminal_capacity) * nb * 16
+    directory = 0
+    cms = 0
+    n_sketches = 0
+    if cfg.customer_source == "cms" or exact:
+        n_sketches += 1  # customer count+amount sketch
+    if exact:
+        n_sketches += 1  # terminal sketch...
+    sketch_cols = 2
+    cms = n_sketches * (nb * 4  # slice_day
+                        + sketch_cols * nb * cfg.cms_depth * cfg.cms_width * 4)
+    if exact:
+        # ...whose fraud column is a third table on the terminal sketch
+        cms += nb * cfg.cms_depth * cfg.cms_width * 4
+        # KeyDirectory: keys u32 + slots i32 over 2x slots, free i32 +
+        # free_top i32 per table.
+        for cap, present in ((cfg.customer_capacity,
+                              cfg.customer_source != "cms"),
+                             (cfg.terminal_capacity, True)):
+            if present:
+                directory += 2 * cap * 8 + cap * 4 + 4
+    return {
+        "dense": int(dense),
+        "directory": int(directory),
+        "cms": int(cms),
+        "total": int(dense + directory + cms),
+    }
 
 
 def _flags(batch: TxBatch, cfg: FeatureConfig) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -73,6 +155,53 @@ def _flags(batch: TxBatch, cfg: FeatureConfig) -> Tuple[jnp.ndarray, jnp.ndarray
     hour = batch.tod_s // 3600
     is_night = (hour <= cfg.night_end_hour).astype(jnp.float32)
     return is_weekend, is_night
+
+
+def _update_state_exact(
+    state: FeatureState, batch: TxBatch, cfg: FeatureConfig
+) -> Tuple[FeatureState, jnp.ndarray, jnp.ndarray, jnp.ndarray,
+           jnp.ndarray]:
+    """Tiered scatter-update half (``key_mode="exact"``).
+
+    Returns (new_state, cust_slot, c_adm, term_slot, t_adm): slots route
+    through the exact key directories; rows that miss admission carry
+    ``*_adm=False``, stay OUT of the dense scatters, and are served from
+    the sketch tier by the caller. The sketches are updated with EVERY
+    row (they shadow the full stream), so a key's sketch estimate stays
+    a valid overestimate whether or not it currently holds a hot slot.
+    """
+    fraud = jnp.maximum(batch.label, 0).astype(jnp.float32)
+    probes = cfg.keydir_probes
+    if cfg.customer_source == "cms":
+        customer, customer_dir = state.customer, None
+        cust_slot = jnp.zeros_like(batch.day)
+        c_adm = jnp.zeros_like(batch.valid)
+    else:
+        customer_dir, cust_slot, c_adm = admit_slots(
+            state.customer_dir, batch.customer_key, batch.valid,
+            n_probes=probes)
+        customer = update_windows(
+            state.customer, cust_slot, batch.day, batch.amount, fraud,
+            batch.valid & c_adm, track_fraud=False,
+        )
+    terminal_dir, term_slot, t_adm = admit_slots(
+        state.terminal_dir, batch.terminal_key, batch.valid,
+        n_probes=probes)
+    terminal = update_windows(
+        state.terminal, term_slot, batch.day, batch.amount, fraud,
+        batch.valid & t_adm, track_amount=False,
+    )
+    cms = cms_update(state.cms, batch.customer_key, batch.amount,
+                     batch.day, batch.valid)
+    terminal_cms = cms_update(state.terminal_cms, batch.terminal_key,
+                              batch.amount, batch.day, batch.valid,
+                              fraud=fraud)
+    new_state = FeatureState(
+        customer=customer, terminal=terminal, cms=cms,
+        customer_dir=customer_dir, terminal_dir=terminal_dir,
+        terminal_cms=terminal_cms,
+    )
+    return new_state, cust_slot, c_adm, term_slot, t_adm
 
 
 def _update_state(
@@ -145,8 +274,15 @@ def update_and_featurize(
     t_risk = jnp.where(t_count > 0, t_fraud / jnp.maximum(t_count, 1.0), 0.0)
 
     is_weekend, is_night = _flags(batch, cfg)
+    features = _assemble(batch, cfg, c_count, c_avg, t_count, t_risk,
+                         is_weekend, is_night)
+    return state, features
 
+
+def _assemble(batch, cfg, c_count, c_avg, t_count, t_risk,
+              is_weekend, is_night) -> jnp.ndarray:
     # Feature order must match features/spec.py::FEATURE_NAMES.
+    windows = tuple(cfg.windows)
     cols = [batch.amount, is_weekend, is_night]
     for i in range(len(windows)):
         cols.append(c_count[:, i])
@@ -154,9 +290,68 @@ def update_and_featurize(
     for i in range(len(windows)):
         cols.append(t_count[:, i])
         cols.append(t_risk[:, i])
-    features = jnp.stack(cols, axis=1)
+    return jnp.stack(cols, axis=1)
 
-    return state, features
+
+def update_and_featurize_exact(
+    state: FeatureState,
+    batch: TxBatch,
+    cfg: FeatureConfig,
+) -> Tuple[FeatureState, jnp.ndarray, jnp.ndarray]:
+    """Tiered twin of :func:`update_and_featurize` (``key_mode="exact"``).
+
+    Returns (new_state, features [B, 15], tier_rows [2] float32) where
+    ``tier_rows = [dense, cms]`` counts (row × keyspace) admissions this
+    batch — the device-side source of
+    ``rtfds_feature_tier_rows_total{tier=…}``.
+
+    Per row and keyspace: an admitted key reads its private hot-tier
+    window row (collision-exact — with the hot tier sized to hold every
+    key this path is bit-identical to ``direct`` mode); a row that
+    missed admission reads the count-min sketch instead
+    (overestimate-only counts/amounts; terminal risk becomes a ratio of
+    two overestimates — an estimate, not a bound).
+    """
+    windows = tuple(cfg.windows)
+    state, cust_slot, c_adm, term_slot, t_adm = _update_state_exact(
+        state, batch, cfg)
+
+    if cfg.customer_source == "cms":
+        from real_time_fraud_detection_system_tpu.ops.cms import cms_query
+
+        c_count, c_amount = cms_query(
+            state.cms, batch.customer_key, batch.day, windows)
+        c_tier_rows = jnp.zeros((), jnp.float32)  # no dense customer tier
+        c_miss_rows = jnp.zeros((), jnp.float32)
+    else:
+        from real_time_fraud_detection_system_tpu.ops.cms import cms_query
+
+        cc_t, ca_t, _ = query_windows(
+            state.customer, cust_slot, batch.day, windows)
+        cc_s, ca_s = cms_query(
+            state.cms, batch.customer_key, batch.day, windows)
+        c_count = jnp.where(c_adm[:, None], cc_t, cc_s)
+        c_amount = jnp.where(c_adm[:, None], ca_t, ca_s)
+        c_tier_rows = jnp.sum((batch.valid & c_adm).astype(jnp.float32))
+        c_miss_rows = jnp.sum((batch.valid & ~c_adm).astype(jnp.float32))
+
+    tc_t, _, tf_t = query_windows(
+        state.terminal, term_slot, batch.day, windows, delay=cfg.delay_days)
+    tc_s, _, tf_s = cms_query_fraud(
+        state.terminal_cms, batch.terminal_key, batch.day, windows,
+        delay=cfg.delay_days)
+    t_count = jnp.where(t_adm[:, None], tc_t, tc_s)
+    t_fraud = jnp.where(t_adm[:, None], tf_t, tf_s)
+
+    c_avg = jnp.where(c_count > 0, c_amount / jnp.maximum(c_count, 1.0), 0.0)
+    t_risk = jnp.where(t_count > 0, t_fraud / jnp.maximum(t_count, 1.0), 0.0)
+    is_weekend, is_night = _flags(batch, cfg)
+    features = _assemble(batch, cfg, c_count, c_avg, t_count, t_risk,
+                         is_weekend, is_night)
+    dense = c_tier_rows + jnp.sum((batch.valid & t_adm).astype(jnp.float32))
+    cms_rows = c_miss_rows + jnp.sum(
+        (batch.valid & ~t_adm).astype(jnp.float32))
+    return state, features, jnp.stack([dense, cms_rows])
 
 
 def update_and_score_pallas(
@@ -250,6 +445,59 @@ def update_and_score_pallas_forest(
     return state, leaf_sum, feats
 
 
+def compact_feature_state(
+    state: FeatureState,
+    now_day: jnp.ndarray,  # int32 [] — newest day the stream has seen
+    cfg: FeatureConfig,
+) -> Tuple[FeatureState, jnp.ndarray]:
+    """Recency compaction (``key_mode="exact"``): one full-table vector
+    pass reclaiming hot-tier slots that hold only dead history.
+
+    A slot whose NEWEST ``bucket_day`` is older than
+    ``now_day - (delay_days + max(windows))`` can never contribute to
+    any window query again (the age mask already excludes every bucket
+    it holds) — its directory entry is vacated, the slot returns to the
+    free list, and its window row is reset so a later grant starts
+    clean. Returns (new_state, reclaimed [2] int32 = [customer,
+    terminal]). Fixed shapes throughout: this is a ``DispatchSignature``
+    variant of the compiled step family, not a recompile.
+    """
+    horizon = jnp.int32(cfg.delay_days + max(cfg.windows))
+    cutoff = now_day.astype(jnp.int32) - horizon
+    out = {}
+    counts = []
+    for dir_name, ws_name in (("customer_dir", "customer"),
+                              ("terminal_dir", "terminal")):
+        kd = getattr(state, dir_name)
+        ws = getattr(state, ws_name)
+        if kd is None:
+            out[dir_name], out[ws_name] = kd, ws
+            counts.append(jnp.int32(0))
+            continue
+        newest = jnp.max(ws.bucket_day, axis=1)  # [slot_cap]
+        slot_idx = jnp.clip(kd.slots, 0, ws.capacity - 1)
+        dead_entry = (kd.slots >= 0) & (newest[slot_idx] < cutoff)
+        old_slots = kd.slots  # pre-clear slot ids (reclaim vacates them)
+        kd, dead, n = reclaim_entries(kd, dead_entry)
+        tgt = jnp.where(dead, old_slots, ws.capacity)
+        out[dir_name] = kd
+        out[ws_name] = WindowState(
+            bucket_day=ws.bucket_day.at[tgt].set(-1, mode="drop"),
+            count=ws.count.at[tgt].set(0.0, mode="drop"),
+            amount=ws.amount.at[tgt].set(0.0, mode="drop"),
+            fraud=ws.fraud.at[tgt].set(0.0, mode="drop"),
+        )
+        counts.append(n)
+    return (
+        state._replace(
+            customer=out["customer"], terminal=out["terminal"],
+            customer_dir=out["customer_dir"],
+            terminal_dir=out["terminal_dir"],
+        ),
+        jnp.stack(counts),
+    )
+
+
 def apply_feedback(
     state: FeatureState,
     terminal_key: jnp.ndarray,  # uint32 [B]
@@ -264,7 +512,21 @@ def apply_feedback(
     config 4). Counts are NOT incremented (the transaction was already
     counted when it streamed through); only the fraud sums change, which the
     delay-shifted risk windows will pick up.
+
+    ``key_mode="exact"``: labels route by directory LOOKUP (never an
+    insert — feedback must not evict live traffic's slots). Hits land in
+    the dense terminal windows exactly as before; misses (the key was
+    never admitted, or its slot was compacted away) land in the terminal
+    sketch's fraud column so the sketch-tier risk estimate still learns.
     """
+    if cfg.key_mode == "exact":
+        term_slot, hit = lookup_slots(
+            state.terminal_dir, terminal_key, valid,
+            n_probes=cfg.keydir_probes)
+        state = apply_feedback_at_slot(state, term_slot, day, label,
+                                       valid & hit)
+        return state._replace(terminal_cms=cms_add_fraud(
+            state.terminal_cms, terminal_key, day, label, valid & ~hit))
     term_slot = _slot(terminal_key, cfg.terminal_capacity, cfg.key_mode)
     return apply_feedback_at_slot(state, term_slot, day, label, valid)
 

@@ -5,6 +5,14 @@ Order and names match the reference's serving feature list
 follow the canonical definitions in :mod:`..config` (the offline-training
 definitions — the reference's online SQL disagreed with its own training
 pipeline; see ``config.py`` docstring).
+
+Tier provenance (``key_mode="exact"``, README § Feature-state playbook):
+the window columns keep this spec under the tiered store, but their
+SOURCE varies per row — a key holding a hot-tier slot reads its exact
+private windows, a key that missed admission reads count-min sketch
+estimates (counts/amounts overestimate-only; terminal risk a ratio of
+two overestimates). ``rtfds_feature_tier_rows_total{tier=…}`` records
+the serving mix; flag/amount columns are tier-independent.
 """
 
 from __future__ import annotations

@@ -644,6 +644,27 @@ def render_ops_html(
                    if shed_rows else
                    f"{len(climbs)} climb(s), fully recovered")
         tiles.append(("Overload", f"rung {top_rung} peak", sub))
+    # Feature-store tile (tiered exact mode): hot-tier occupancy at the
+    # last compaction, total reclaimed slots, and the dense-tier hit
+    # rate. Only rendered when the run compacted (any feature_state
+    # event), so direct/hash runs keep a clean tile row.
+    fs_events = [e for e in events if e.get("event") == "feature_state"]
+    if fs_events:
+        last = fs_events[-1]
+        occ = int(last.get("occupied", 0))
+        cap = int(last.get("capacity", 0))
+        reclaimed = sum(int(e.get("reclaimed", 0)) for e in fs_events)
+        dense = float(last.get("dense_rows", 0.0))
+        cms_r = float(last.get("cms_rows", 0.0))
+        served = dense + cms_r
+        sub_bits = [f"{_compact(reclaimed)} slot(s) reclaimed"]
+        if served:
+            sub_bits.append(f"{dense / served:.1%} dense")
+        tiles.append((
+            "Feature store",
+            f"{_compact(occ)}/{_compact(cap)} slots" if cap
+            else _compact(occ),
+            " · ".join(sub_bits)))
     # Learning tile: which model versions served/shadowed and how the
     # canary ended. Only rendered when the run had a learning loop (any
     # model_* event), so plain serving runs keep a clean tile row.

@@ -18,7 +18,7 @@ features are precomputed static joins, ``fraud_detection.py:100-123``).
 
 from __future__ import annotations
 
-from typing import NamedTuple, Sequence, Tuple
+from typing import NamedTuple, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 
@@ -26,11 +26,19 @@ from real_time_fraud_detection_system_tpu.ops.hashing import multi_hash
 
 
 class CountMinSketch(NamedTuple):
-    """Pytree: ring of daily CMS slices."""
+    """Pytree: ring of daily CMS slices.
+
+    ``fraud`` is an OPTIONAL third column (fraud-label sums) used by the
+    tiered feature store's sketch tier so terminal *risk* degrades
+    gracefully when a key misses hot-tier admission. ``None`` (the
+    default, and every pre-tiering config) keeps the pytree leaf
+    structure — and therefore checkpoints — identical to the historical
+    2-column sketch."""
 
     slice_day: jnp.ndarray  # int32 [ND] — absolute day held by each slice
     count: jnp.ndarray  # float32 [ND, depth, width]
     amount: jnp.ndarray  # float32 [ND, depth, width]
+    fraud: Optional[jnp.ndarray] = None  # float32 [ND, depth, width] | None
 
     @property
     def n_days(self) -> int:
@@ -45,11 +53,14 @@ class CountMinSketch(NamedTuple):
         return int(self.count.shape[2])
 
 
-def cms_init(depth: int, width: int, n_days: int = 40) -> CountMinSketch:
+def cms_init(depth: int, width: int, n_days: int = 40,
+             track_fraud: bool = False) -> CountMinSketch:
     return CountMinSketch(
         slice_day=jnp.full((n_days,), -1, dtype=jnp.int32),
         count=jnp.zeros((n_days, depth, width), dtype=jnp.float32),
         amount=jnp.zeros((n_days, depth, width), dtype=jnp.float32),
+        fraud=jnp.zeros((n_days, depth, width), dtype=jnp.float32)
+        if track_fraud else None,
     )
 
 
@@ -59,6 +70,7 @@ def cms_update(
     amount: jnp.ndarray,  # float32 [B]
     day: jnp.ndarray,  # int32 [B]
     valid: jnp.ndarray,  # bool [B]
+    fraud: Optional[jnp.ndarray] = None,  # float32 [B] 0/1 (labeled rows)
 ) -> CountMinSketch:
     nd, depth, width = sk.count.shape
     sl = jnp.remainder(day, nd)  # [B]
@@ -78,7 +90,77 @@ def cms_update(
     wb = jnp.broadcast_to(w[None, :], cols.shape)
     count = count.at[slc, rows, cols].add(wb)
     amt = amt.at[slc, rows, cols].add(wb * amount[None, :])
-    return CountMinSketch(slice_day=new_slice_day, count=count, amount=amt)
+    frd = sk.fraud
+    if frd is not None:
+        # Same slice-reset + fresh-mask discipline as count/amount; a
+        # sketch without the column (every pre-tiering config) takes a
+        # bit-identical count/amount path through this function.
+        frd = jnp.where(advanced, 0.0, frd)
+        f_in = (jnp.zeros_like(w) if fraud is None
+                else fraud.astype(jnp.float32))
+        frd = frd.at[slc, rows, cols].add(wb * f_in[None, :])
+    return CountMinSketch(slice_day=new_slice_day, count=count, amount=amt,
+                          fraud=frd)
+
+
+def cms_add_fraud(
+    sk: CountMinSketch,
+    key: jnp.ndarray,  # uint32 [B]
+    day: jnp.ndarray,  # int32 [B] — the ORIGINAL transaction's day
+    label: jnp.ndarray,  # int32/float32 [B] 0/1
+    valid: jnp.ndarray,  # bool [B]
+) -> CountMinSketch:
+    """Late fraud-label feedback into the sketch tier: add fraud sums to
+    the slice still holding ``day`` (counts unchanged — the row was
+    already counted when it streamed through). Labels for days the ring
+    has wrapped past are dropped, mirroring the dense tier's
+    bounded-lateness policy."""
+    if sk.fraud is None:
+        return sk
+    nd, depth, width = sk.count.shape
+    sl = jnp.remainder(day, nd)
+    live = valid & (sk.slice_day[sl] == day)
+    w = live.astype(jnp.float32) * label.astype(jnp.float32)
+    cols = multi_hash(key, depth, width)  # [depth, B]
+    rows = jnp.broadcast_to(
+        jnp.arange(depth, dtype=jnp.int32)[:, None], cols.shape)
+    slc = jnp.broadcast_to(sl[None, :], cols.shape)
+    wb = jnp.broadcast_to(w[None, :], cols.shape)
+    return sk._replace(fraud=sk.fraud.at[slc, rows, cols].add(wb))
+
+
+def _cms_query_tables(
+    sk: CountMinSketch,
+    tables: Sequence[jnp.ndarray],  # each [ND, depth, width]
+    key: jnp.ndarray,  # uint32 [B]
+    day: jnp.ndarray,  # int32 [B]
+    windows: Sequence[int],
+    delay: int = 0,
+) -> Tuple[jnp.ndarray, ...]:
+    """Shared windowed min-over-depth estimator over N parallel tables.
+
+    Window w sums the per-day estimates for days
+    [day-delay-w+1, day-delay] — the same delay-shift semantics as
+    :func:`..windows.query_windows` (``delay=0`` is the historical
+    count/amount path, bit-identical arithmetic)."""
+    nd, depth, width = sk.count.shape
+    max_w = max(windows)
+    offsets = jnp.arange(max_w, dtype=jnp.int32)  # [W]
+    wanted = day[:, None] - jnp.int32(delay) - offsets[None, :]  # [B, W]
+    sl = jnp.remainder(wanted, nd)  # [B, W]
+    live = (sk.slice_day[sl] == wanted) & (wanted >= 0)  # [B, W]
+
+    cols = multi_hash(key, depth, width)  # [depth, B]
+    sel = jnp.stack(
+        [(offsets < w).astype(jnp.float32) for w in windows], axis=0
+    )  # [NW, W]
+    out = []
+    for t in tables:
+        # Gather [depth, B, W] then min over depth.
+        g = t[sl[None, :, :], jnp.arange(depth)[:, None, None],
+              cols[:, :, None]]
+        out.append((jnp.min(g, axis=0) * live) @ sel.T)
+    return tuple(out)
 
 
 def cms_query(
@@ -86,27 +168,33 @@ def cms_query(
     key: jnp.ndarray,  # uint32 [B]
     day: jnp.ndarray,  # int32 [B]
     windows: Sequence[int],
+    delay: int = 0,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Windowed velocity estimates: (counts, amount_sums), each [B, NW].
 
     Window w sums the per-day min-over-depth estimates for days
-    [day-w+1, day].
+    [day-delay-w+1, day-delay] (``delay=0``: [day-w+1, day], the
+    historical behavior, bit-identical).
     """
-    nd, depth, width = sk.count.shape
-    max_w = max(windows)
-    offsets = jnp.arange(max_w, dtype=jnp.int32)  # [W]
-    wanted = day[:, None] - offsets[None, :]  # [B, W]
-    sl = jnp.remainder(wanted, nd)  # [B, W]
-    live = (sk.slice_day[sl] == wanted) & (wanted >= 0)  # [B, W]
+    return _cms_query_tables(sk, (sk.count, sk.amount), key, day, windows,
+                             delay)
 
-    cols = multi_hash(key, depth, width)  # [depth, B]
-    # Gather [depth, B, W] then min over depth.
-    g_count = sk.count[sl[None, :, :], jnp.arange(depth)[:, None, None], cols[:, :, None]]
-    g_amt = sk.amount[sl[None, :, :], jnp.arange(depth)[:, None, None], cols[:, :, None]]
-    est_count = jnp.min(g_count, axis=0) * live  # [B, W]
-    est_amt = jnp.min(g_amt, axis=0) * live
 
-    sel = jnp.stack(
-        [(offsets < w).astype(jnp.float32) for w in windows], axis=0
-    )  # [NW, W]
-    return est_count @ sel.T, est_amt @ sel.T
+def cms_query_fraud(
+    sk: CountMinSketch,
+    key: jnp.ndarray,  # uint32 [B]
+    day: jnp.ndarray,  # int32 [B]
+    windows: Sequence[int],
+    delay: int = 0,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """3-column windowed estimates: (counts, amount_sums, fraud_sums),
+    each [B, NW]. Requires a fraud-tracking sketch (``cms_init(...,
+    track_fraud=True)``). Both count and fraud are overestimate-only, so
+    a risk RATIO derived from them is an estimate, not a bound — the
+    documented sketch-tier degradation."""
+    if sk.fraud is None:
+        raise ValueError(
+            "cms_query_fraud needs a fraud-tracking sketch "
+            "(cms_init(..., track_fraud=True))")
+    return _cms_query_tables(sk, (sk.count, sk.amount, sk.fraud), key, day,
+                             windows, delay)

@@ -1,0 +1,236 @@
+"""Exact on-device key directory: open-addressing uint32 key→slot table.
+
+The dense ``WindowState`` tier historically coupled its capacity to the
+key universe: ``key_mode="direct"`` needs capacity ≥ max(key) + 1 (a
+10M-customer corpus is ~5 GB of HBM window state before donation
+double-buffering), while ``key_mode="hash"`` silently MERGES colliding
+keys' windows. This module decouples the two: the hot tier is sized to
+the *active working set* (``slot_capacity`` rows) and an open-addressing
+hash directory (``dir_capacity`` = 2× slots → load factor ≤ 0.5) maps
+keys to slots *exactly* — a key either owns a private slot or it misses
+admission and is served from the count-min sketch tier, but two keys
+never share window state.
+
+Everything is vectorized, fixed-shape and jit/shard_map-friendly:
+
+- **probing** is double hashing over a power-of-two table
+  (``h1 + j·(h2|1)``, an odd stride walks the whole table) with a FIXED
+  probe depth — lookups scan all P candidate positions and pick the
+  match, so there is no early-exit data dependence and deleted entries
+  need no tombstones;
+- **batched insert** resolves scatter races with claim rounds: round j's
+  writers scatter-min their key into still-empty positions, re-read, and
+  the losers continue to probe j+1. Batch duplicates of one new key all
+  win the same entry; a scatter-min of the row index picks ONE owner to
+  pop the free-slot stack, so one key costs one slot;
+- **the free-slot stack** (``free``/``free_top``) is the admission
+  bound: when it runs dry the claimed entry is rolled back and the row
+  reports ``admitted=False`` — a full hot tier degrades to the sketch
+  tier instead of clobbering a live slot;
+- **reclaim** pushes dead slots back on the stack and vacates their
+  directory entries (no tombstones needed — see probing above), which is
+  what the engine's recency compaction pass calls.
+
+Sentinel note: ``EMPTY_KEY`` (0xFFFFFFFF) is reserved; a real key equal
+to it is remapped to 0xFFFFFFFE (``fold_key`` output collides with that
+one value in 2^32 — the same order of aliasing the 32-bit fold already
+accepts).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from real_time_fraud_detection_system_tpu.ops.hashing import hash_u32
+
+# np scalar, NOT jnp: a module-level jnp constant would run a JAX
+# computation at import time, which breaks jax.distributed.initialize
+# in multiprocess workers (same idiom as ops/hashing._M1/_M2)
+EMPTY_KEY = np.uint32(0xFFFFFFFF)
+
+
+class KeyDirectory(NamedTuple):
+    """Pytree: the directory + the free-slot stack (all HBM-resident).
+
+    Invariant: an entry is either vacant (``keys[e] == EMPTY_KEY`` and
+    ``slots[e] == -1``) or owns exactly one live slot; every slot id is
+    either owned by exactly one entry or sits on the free stack
+    (``free[:free_top]``)."""
+
+    keys: jnp.ndarray  # uint32 [dir_cap]; EMPTY_KEY = vacant
+    slots: jnp.ndarray  # int32 [dir_cap]; slot owned by the entry, -1 vacant
+    free: jnp.ndarray  # int32 [slot_cap]; free[:free_top] = free slot ids
+    free_top: jnp.ndarray  # int32 [] — live height of the free stack
+
+    @property
+    def dir_capacity(self) -> int:
+        return int(self.keys.shape[0])
+
+    @property
+    def slot_capacity(self) -> int:
+        return int(self.free.shape[0])
+
+
+def init_keydir(dir_capacity: int, slot_capacity: int) -> KeyDirectory:
+    assert dir_capacity & (dir_capacity - 1) == 0, \
+        "dir_capacity must be a power of 2"
+    assert slot_capacity <= dir_capacity, \
+        "more slots than directory entries can never all be reachable"
+    return KeyDirectory(
+        keys=jnp.full((dir_capacity,), EMPTY_KEY, dtype=jnp.uint32),
+        slots=jnp.full((dir_capacity,), -1, dtype=jnp.int32),
+        # low slot ids pop first (free[top-1] is the next grant)
+        free=jnp.arange(slot_capacity - 1, -1, -1, dtype=jnp.int32),
+        free_top=jnp.int32(slot_capacity),
+    )
+
+
+def _canon(key: jnp.ndarray) -> jnp.ndarray:
+    key = key.astype(jnp.uint32)
+    return jnp.where(key == EMPTY_KEY, jnp.uint32(0xFFFFFFFE), key)
+
+
+def _probe_positions(key: jnp.ndarray, dir_cap: int,
+                     n_probes: int) -> jnp.ndarray:
+    """[B] keys → [B, P] probe positions (double hashing, odd stride)."""
+    h1 = hash_u32(key, seed=0)
+    h2 = hash_u32(key, seed=1) | jnp.uint32(1)
+    j = jnp.arange(n_probes, dtype=jnp.uint32)
+    pos = (h1[:, None] + j[None, :] * h2[:, None]) \
+        & jnp.uint32(dir_cap - 1)
+    return pos.astype(jnp.int32)
+
+
+def lookup_slots(
+    kd: KeyDirectory,
+    key: jnp.ndarray,  # uint32 [B]
+    valid: jnp.ndarray,  # bool [B]
+    n_probes: int = 8,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Read-only probe: (slot [B] int32, hit [B] bool). Missing/invalid
+    rows return slot 0 with ``hit=False`` — mask before scattering."""
+    key = _canon(key)
+    pos = _probe_positions(key, kd.dir_capacity, n_probes)  # [B, P]
+    found = kd.keys[pos] == key[:, None]  # [B, P]
+    pidx = jnp.argmax(found, axis=1)
+    entry = jnp.take_along_axis(pos, pidx[:, None], axis=1)[:, 0]
+    slot = kd.slots[entry]
+    hit = valid & found.any(axis=1) & (slot >= 0)
+    return jnp.where(hit, slot, 0), hit
+
+
+def admit_slots(
+    kd: KeyDirectory,
+    key: jnp.ndarray,  # uint32 [B]
+    valid: jnp.ndarray,  # bool [B]
+    n_probes: int = 8,
+) -> Tuple[KeyDirectory, jnp.ndarray, jnp.ndarray]:
+    """Lookup-or-insert a batch of keys; the hot path's admission op.
+
+    Returns ``(kd', slot [B] int32, admitted [B] bool)``. A row is
+    admitted iff its key already owned a slot or could claim a directory
+    entry within ``n_probes`` probes AND a free slot remained; batch
+    duplicates of one key share a single slot. Non-admitted rows return
+    slot 0 and MUST be masked out of dense-tier scatters (the caller
+    serves them from the sketch tier).
+    """
+    dir_cap = kd.dir_capacity
+    slot_cap = kd.slot_capacity
+    key = _canon(key)
+    B = int(key.shape[0])
+    pos = _probe_positions(key, dir_cap, n_probes)  # [B, P]
+    keys = kd.keys
+    # FULL-depth lookup FIRST, claims only for keys with no existing
+    # entry: reclaim_entries can vacate a position on a live key's
+    # probe-path PREFIX, and a claim-as-you-probe insert would grab that
+    # vacancy before ever reaching the key's real entry — duplicating
+    # the key, resetting its window history, and leaking its old slot.
+    # (lookup_slots scans all P positions for the same reason; this is
+    # the insert-side half of the no-tombstones argument.)
+    found = keys[pos] == key[:, None]  # [B, P]
+    pidx = jnp.argmax(found, axis=1)
+    hit0 = found.any(axis=1) & valid
+    entry = jnp.where(
+        hit0, jnp.take_along_axis(pos, pidx[:, None], axis=1)[:, 0], 0)
+    placed = ~valid | hit0
+    claimed = jnp.zeros(B, dtype=bool)  # matched via a claim made NOW
+    for j in range(n_probes):
+        p = pos[:, j]
+        cur = keys[p]
+        # batch duplicates of a key claimed in an EARLIER round match
+        # here (pre-call lookup could not see that claim)
+        hit = (~placed) & (cur == key)
+        entry = jnp.where(hit, p, entry)
+        placed = placed | hit
+        # Claim attempt: scatter-min our key into still-empty positions;
+        # among racing writers the smallest key wins, losers re-probe.
+        want = (~placed) & (cur == EMPTY_KEY)
+        cand = jnp.where(want, key, EMPTY_KEY)
+        keys = keys.at[p].min(cand)
+        won = want & (keys[p] == key)
+        entry = jnp.where(won, p, entry)
+        claimed = claimed | won
+        placed = placed | won
+    # One owner per newly claimed entry (batch duplicates of one new key
+    # all carry claimed=True on the same entry; exactly one pops a slot).
+    rows = jnp.arange(B, dtype=jnp.int32)
+    owner = jnp.full((dir_cap,), B, jnp.int32).at[
+        jnp.where(claimed, entry, dir_cap)].min(rows, mode="drop")
+    new = claimed & (owner[entry] == rows)
+    # Grant free slots to owners in row order; owners past the stack
+    # height roll their claim back (their duplicates then miss too).
+    rank = jnp.cumsum(new.astype(jnp.int32)) - 1  # [B]
+    avail = kd.free_top
+    has = new & (rank < avail)
+    slot_new = kd.free[jnp.clip(avail - 1 - rank, 0, slot_cap - 1)]
+    slots = kd.slots.at[jnp.where(has, entry, dir_cap)].set(
+        slot_new, mode="drop")
+    revert = new & ~(rank < avail)
+    keys = keys.at[jnp.where(revert, entry, dir_cap)].set(
+        EMPTY_KEY, mode="drop")
+    free_top = avail - jnp.sum(has.astype(jnp.int32))
+    # Final resolution covers every case at once: hits, fresh grants,
+    # batch duplicates of grants, rolled-back claims (keys[entry] no
+    # longer matches), and rows that never placed (probe overflow).
+    slot = slots[entry]
+    admitted = placed & valid & (keys[entry] == key) & (slot >= 0)
+    return (
+        KeyDirectory(keys=keys, slots=slots, free=kd.free,
+                     free_top=free_top),
+        jnp.where(admitted, slot, 0),
+        admitted,
+    )
+
+
+def reclaim_entries(
+    kd: KeyDirectory,
+    dead_entry: jnp.ndarray,  # bool [dir_cap] — entries to vacate
+) -> Tuple[KeyDirectory, jnp.ndarray, jnp.ndarray]:
+    """Vacate ``dead_entry`` positions and push their slots back on the
+    free stack. Returns ``(kd', dead [dir_cap] bool, n_reclaimed [])``
+    — ``dead`` is the mask restricted to live entries, which the caller
+    uses to reset the reclaimed slots' window rows."""
+    slot_cap = kd.slot_capacity
+    dead = dead_entry & (kd.slots >= 0)
+    rank = jnp.cumsum(dead.astype(jnp.int32)) - 1
+    push = jnp.where(dead, kd.free_top + rank, slot_cap)
+    free = kd.free.at[push].set(kd.slots, mode="drop")
+    n = jnp.sum(dead.astype(jnp.int32))
+    return (
+        KeyDirectory(
+            keys=jnp.where(dead, EMPTY_KEY, kd.keys),
+            slots=jnp.where(dead, -1, kd.slots),
+            free=free,
+            free_top=kd.free_top + n,
+        ),
+        dead,
+        n,
+    )
+
+
+def occupied_slots(kd: KeyDirectory) -> jnp.ndarray:
+    """Live slot count (int32 scalar): slots granted and not reclaimed."""
+    return jnp.int32(kd.slot_capacity) - kd.free_top

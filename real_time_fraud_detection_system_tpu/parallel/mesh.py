@@ -188,7 +188,9 @@ def reshard_feature_state(
 
     cms = state.cms
     if cms is not None:
-        leaves = [np.asarray(a) for a in cms]
+        # fraud is Optional (None on every pre-tiering config): merge
+        # only the tables that exist, keep None as None
+        leaves = [None if a is None else np.asarray(a) for a in cms]
         if n_old > 1 and leaves[0].ndim > 1:
             if leaves[0].shape[0] != n_old:
                 raise ValueError(
@@ -206,7 +208,8 @@ def reshard_feature_state(
             fresh = (days == max_day[None]).astype(leaves[1].dtype)
             single = type(cms)(
                 max_day,
-                *[(a * fresh[..., None, None]).sum(axis=0)
+                *[None if a is None
+                  else (a * fresh[..., None, None]).sum(axis=0)
                   for a in leaves[1:]],
             )
         else:
@@ -220,7 +223,11 @@ def reshard_feature_state(
         # branch exists to avoid).
         cms = single
 
-    return FeatureState(
+    # _replace: the tiered-store fields (directories, terminal sketch)
+    # pass through untouched — exact mode is single-chip today, so they
+    # are None on every state that can reach a reshard, but dropping
+    # them silently here would be a trap for the item-1 follow-up
+    return state._replace(
         customer=convert(state.customer, fcfg.customer_capacity),
         terminal=convert(state.terminal, fcfg.terminal_capacity),
         cms=cms,

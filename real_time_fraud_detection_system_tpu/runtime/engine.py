@@ -34,7 +34,9 @@ from real_time_fraud_detection_system_tpu.core.batch import (
 from real_time_fraud_detection_system_tpu.features.online import (
     FeatureState,
     init_feature_state,
+    state_bytes,
     update_and_featurize,
+    update_and_featurize_exact,
     update_and_score_pallas,
     update_and_score_pallas_forest,
 )
@@ -318,6 +320,16 @@ class ScoringEngine:
         # of the feature-state buffers is disabled while it is on.
         self._donate = () if self._nan_guard else (0,)
         self._init_telemetry(metrics)
+        # Tiered-store attrs exist on EVERY engine (the shared batch path
+        # reads them); only the non-sequence constructor below can arm
+        # them.
+        self._exact = False
+        self._compact_every = 0
+        self._compact = None
+        self._max_day = 0
+        self._m_tier = None
+        self._m_slots_occ = None
+        self._m_slots_rec = None
         if cfg.runtime.emit_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"emit_dtype must be float32|bfloat16, "
@@ -326,6 +338,11 @@ class ScoringEngine:
             # Long-context serving: per-customer event histories in HBM
             # scored by the causal transformer — a different state and
             # step shape, built in its own branch.
+            if cfg.features.key_mode == "exact":
+                raise ValueError(
+                    "key_mode='exact' is the windows-plane tiered "
+                    "feature store; kind='sequence' serves from its own "
+                    "history state (keep key_mode direct/hash)")
             if self.scorer == "cpu":
                 raise ValueError(
                     "kind='sequence' has no sklearn oracle — "
@@ -402,6 +419,16 @@ class ScoringEngine:
         self.selective_overflows = 0
         self._feedback_step = None
         self._state_feedback_step = None
+        # Tiered feature store (key_mode="exact"): the step routes slots
+        # through the exact key directory, serves admission misses from
+        # the sketch tier, and returns per-batch tier counts; a periodic
+        # compaction step (its own DispatchSignature variant, see
+        # dispatch_inventory) reclaims dead hot-tier slots.
+        self._exact = cfg.features.key_mode == "exact"
+        self._compact_every = (cfg.features.compact_every
+                               if self._exact else 0)
+        self._check_state_budget()
+        self._init_state_telemetry()
         # Depth-bounded tree ensembles score ~100× faster on TPU in the GEMM
         # form (see models/forest.py::predict_proba); convert once at build.
         params = device_params_for(kind, params)
@@ -415,10 +442,16 @@ class ScoringEngine:
         fcfg = cfg.features
         z_mode = self.z_mode
 
+        # Both FUSED featurize→score kernels read gathered hot-tier rows
+        # directly and know nothing of the sketch fallback, so the
+        # tiered exact mode keeps the XLA composition (the pure predict
+        # swap in _maybe_use_pallas_forest still applies — it consumes
+        # the already-assembled feature matrix).
         use_pallas = (
             cfg.runtime.use_pallas
             and kind == "logreg"
             and cfg.features.customer_source == "table"
+            and not self._exact
         )
         # Fused featurize→score forest step (ops/pallas_forest.py): the
         # round-9 kernel that keeps the feature block VMEM-resident past
@@ -432,6 +465,7 @@ class ScoringEngine:
             and kind in ("tree", "forest")
             and cfg.features.customer_source == "table"
             and self.scorer != "cpu"
+            and not self._exact
         )
         if use_pallas_forest:
             from real_time_fraud_detection_system_tpu.models.forest import (
@@ -448,10 +482,21 @@ class ScoringEngine:
             return (use_pallas_forest and isinstance(p, GemmEnsemble)
                     and admit_block(p, z_mode, _PALLAS_BLOCK_BUDGET).fits)
 
+        exact = self._exact
+
+        def _featurize(fstate, batch):
+            # one shared featurize for the non-fused branches: the tiered
+            # exact path additionally returns [dense, cms] row counts
+            if exact:
+                return update_and_featurize_exact(fstate, batch, fcfg)
+            fstate, feats = update_and_featurize(fstate, batch, fcfg)
+            return fstate, feats, None
+
         def step(fstate: FeatureState, params, scaler: Scaler, packed):
             # One packed H2D array per batch (see core.batch.pack_batch):
             # the unpack is free bitcasts inside the fused program.
             batch = unpack_batch(packed)
+            tier = None
             if use_pallas:
                 fstate, probs, feats = update_and_score_pallas(
                     fstate, batch, fcfg, scaler.mean, scaler.scale,
@@ -470,11 +515,11 @@ class ScoringEngine:
                 # Oracle serving: the classifier runs host-side on the
                 # returned features (process_batch), so don't burn device
                 # time on a predict whose output is discarded.
-                fstate, feats = update_and_featurize(fstate, batch, fcfg)
+                fstate, feats, tier = _featurize(fstate, batch)
                 x = transform(scaler, feats)
                 probs = jnp.zeros(batch.valid.shape, jnp.float32)
             else:
-                fstate, feats = update_and_featurize(fstate, batch, fcfg)
+                fstate, feats, tier = _featurize(fstate, batch)
                 x = transform(scaler, feats)
                 probs = self._predict(params, x)
                 probs = jnp.where(batch.valid, probs, 0.0)
@@ -511,12 +556,25 @@ class ScoringEngine:
                     idx.astype(jnp.float32),
                     feats[idx].reshape(-1),
                 ])
-                return fstate, params, probs, {
-                    "packed": packed_out, "full": feats,
-                }
-            return fstate, params, probs, feats
+                emit = {"packed": packed_out, "full": feats}
+            else:
+                emit = feats
+            if exact:
+                # 5th output only in the tiered mode: every engine config
+                # has ONE static step arity, so the dispatch signatures
+                # stay enumerable (dispatch_inventory) and AOT-coverable.
+                return fstate, params, probs, emit, tier
+            return fstate, params, probs, emit
 
         self._step = jax.jit(step, donate_argnums=self._donate)
+        if self._exact:
+            from real_time_fraud_detection_system_tpu.features.online \
+                import compact_feature_state
+
+            def compact(fstate: FeatureState, now_day):
+                return compact_feature_state(fstate, now_day, fcfg)
+
+            self._compact = jax.jit(compact, donate_argnums=self._donate)
 
     def _init_telemetry(self, metrics) -> None:
         """Resolve the registry series ONCE at build time: the hot loop
@@ -624,6 +682,136 @@ class ScoringEngine:
             for o in ("clean", "clobbered_online_updates")
         }
 
+    # -- tiered feature store (key_mode="exact") ---------------------------
+
+    def _check_state_budget(self) -> None:
+        """``features.state_hbm_budget_mb``: fail the BUILD, not the
+        stream, when the configured feature state cannot fit the budget
+        (static ``state_bytes`` accounting; the same numbers bench's
+        ``detail.state_scale`` reports)."""
+        fcfg = self.cfg.features
+        if fcfg.state_hbm_budget_mb <= 0:
+            return
+        sb = state_bytes(fcfg)
+        budget = int(fcfg.state_hbm_budget_mb * 2 ** 20)
+        if sb["total"] > budget:
+            raise ValueError(
+                f"feature state needs {sb['total']} bytes "
+                f"(dense {sb['dense']}, directory {sb['directory']}, "
+                f"cms {sb['cms']}) against a state_hbm_budget_mb="
+                f"{fcfg.state_hbm_budget_mb:g} budget ({budget} bytes) — "
+                "shrink the hot tier (customer_capacity/"
+                "terminal_capacity), the sketch (cms_width), or raise "
+                "the budget")
+
+    def _init_state_telemetry(self) -> None:
+        """Tiered-store observability (registered only when the tier
+        machinery is live, so plain direct/hash runs keep /healthz
+        clean; bytes gauges also register whenever a budget is set)."""
+        reg = self.metrics
+        fcfg = self.cfg.features
+        self._m_tier = None
+        self._m_slots_occ = None
+        self._m_slots_rec = None
+        if self._exact:
+            self._m_tier = {
+                t: reg.counter(
+                    "rtfds_feature_tier_rows_total",
+                    "row x keyspace feature reads served per tier "
+                    "(dense = private hot-tier slot; cms = count-min "
+                    "sketch fallback after an admission miss)", tier=t)
+                for t in ("dense", "cms")
+            }
+            tables = (("customer", fcfg.customer_source != "cms"),
+                      ("terminal", True))
+            self._m_slots_occ = {
+                t: reg.gauge(
+                    "rtfds_feature_slots_occupied",
+                    "hot-tier slots currently owned by a key "
+                    "(updated at compaction cadence)", table=t)
+                for t, present in tables if present
+            }
+            self._m_slots_rec = {
+                t: reg.counter(
+                    "rtfds_feature_slots_reclaimed_total",
+                    "hot-tier slots reclaimed by recency compaction "
+                    "(the slot held only history older than "
+                    "delay + max(window))", table=t)
+                for t, present in tables if present
+            }
+        if self._exact or fcfg.state_hbm_budget_mb > 0:
+            sb = state_bytes(fcfg)
+            for tier in ("dense", "directory", "cms", "total"):
+                reg.gauge(
+                    "rtfds_feature_state_bytes",
+                    "HBM bytes of the configured feature state per tier "
+                    "(static accounting, features/online.state_bytes)",
+                    tier=tier).set(float(sb[tier]))
+            reg.gauge(
+                "rtfds_feature_state_budget_bytes",
+                "configured feature-state HBM budget "
+                "(state_hbm_budget_mb; 0 = unchecked)").set(
+                float(fcfg.state_hbm_budget_mb * 2 ** 20))
+
+    def _note_batch_days(self, cols: dict) -> None:
+        """Track the newest day the stream has seen — compaction's
+        recency cutoff input (one vectorized max per batch)."""
+        if not self._compact_every:
+            return
+        us = cols.get("tx_datetime_us")
+        if us is not None and len(us):
+            from real_time_fraud_detection_system_tpu.core.batch import (
+                US_PER_DAY,
+            )
+
+            self._max_day = max(self._max_day,
+                                int(np.max(us) // US_PER_DAY))
+
+    def _maybe_compact(self) -> None:
+        """Run the recency-compaction step on its cadence (called once
+        per finished batch, between device steps — the same
+        single-threaded contract as feedback). Dispatch chains through
+        ``state.feature_state`` like every step, so in-flight batches
+        (dispatched earlier) are unaffected and the next batch serves
+        post-compaction state."""
+        if (not self._compact_every
+                or self.state.batches_done % self._compact_every != 0):
+            return
+        day = jnp.asarray(np.int32(self._max_day))
+        with self.tracer.span("state_compact", day=self._max_day):
+            with self._recompile.step(step_signature(
+                    day, static=(self.kind, "compact"))):
+                fstate, reclaimed = self._dispatch_step(
+                    ("compact",), self._compact,
+                    self.state.feature_state, day)
+        self.state.feature_state = fstate
+        rec = np.asarray(reclaimed)  # [customer, terminal]
+        occupied = {}
+        for i, table in enumerate(("customer", "terminal")):
+            if table in self._m_slots_rec:
+                self._m_slots_rec[table].inc(int(rec[i]))
+            kd = getattr(fstate, f"{table}_dir")
+            if kd is not None and table in self._m_slots_occ:
+                # the reclaimed fetch above already synced the step, so
+                # this scalar read is free
+                occ = int(kd.slot_capacity) - int(np.asarray(kd.free_top))
+                self._m_slots_occ[table].set(occ)
+                occupied[table] = occ
+        rec_now = int(rec.sum())
+        recorder = self.recorder if self.recorder is not None \
+            else active_recorder()
+        if recorder is not None:
+            tiers = {t: m.value for t, m in (self._m_tier or {}).items()}
+            recorder.record_event(
+                "feature_state", reclaimed=rec_now,
+                occupied=sum(occupied.values()),
+                capacity=sum(
+                    getattr(fstate, f"{t}_dir").slot_capacity
+                    for t in occupied),
+                dense_rows=tiers.get("dense", 0.0),
+                cms_rows=tiers.get("cms", 0.0),
+                batch=self.state.batches_done)
+
     # -- AOT bucket precompilation ----------------------------------------
 
     @staticmethod
@@ -665,7 +853,7 @@ class ScoringEngine:
         neither re-derives its own enumeration, so they cannot drift.
         """
         zmode_kinds = ("tree", "forest", "gbt")
-        return [
+        sigs = [
             DispatchSignature(
                 key=("step", 7, int(b)),
                 variant="step",
@@ -679,6 +867,25 @@ class ScoringEngine:
             )
             for b in sorted(set(self.cfg.runtime.batch_buckets))
         ]
+        if self._compact_every:
+            # The recency-compaction pass is part of the compiled step
+            # family: ONE shape (the full state + an int32 day scalar),
+            # AOT-compiled at warmup like every bucket, so the cadence
+            # can fire mid-stream without a recompile. No z contraction,
+            # no emission, no Pallas — the per-signature checks that key
+            # on those facts correctly skip it.
+            sigs.append(DispatchSignature(
+                key=("compact",),
+                variant="compact",
+                kind=self.kind,
+                z_mode=None,
+                bucket=0,
+                donate=tuple(self._donate),
+                selective=False,
+                emit_dtype=self.cfg.runtime.emit_dtype,
+                use_pallas=False,
+            ))
+        return sigs
 
     def signature_templates(self, sig: DispatchSignature) -> tuple:
         """Shape-only argument templates for ``sig`` — what
@@ -687,6 +894,11 @@ class ScoringEngine:
         work; callers that need runtime-exact dtypes (precompile, the
         verifier) must commit scalar param leaves to arrays first (see
         :meth:`precompile`)."""
+        if sig.variant == "compact":
+            return (
+                self._sds(self.state.feature_state),
+                jax.ShapeDtypeStruct((), jnp.int32),
+            )
         return (
             self._sds(self.state.feature_state),
             self._sds(self.state.params),
@@ -696,8 +908,10 @@ class ScoringEngine:
 
     def signature_step(self, sig: DispatchSignature):
         """The jitted callable ``sig`` dispatches to (one shared step
-        for the single-chip engine; the sharded engine overrides with
-        its per-variant builds)."""
+        for the single-chip engine plus the compaction variant; the
+        sharded engine overrides with its per-variant builds)."""
+        if sig.variant == "compact":
+            return self._compact
         return self._step
 
     def precompile(self) -> dict:
@@ -1047,21 +1261,25 @@ class ScoringEngine:
             # loop.
             with self._recompile.step(step_signature(
                     jbatch, static=(self.kind, "donate0", self.z_mode))):
-                fstate, params, probs, feats = self._dispatch_step(
+                out = self._dispatch_step(
                     ("step",) + tuple(jbatch.shape), self._step,
                     self.state.feature_state, self.state.params,
                     self.state.scaler, jbatch,
                 )
+            fstate, params, probs, feats = out[:4]
+            tier = out[4] if self._exact else None
             self.state.feature_state = fstate
             self.state.params = params
+            self._note_batch_days(cols)
             # Start the D2H copies NOW (they queue behind the step's
             # compute): by the time _finish_batch blocks, the transfer
             # has been running since compute finished.
             t_fetch = self._issue_host_fetch(probs, feats)
             t2 = time.perf_counter()
         return {"cols": cols, "n": n, "probs": probs, "feats": feats,
-                "t0": t0, "prep_s": t1 - t0, "dispatch_s": t2 - t1,
-                "pre_state": pre_state, "fetch_issue_t": t_fetch}
+                "tier": tier, "t0": t0, "prep_s": t1 - t0,
+                "dispatch_s": t2 - t1, "pre_state": pre_state,
+                "fetch_issue_t": t_fetch}
 
     def _finish_batch(self, handle: dict) -> BatchResult:
         """Block on the handle's device futures; build the BatchResult."""
@@ -1224,11 +1442,19 @@ class ScoringEngine:
             # in-step online SGD consumed this batch's in-band labels:
             # the on-device params now lead the last published artifact
             self._online_dirty = True
+        tier = handle.get("tier")
+        if tier is not None and self._m_tier is not None:
+            # [dense, cms] row x keyspace admissions this batch; the
+            # step already materialized, so this tiny fetch is free
+            t = np.asarray(tier)
+            self._m_tier["dense"].inc(float(t[0]))
+            self._m_tier["cms"].inc(float(t[1]))
         self.state.batches_done += 1
         self.state.rows_done += n
         self._m_batches.inc()
         self._m_rows.inc(n)
         self._m_last.set(time.time())
+        self._maybe_compact()
         # Device-memory gauges ride the batch cadence; on backends
         # without memory stats (CPU) this is a single boolean check.
         self._devmem.sample()

@@ -105,6 +105,16 @@ class ShardedScoringEngine(ScoringEngine):
         permutations that nothing else can tell apart. Omit
         ``feature_state_n_old`` only when the state is already in this
         mesh's layout. Default: fresh state."""
+        if cfg.features.key_mode == "exact":
+            # The mesh step's owner layout routes keys by a global modulo
+            # (parallel/step.py) — the tiered exact store replaces that
+            # with a per-shard directory exchange, which is the ROADMAP
+            # item-1 follow-up. Refuse loudly rather than silently serve
+            # modulo placement under an "exact" flag.
+            raise ValueError(
+                "key_mode='exact' (the tiered device-resident feature "
+                "store) is single-chip for now; serve with --devices 1, "
+                "or keep key_mode direct/hash on the mesh")
         if cfg.runtime.nan_guard:
             # The sharded step donates state inside shard_map and a batch
             # spans several chunk steps — there is no pre-batch anchor to

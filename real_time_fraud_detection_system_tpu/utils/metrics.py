@@ -658,6 +658,47 @@ class MetricsServer:
             if ev is not None:
                 cache["evictions"] = ev
             extras["feature_cache"] = cache
+        # Tiered feature store (key_mode="exact"): per-table hot-tier
+        # occupancy, compaction reclaim totals, the dense-tier hit rate,
+        # and state bytes vs the configured HBM budget — present only
+        # once an exact-mode engine registered the occupancy gauges, so
+        # direct/hash runs keep a clean body.
+        occ_tables: Dict[str, float] = {}
+        for table in ("customer", "terminal"):
+            g = self.registry.get("rtfds_feature_slots_occupied",
+                                  table=table)
+            if g is not None:
+                occ_tables[table] = g.value
+        if occ_tables:
+            fstate: Dict[str, object] = {"slots_occupied": occ_tables}
+            rec = self.registry.family_total(
+                "rtfds_feature_slots_reclaimed_total")
+            if rec is not None:
+                fstate["slots_reclaimed"] = rec
+            dense = self.registry.get("rtfds_feature_tier_rows_total",
+                                      tier="dense")
+            cms_t = self.registry.get("rtfds_feature_tier_rows_total",
+                                      tier="cms")
+            if dense is not None or cms_t is not None:
+                d = dense.value if dense is not None else 0.0
+                c = cms_t.value if cms_t is not None else 0.0
+                fstate["tier_rows"] = {"dense": d, "cms": c}
+                total = d + c
+                # both tiers serve correct-contract features; the hit
+                # rate tells the operator how EXACT the serving mix is
+                fstate["dense_hit_rate"] = (round(d / total, 4)
+                                            if total else 1.0)
+            sb = self.registry.get("rtfds_feature_state_bytes",
+                                   tier="total")
+            if sb is not None:
+                fstate["state_bytes"] = sb.value
+                budget = self.registry.get(
+                    "rtfds_feature_state_budget_bytes")
+                if budget is not None and budget.value > 0:
+                    fstate["budget_bytes"] = budget.value
+                    fstate["budget_used"] = round(
+                        sb.value / budget.value, 4)
+            extras["feature_state"] = fstate
         # Device plane: the z-contraction mode the serving step compiled
         # with and whether the fused Pallas path is on — present only
         # once an engine registered the gauges, so non-serving processes

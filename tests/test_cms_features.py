@@ -96,3 +96,112 @@ def test_engine_runs_cms_mode(small_dataset):
     stats = eng.run(ReplaySource(txs.slice(slice(0, 1024)), 1_743_465_600,
                                  batch_rows=512))
     assert stats["rows"] == 1024
+
+
+# ---------------------------------------------------------------------------
+# fraud-column back-compat (the tiered store's sketch-tier extension)
+# ---------------------------------------------------------------------------
+
+def test_cms_fraud_column_backcompat_bit_identical(rng):
+    """count/amount behavior of a fraud-tracking sketch is BIT-identical
+    to the historical 2-column sketch for the same stream, and
+    cms_query is untouched for existing configs."""
+    from real_time_fraud_detection_system_tpu.ops.cms import (
+        cms_init,
+        cms_query,
+        cms_query_fraud,
+        cms_update,
+    )
+
+    b = _batch(rng)
+    key = jnp.asarray(b.customer_key if hasattr(b, "customer_key")
+                      else b.customer_id)
+    day = jnp.asarray(b.day)
+    amt = jnp.asarray(b.amount)
+    valid = jnp.ones(day.shape, bool)
+    fraud = jnp.asarray((np.asarray(day) % 3 == 0).astype(np.float32))
+
+    old = cms_init(4, 1 << 10, 40)                      # 2-column
+    new = cms_init(4, 1 << 10, 40, track_fraud=True)    # 3-column
+    assert old.fraud is None and new.fraud is not None
+    old = cms_update(old, key, amt, day, valid)
+    new = cms_update(new, key, amt, day, valid, fraud=fraud)
+    np.testing.assert_array_equal(np.asarray(old.count),
+                                  np.asarray(new.count))
+    np.testing.assert_array_equal(np.asarray(old.amount),
+                                  np.asarray(new.amount))
+    c_o, a_o = cms_query(old, key, day, (1, 7, 30))
+    c_n, a_n, f_n = cms_query_fraud(new, key, day, (1, 7, 30))
+    np.testing.assert_array_equal(np.asarray(c_o), np.asarray(c_n))
+    np.testing.assert_array_equal(np.asarray(a_o), np.asarray(a_n))
+    # fraud estimates obey the overestimate-only contract per key/day
+    assert (np.asarray(f_n) >= -1e-6).all()
+    # querying fraud off a 2-column sketch refuses loudly
+    with pytest.raises(ValueError, match="track_fraud"):
+        cms_query_fraud(old, key, day, (1, 7, 30))
+
+
+def test_cms_delay_zero_query_bit_identical(rng):
+    """cms_query grew a delay param for the terminal sketch tier;
+    delay=0 (every existing call site) must stay bit-identical."""
+    from real_time_fraud_detection_system_tpu.ops.cms import (
+        cms_init,
+        cms_query,
+        cms_update,
+    )
+
+    b = _batch(rng)
+    key, day = jnp.asarray(b.customer_key), jnp.asarray(b.day)
+    sk = cms_update(cms_init(4, 1 << 10, 40), key,
+                    jnp.asarray(b.amount), day, jnp.ones(day.shape, bool))
+    c0, a0 = cms_query(sk, key, day, (1, 7, 30))
+    c1, a1 = cms_query(sk, key, day, (1, 7, 30), delay=0)
+    np.testing.assert_array_equal(np.asarray(c0), np.asarray(c1))
+    np.testing.assert_array_equal(np.asarray(a0), np.asarray(a1))
+    # a positive delay shifts the window exactly like the dense tier:
+    # querying at day+d with delay=d sees the same buckets as delay=0
+    d = 7
+    c2, a2 = cms_query(sk, key, day + d, (1, 7, 30), delay=d)
+    np.testing.assert_array_equal(np.asarray(c0), np.asarray(c2))
+    np.testing.assert_array_equal(np.asarray(a0), np.asarray(a2))
+
+
+def test_v1_checkpoint_with_two_column_sketch_still_restores(
+        rng, tmp_path):
+    """A checkpoint written from a pre-tiering config (2-column sketch,
+    no directories) must restore into today's template for the SAME
+    config — the Optional fields contribute no pytree leaves."""
+    import jax as _jax
+
+    from real_time_fraud_detection_system_tpu.io.checkpoint import (
+        Checkpointer,
+    )
+    from real_time_fraud_detection_system_tpu.models.logreg import (
+        init_logreg,
+    )
+    from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+    from real_time_fraud_detection_system_tpu.runtime.engine import (
+        EngineState,
+    )
+
+    _, cms_cfg = _cfgs()
+    b = jax.tree.map(jnp.asarray, _batch(rng))
+    st = init_feature_state(cms_cfg)
+    # pin the pre-tiering leaf structure: 4+4 window leaves + 3 sketch
+    # leaves, exactly what a v1 checkpoint holds for this config
+    assert len(_jax.tree.leaves(st)) == 11
+    st, _ = update_and_featurize(st, b, cms_cfg)
+    state = EngineState(feature_state=st, params=init_logreg(15),
+                        scaler=Scaler(jnp.zeros(15), jnp.ones(15)),
+                        offsets=[3], batches_done=1, rows_done=256)
+    ck = Checkpointer(str(tmp_path))
+    ck.save(state)
+    tmpl = EngineState(feature_state=init_feature_state(cms_cfg),
+                       params=init_logreg(15),
+                       scaler=Scaler(jnp.zeros(15), jnp.ones(15)))
+    restored = ck.restore(tmpl)
+    rs = restored.feature_state
+    assert rs.customer_dir is None and rs.terminal_cms is None
+    np.testing.assert_array_equal(np.asarray(rs.cms.count),
+                                  np.asarray(st.cms.count))
+    assert restored.batches_done == 1

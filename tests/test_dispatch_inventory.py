@@ -148,3 +148,34 @@ def test_inventory_keys_are_the_runtime_dispatch_keys():
         eng.process_batch(cols)
     assert reg.get("rtfds_aot_fallbacks_total").value == 0
     assert eng._aot, "fallback path silently dropped the AOT cache"
+
+
+def test_exact_mode_inventory_enumerates_compact_variant():
+    """key_mode='exact' + compact_every adds the recency-compaction pass
+    as its own signature; precompile compiles it with the buckets (the
+    registry count proves it), and the variant carries no z contraction
+    or Pallas claim for the per-signature checks to misfire on."""
+    import dataclasses as _dc
+
+    reg = MetricsRegistry()
+    cfg = _cfg()
+    cfg = cfg.replace(features=_dc.replace(
+        cfg.features, key_mode="exact", compact_every=4))
+    eng = ScoringEngine(cfg, "forest", _forest_params(), _scaler(),
+                        metrics=reg)
+    inv = eng.dispatch_inventory()
+    assert [s.key for s in inv] == [("step", 7, 64), ("step", 7, 256),
+                                    ("compact",)]
+    compact = inv[-1]
+    assert compact.variant == "compact"
+    assert compact.z_mode is None and not compact.use_pallas
+    eng.precompile()
+    assert reg.get("rtfds_precompiled_steps_total").value == len(inv)
+    assert sorted(eng._aot) == sorted(s.key for s in inv)
+    # compaction off -> no compact signature (and no dead executable)
+    cfg2 = _cfg().replace(features=_dc.replace(
+        _cfg().features, key_mode="exact", compact_every=0))
+    eng2 = ScoringEngine(cfg2, "forest", _forest_params(), _scaler(),
+                         metrics=MetricsRegistry())
+    assert [s.key for s in eng2.dispatch_inventory()] \
+        == [("step", 7, 64), ("step", 7, 256)]

@@ -65,3 +65,72 @@ def test_terminal_in_radius(small_dataset):
     ty = terminals.y[txs.terminal_id]
     d = np.sqrt((cx - tx) ** 2 + (cy - ty) ** 2)
     assert d.max() < cfg.radius
+
+
+# ---------------------------------------------------------------------------
+# Zipf-skewed key corpus (the 10M-key feature-state scale mode)
+# ---------------------------------------------------------------------------
+
+def test_zipf_sampler_skew_and_bounds():
+    from real_time_fraud_detection_system_tpu.data.generator import (
+        ZipfKeySampler,
+    )
+
+    rng = np.random.default_rng(0)
+    s = ZipfKeySampler(100_000, skew=1.2)
+    keys = s.sample(rng, 50_000)
+    assert keys.min() >= 0 and keys.max() < 100_000
+    # heavy head: a handful of hot keys dominate a skewed draw
+    _, counts = np.unique(keys, return_counts=True)
+    top = np.sort(counts)[::-1]
+    assert top[:10].sum() > 0.25 * len(keys)
+    # skew=0 degenerates to ~uniform: the head carries no such mass
+    u = ZipfKeySampler(100_000, skew=0.0).sample(rng, 50_000)
+    _, uc = np.unique(u, return_counts=True)
+    assert np.sort(uc)[::-1][:10].sum() < 0.01 * len(u)
+
+
+def test_zipf_sampler_scatters_hot_keys_over_id_space():
+    from real_time_fraud_detection_system_tpu.data.generator import (
+        ZipfKeySampler,
+    )
+
+    rng = np.random.default_rng(1)
+    keys = ZipfKeySampler(1 << 20, skew=1.3).sample(rng, 20_000)
+    # hot ranks must not pile into the low ids (a direct-mode table
+    # would accidentally favor them); the stride spreads them out
+    assert np.median(keys) > (1 << 20) * 0.05
+
+
+def test_zipf_stream_cols_engine_ready():
+    from real_time_fraud_detection_system_tpu.data.generator import (
+        ZipfKeySampler,
+        zipf_stream_cols,
+    )
+
+    rng = np.random.default_rng(2)
+    s = ZipfKeySampler(10_000, skew=1.1)
+    cols = zipf_stream_cols(rng, 256, s, n_terminals=1000, day=20200,
+                            tx_id_start=512)
+    for k in ("tx_id", "tx_datetime_us", "customer_id", "terminal_id",
+              "tx_amount_cents", "kafka_ts_ms"):
+        assert k in cols and len(cols[k]) == 256
+    assert cols["tx_id"][0] == 512 and cols["tx_id"][-1] == 512 + 255
+    day = cols["tx_datetime_us"] // (86400 * 1_000_000)
+    assert (day == 20200).all()
+    assert (cols["terminal_id"] >= 0).all() \
+        and (cols["terminal_id"] < 1000).all()
+    assert (cols["tx_amount_cents"] > 0).all()
+
+
+def test_zipf_sampler_validates():
+    import pytest as _pytest
+
+    from real_time_fraud_detection_system_tpu.data.generator import (
+        ZipfKeySampler,
+    )
+
+    with _pytest.raises(ValueError):
+        ZipfKeySampler(0)
+    with _pytest.raises(ValueError):
+        ZipfKeySampler(10, skew=-1.0)

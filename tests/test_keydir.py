@@ -1,0 +1,194 @@
+"""Exact on-device key directory (ops/keydir.py): batched insert race
+resolution, duplicate coalescing, free-list-bounded admission, read-only
+lookup, and reclaim — the primitives the tiered feature store
+(key_mode="exact") is built from."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from real_time_fraud_detection_system_tpu.ops.keydir import (
+    EMPTY_KEY,
+    admit_slots,
+    init_keydir,
+    lookup_slots,
+    occupied_slots,
+    reclaim_entries,
+)
+
+
+def _admit(kd, keys, valid=None):
+    k = jnp.asarray(np.asarray(keys, np.uint32))
+    v = jnp.ones(k.shape, bool) if valid is None else jnp.asarray(valid)
+    return admit_slots(kd, k, v)
+
+
+def test_admit_assigns_unique_slots_and_coalesces_duplicates():
+    kd = init_keydir(64, 16)
+    kd, slot, adm = _admit(kd, [5, 5, 7, 9, 5, 11])
+    slot, adm = np.asarray(slot), np.asarray(adm)
+    assert adm.all()
+    # batch duplicates of one key share ONE slot (and one grant)
+    assert slot[0] == slot[1] == slot[4]
+    assert len({slot[0], slot[2], slot[3], slot[5]}) == 4
+    assert int(occupied_slots(kd)) == 4
+
+
+def test_admit_is_stable_across_batches():
+    kd = init_keydir(64, 16)
+    kd, s1, _ = _admit(kd, [100, 200, 300])
+    kd, s2, adm = _admit(kd, [300, 100, 200])
+    np.testing.assert_array_equal(
+        np.asarray(s2), np.asarray(s1)[[2, 0, 1]])
+    assert np.asarray(adm).all()
+    assert int(occupied_slots(kd)) == 3  # no double-allocation
+
+
+def test_admission_bounded_by_free_list_then_recovers():
+    kd = init_keydir(64, 8)
+    kd, _, adm = _admit(kd, np.arange(12))
+    # exactly slot_capacity keys admitted; the rest overflow gracefully
+    assert int(np.asarray(adm).sum()) == 8
+    assert int(kd.free_top) == 0
+    # a full table still serves existing keys and refuses new ones
+    kd, slot, adm2 = _admit(kd, [0, 999])
+    adm2 = np.asarray(adm2)
+    assert bool(adm2[0]) and not bool(adm2[1])
+    # reclaim everything → the same 12 keys now all admit again
+    kd, _, n = reclaim_entries(kd, jnp.ones(64, bool))
+    assert int(n) == 8 and int(kd.free_top) == 8
+    kd, _, adm3 = _admit(kd, np.arange(8))
+    assert np.asarray(adm3).all()
+
+
+def test_invalid_rows_never_place():
+    kd = init_keydir(64, 16)
+    kd, slot, adm = _admit(kd, [1, 2, 3], valid=[True, False, True])
+    assert not bool(np.asarray(adm)[1])
+    assert int(occupied_slots(kd)) == 2
+    _, hit = lookup_slots(kd, jnp.asarray(np.uint32(2))[None],
+                          jnp.ones(1, bool))
+    assert not bool(hit[0])
+
+
+def test_lookup_is_read_only_and_exact():
+    kd = init_keydir(64, 16)
+    kd, slot, _ = _admit(kd, [42, 43])
+    got, hit = lookup_slots(kd, jnp.asarray(np.array([43, 42, 44],
+                                                     np.uint32)),
+                            jnp.ones(3, bool))
+    hit = np.asarray(hit)
+    assert bool(hit[0]) and bool(hit[1]) and not bool(hit[2])
+    np.testing.assert_array_equal(np.asarray(got)[:2],
+                                  np.asarray(slot)[[1, 0]])
+    # lookup never allocates
+    assert int(occupied_slots(kd)) == 2
+
+
+def test_reclaim_frees_entries_and_slots_consistently():
+    kd = init_keydir(64, 16)
+    kd, slot, _ = _admit(kd, [1, 2, 3, 4])
+    # vacate exactly key 2's entry
+    target = int(np.asarray(slot)[1])
+    dead_entry = np.asarray(kd.slots) == target
+    kd, dead, n = reclaim_entries(kd, jnp.asarray(dead_entry))
+    assert int(n) == 1 and int(occupied_slots(kd)) == 3
+    _, hit = lookup_slots(kd, jnp.asarray(np.array([2], np.uint32)),
+                          jnp.ones(1, bool))
+    assert not bool(hit[0])
+    # the other keys are untouched
+    got, hit = lookup_slots(kd, jnp.asarray(np.array([1, 3, 4],
+                                                     np.uint32)),
+                            jnp.ones(3, bool))
+    assert np.asarray(hit).all()
+    # the freed slot is re-grantable
+    kd, s5, adm = _admit(kd, [50])
+    assert bool(np.asarray(adm)[0])
+
+
+def test_readmission_survives_probe_prefix_vacancy():
+    """Review-pass regression: reclaiming an entry that sits on a LIVE
+    key's probe-path prefix must not make re-admission duplicate the key
+    (claim the vacancy, pop a fresh slot, reset its history). The insert
+    path must look up the FULL probe depth before claiming anything."""
+    kd = init_keydir(64, 32)
+    rng = np.random.default_rng(11)
+    keys = rng.integers(0, 10_000, 24).astype(np.uint32)
+    kd, slot0, adm0 = _admit(kd, keys)
+    assert np.asarray(adm0).all()
+    occ0 = int(occupied_slots(kd))
+    slots_by_key = dict(zip(keys.tolist(), np.asarray(slot0).tolist()))
+    # vacate HALF the entries (whichever they are, some sit on the
+    # survivors' probe prefixes in a 64-entry directory)
+    live_entries = np.flatnonzero(np.asarray(kd.slots) >= 0)
+    dead = np.zeros(64, bool)
+    dead[live_entries[::2]] = True
+    kd, dead_mask, n = reclaim_entries(kd, jnp.asarray(dead))
+    reclaimed_slots = set(
+        np.asarray(slot0)[np.isin(np.asarray(slot0),
+                                  np.asarray(kd.free)[
+                                      :int(kd.free_top)])].tolist())
+    # re-admit EVERY original key: survivors must keep their exact slot
+    kd, slot1, adm1 = _admit(kd, keys)
+    assert np.asarray(adm1).all()
+    for k, s1 in zip(keys.tolist(), np.asarray(slot1).tolist()):
+        if slots_by_key[k] not in reclaimed_slots:
+            assert s1 == slots_by_key[k], \
+                f"live key {k} moved {slots_by_key[k]} -> {s1}"
+    # every key owns exactly ONE directory entry (no duplicates)
+    stored = np.asarray(kd.keys)[np.asarray(kd.slots) >= 0]
+    assert len(stored) == len(np.unique(stored))
+    assert int(occupied_slots(kd)) == occ0
+
+
+def test_sentinel_key_is_remapped_not_lost():
+    kd = init_keydir(64, 16)
+    kd, _, adm = _admit(kd, [0xFFFFFFFF])
+    assert bool(np.asarray(adm)[0])
+    _, hit = lookup_slots(kd, jnp.asarray(np.array([0xFFFFFFFF],
+                                                   np.uint32)),
+                          jnp.ones(1, bool))
+    assert bool(hit[0])
+    # the directory never stores the sentinel itself
+    assert not np.any(np.asarray(kd.keys)[np.asarray(kd.slots) >= 0]
+                      == np.uint32(0xFFFFFFFF))
+
+
+def test_admit_under_jit_matches_eager():
+    kd_e = init_keydir(128, 32)
+    kd_j = init_keydir(128, 32)
+    rng = np.random.default_rng(3)
+    jitted = jax.jit(admit_slots, static_argnames="n_probes")
+    for _ in range(4):
+        keys = rng.integers(0, 200, 64).astype(np.uint32)
+        kd_e, s_e, a_e = _admit(kd_e, keys)
+        kd_j, s_j, a_j = jitted(kd_j, jnp.asarray(keys),
+                                jnp.ones(64, bool))
+        np.testing.assert_array_equal(np.asarray(s_e), np.asarray(s_j))
+        np.testing.assert_array_equal(np.asarray(a_e), np.asarray(a_j))
+    np.testing.assert_array_equal(np.asarray(kd_e.keys),
+                                  np.asarray(kd_j.keys))
+
+
+@pytest.mark.parametrize("n_keys,slot_cap", [(500, 512), (2000, 256)])
+def test_admission_exactness_property(n_keys, slot_cap):
+    """Random stream: every admitted key maps to a UNIQUE slot; the
+    mapping is a function (same key → same slot, always); occupancy
+    equals the number of distinct admitted keys."""
+    kd = init_keydir(2 * 1024, slot_cap)
+    rng = np.random.default_rng(7)
+    seen = {}
+    for _ in range(12):
+        keys = rng.integers(0, n_keys, 256).astype(np.uint32)
+        kd, slot, adm = _admit(kd, keys)
+        slot, adm = np.asarray(slot), np.asarray(adm)
+        for k, s, a in zip(keys.tolist(), slot.tolist(), adm.tolist()):
+            if not a:
+                continue
+            if k in seen:
+                assert seen[k] == s, "key moved slots without reclaim"
+            seen[k] = s
+    slots = list(seen.values())
+    assert len(set(slots)) == len(slots) <= slot_cap
+    assert int(occupied_slots(kd)) == len(seen)

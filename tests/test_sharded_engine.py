@@ -330,7 +330,7 @@ def test_sharded_engine_online_sgd_updates_params(small_dataset):
 
 def test_sharded_engine_rejects_indivisible_capacity():
     cfg = Config(
-        features=FeatureConfig(customer_capacity=500,  # not /8
+        features=FeatureConfig(customer_capacity=4,  # pow2, but not /8
                                terminal_capacity=1024),
     )
     params, scaler = _model()

@@ -288,8 +288,18 @@ def test_non_pow2_local_capacity_rejected():
         init_history_state,
     )
 
-    cfg = Config(features=FeatureConfig(
-        customer_capacity=24576, terminal_capacity=1024, history_len=8))
-    state = init_history_state(cfg.features)
+    # the refusal now fires at CONFIG construction (FeatureConfig
+    # validates pow2 capacities — a non-pow2 table silently aliases
+    # keys), before a state that could mis-reshard can even be built
     with pytest.raises(ValueError, match="power of two"):
-        reshard_history_state(state, cfg, 4)
+        Config(features=FeatureConfig(
+            customer_capacity=24576, terminal_capacity=1024,
+            history_len=8))
+    # the reshard-level guard stays as defense in depth for states
+    # built outside the config path: fake a non-pow2 LOCAL capacity by
+    # resharding a pow2 table over a non-pow2 width
+    cfg = Config(features=FeatureConfig(
+        customer_capacity=8192, terminal_capacity=1024, history_len=8))
+    state = init_history_state(cfg.features)
+    with pytest.raises(ValueError, match="power of two|divide"):
+        reshard_history_state(state, cfg, 3)

@@ -48,7 +48,7 @@ def _identity_scaler():
     )
 
 
-def _base_config(**runtime_kw):
+def _base_config(features_kw=None, **runtime_kw):
     import dataclasses as dc
 
     from real_time_fraud_detection_system_tpu.config import (
@@ -58,9 +58,11 @@ def _base_config(**runtime_kw):
     )
 
     return Config(
-        features=FeatureConfig(customer_capacity=128,
-                               terminal_capacity=256,
-                               cms_width=1 << 10),
+        features=dc.replace(
+            FeatureConfig(customer_capacity=128,
+                          terminal_capacity=256,
+                          cms_width=1 << 10),
+            **(features_kw or {})),
         runtime=dc.replace(
             RuntimeConfig(batch_buckets=(64, 256), max_batch_rows=256),
             **runtime_kw),
@@ -95,15 +97,17 @@ def _params_for(kind: str, n_trees: int = 4, depth: int = 3):
 
 def make_target(kind: str, name: Optional[str] = None,
                 sharded: bool = False, n_trees: int = 4, depth: int = 3,
-                params=None, **runtime_kw) -> VerifyTarget:
+                params=None, features_kw=None, **runtime_kw
+                ) -> VerifyTarget:
     """Build one verification target. ``runtime_kw`` land on
-    ``RuntimeConfig`` (z_mode, emit_threshold, use_pallas, …);
+    ``RuntimeConfig`` (z_mode, emit_threshold, use_pallas, …) and
+    ``features_kw`` on ``FeatureConfig`` (key_mode, compact_every, …);
     ``params`` overrides the synthetic template (the over-budget
     Pallas fixture passes an oversized ensemble)."""
     import jax
     import jax.numpy as jnp
 
-    cfg = _base_config(**runtime_kw)
+    cfg = _base_config(features_kw=features_kw, **runtime_kw)
     params = params if params is not None else _params_for(
         kind, n_trees, depth)
     if sharded:
@@ -143,6 +147,11 @@ def build_default_targets() -> List[VerifyTarget]:
     # the fused-Pallas gate (trace-time admission on static shapes)
     out.append(make_target("forest", name="forest/int8/pallas",
                            z_mode="int8", use_pallas=True))
+    # the tiered feature store: exact key directory + sketch fallback in
+    # the scoring program, plus the compaction pass as its own signature
+    out.append(make_target(
+        "forest", name="forest/int8/exact", z_mode="int8",
+        features_kw={"key_mode": "exact", "compact_every": 8}))
     # sharded local + routed variants
     out.append(make_target("forest", sharded=True, z_mode="int8"))
     return out
