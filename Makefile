@@ -129,7 +129,10 @@ overload-smoke:
 # recompiles (compaction + sketch-tier overflow active, both enumerated
 # in dispatch_inventory), exact tier counters from the registry
 # (dense + cms == rows x keyspaces), compaction firing AND reclaiming,
-# and gap/dup-free sink lineage
+# and gap/dup-free sink lineage — on the single-chip engine AND the
+# sharded cell (4 virtual devices: per-shard directories, shard-exact
+# tier counters, compaction reclaiming on EVERY shard, per-shard
+# /healthz breakdown)
 state-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_state_smoke.py -q
 

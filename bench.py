@@ -69,6 +69,36 @@ def _progress(msg: str) -> None:
     """Child-side liveness breadcrumb (parent re-arms its settle timer)."""
     print(f"{PROGRESS_LINE} {msg}", flush=True)
 
+
+def _run_cpu_mesh_tool(tool_name: str, tool_args: list,
+                       timeout_s: float, label: str) -> dict:
+    """Run a tools/ bench script on the virtual CPU mesh as a
+    subprocess (this possibly-TPU-attached process cannot adopt the
+    8-device CPU env itself) and parse its one-JSON-line result. Shared
+    by the sharded scaling and sharded state-scale blocks so the
+    poll/timeout/kill/parse discipline cannot diverge between them."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("BENCH_ROLE", None)
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", tool_name)
+    p = subprocess.Popen([sys.executable, tool] + list(tool_args),
+                         env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True)
+    t0 = time.monotonic()
+    while p.poll() is None:
+        if time.monotonic() - t0 > timeout_s:
+            p.kill()
+            p.wait()
+            raise TimeoutError(f"{tool_name} subprocess > {timeout_s} s")
+        _progress(label)
+        time.sleep(20.0)
+    out, err = p.communicate()
+    lines = [ln for ln in out.splitlines() if ln.startswith("{")]
+    if p.returncode != 0 or not lines:
+        raise RuntimeError(f"rc={p.returncode}: {err.strip()[-200:]}")
+    return json.loads(lines[-1])
+
 # Peak dense bf16 matmul FLOP/s per chip, by device_kind substring
 # (public spec sheets). MFU here is model-FLOPs / (wall · peak): a lower
 # bound, since the f32-HIGHEST proj pass runs below bf16 peak.
@@ -961,35 +991,13 @@ def _child_main(args) -> None:
             _progress("sharded scaling curve (virtual CPU mesh)")
 
             def _scaling():
-                env = dict(os.environ)
-                env["JAX_PLATFORMS"] = "cpu"
-                env.pop("BENCH_ROLE", None)
-                tool = os.path.join(
-                    os.path.dirname(os.path.abspath(__file__)),
-                    "tools", "sharded_scaling_bench.py")
-                p = subprocess.Popen(
-                    # 16k rows: big enough that per-shard-program
-                    # dispatch noise stops dominating (the 2k quick
-                    # size wobbles ±40%), ~15 s on one host core
-                    [sys.executable, tool, "--rows", "16384",
-                     "--batches", "3"], env=env,
-                    stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                    text=True)
-                t0 = time.monotonic()
-                while p.poll() is None:
-                    if time.monotonic() - t0 > 1200.0:
-                        p.kill()
-                        p.wait()
-                        raise TimeoutError("scaling subprocess > 1200 s")
-                    _progress("sharded scaling running")
-                    time.sleep(20.0)
-                out, err = p.communicate()
-                lines = [ln for ln in out.splitlines()
-                         if ln.startswith("{")]
-                if p.returncode != 0 or not lines:
-                    raise RuntimeError(
-                        f"rc={p.returncode}: {err.strip()[-200:]}")
-                return json.loads(lines[-1])
+                # 16k rows: big enough that per-shard-program
+                # dispatch noise stops dominating (the 2k quick
+                # size wobbles ±40%), ~15 s on one host core
+                return _run_cpu_mesh_tool(
+                    "sharded_scaling_bench.py",
+                    ["--rows", "16384", "--batches", "3"],
+                    timeout_s=1200.0, label="sharded scaling running")
 
             _guarded("sharded_scaling", _scaling)
         if on_cpu and skl is not None:
@@ -1438,6 +1446,24 @@ def _child_main(args) -> None:
     except Exception as e:
         state_scale = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
 
+    # ---- sharded tiered-store scale matrix (detail.sharded_state_scale)
+    # The scale-out half of the same proof: shards × {64k, 1M, 10M} Zipf
+    # with per-shard directories — rows/s per shard count must stay flat
+    # as the universe grows 1000×, per-shard dense hit rate and state
+    # bytes reported from registry series, zero mid-stream recompiles
+    # with per-shard compaction firing. Subprocess: needs the virtual
+    # CPU mesh env this (possibly TPU-attached) process cannot adopt.
+    _progress("sharded state scale (virtual CPU mesh)")
+    sharded_state_scale = None
+    try:
+        sharded_state_scale = _run_cpu_mesh_tool(
+            "sharded_state_scale_bench.py",
+            ["--quick"] if (args.quick or on_cpu) else [],
+            timeout_s=1800.0, label="sharded state scale running")
+    except Exception as e:
+        sharded_state_scale = {
+            "error": f"{type(e).__name__}: {str(e)[:160]}"}
+
     # ---- CPU sklearn baseline (the reference-equivalent predict_proba) --
     # Measured at the headline batch size, capped at 65,536 rows per call
     # to bound a single predict_proba's cost; sklearn RF throughput is
@@ -1510,6 +1536,8 @@ def _child_main(args) -> None:
         detail["size_scale_stopped"] = size_error
     if state_scale is not None:
         detail["state_scale"] = state_scale
+    if sharded_state_scale is not None:
+        detail["sharded_state_scale"] = sharded_state_scale
 
     # Registry snapshot beside the headline (ROADMAP PR-1 note): the
     # engine loops above populated rtfds_phase_seconds / rtfds_batch_

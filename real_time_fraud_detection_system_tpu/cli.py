@@ -584,7 +584,8 @@ def cmd_score(args) -> int:
             state_bytes as _state_bytes,
         )
 
-        need = _state_bytes(cfg.features)["total"]
+        need = _state_bytes(cfg.features,
+                            n_shards=max(args.devices, 1))["total"]
         if need > args.state_hbm_budget_mb * 2 ** 20:
             log.error(
                 "--state-hbm-budget-mb %g cannot hold the configured "
@@ -597,7 +598,7 @@ def cmd_score(args) -> int:
             state_bytes,
         )
 
-        sb = state_bytes(cfg.features)
+        sb = state_bytes(cfg.features, n_shards=max(args.devices, 1))
         log.info(
             "tiered feature store: hot tier %d+%d slots, compaction "
             "every %s batches, state %.1f MB (dense %.1f, directory "
@@ -1264,6 +1265,16 @@ def cmd_ckpt(args) -> int:
             print(_json_line({"path": args.inspect, "valid": False,
                               "error": f"{type(e).__name__}: {e}"[:300]}))
             return 1
+        from real_time_fraud_detection_system_tpu.io.checkpoint import (
+            feature_state_report,
+        )
+
+        fs = feature_state_report(man)
+        if fs is not None:
+            # named feature-state leaves with per-shard byte attribution
+            # + writer-recorded directory occupancy: state skew visible
+            # from the manifest, no restore needed
+            man = {**man, "feature_state": fs}
         print(_json_line({"path": args.inspect, **man}))
         return 0
     # listing stays cheap (one read per entry); only --verify pays for

@@ -60,8 +60,18 @@ class FeatureState(NamedTuple):
 
 
 def init_feature_state(
-    cfg: FeatureConfig, with_cms: Optional[bool] = None
+    cfg: FeatureConfig, with_cms: Optional[bool] = None,
+    n_shards: int = 1,
 ) -> FeatureState:
+    """``n_shards > 1`` builds the SHARDED exact layout: the window
+    tables stay flat ``[capacity, NB]`` (placed ``P(axis, None)``, so
+    shard s owns rows ``[s*cap/n, (s+1)*cap/n)``), but each shard gets
+    its OWN key directory over its local slot range — stacked
+    ``[n_shards, ...]`` leaves (:func:`~..ops.keydir.
+    init_stacked_keydir`). Sketches keep the single-chip layout here;
+    :func:`~..parallel.mesh.shard_feature_state` expands them
+    per-device at placement time. Non-exact key modes ignore
+    ``n_shards`` (their layouts are width-independent)."""
     exact = cfg.key_mode == "exact"
     if with_cms is None:
         # exact mode always carries the customer sketch: it is the
@@ -72,11 +82,22 @@ def init_feature_state(
         # Directory at 2x the slot capacity: load factor <= 0.5 keeps
         # fixed-depth probing effectively lossless until the free-slot
         # list itself runs dry (THE admission bound).
+        def _dir(cap: int):
+            if n_shards > 1:
+                if cap % n_shards:
+                    raise ValueError(
+                        f"capacity {cap} must divide by n_shards "
+                        f"{n_shards}")
+                from real_time_fraud_detection_system_tpu.ops.keydir \
+                    import init_stacked_keydir
+
+                local = cap // n_shards
+                return init_stacked_keydir(2 * local, local, n_shards)
+            return init_keydir(2 * cap, cap)
+
         if cfg.customer_source != "cms":
-            customer_dir = init_keydir(2 * cfg.customer_capacity,
-                                       cfg.customer_capacity)
-        terminal_dir = init_keydir(2 * cfg.terminal_capacity,
-                                   cfg.terminal_capacity)
+            customer_dir = _dir(cfg.customer_capacity)
+        terminal_dir = _dir(cfg.terminal_capacity)
         terminal_cms = cms_init(cfg.cms_depth, cfg.cms_width,
                                 cfg.n_day_buckets, track_fraud=True)
     return FeatureState(
@@ -104,7 +125,7 @@ def _slot(key: jnp.ndarray, capacity: int, mode: str) -> jnp.ndarray:
     return slot_of(key, capacity)
 
 
-def state_bytes(cfg: FeatureConfig) -> dict:
+def state_bytes(cfg: FeatureConfig, n_shards: int = 1) -> dict:
     """Static per-tier HBM accounting for the feature state a config
     would build (init_feature_state shapes × dtype bytes; no device
     access, no allocation). Keys: ``dense`` (window tables),
@@ -112,7 +133,10 @@ def state_bytes(cfg: FeatureConfig) -> dict:
     sketches), ``total``. The ``--state-hbm-budget-mb`` engine-build
     check and bench's ``detail.state_scale`` both read this, so the
     budget the operator sets and the bytes the bench reports cannot
-    drift."""
+    drift. ``n_shards``: the sharded engine passes its width — window
+    tables and directories partition (same total bytes, plus one
+    free_top scalar per shard), but each shard carries its OWN sketch
+    replica, so the cms tier multiplies."""
     exact = cfg.key_mode == "exact"
     nb = cfg.n_day_buckets
     # WindowState: bucket_day i32 + count/amount/fraud f32 = 16 B/bucket.
@@ -131,12 +155,15 @@ def state_bytes(cfg: FeatureConfig) -> dict:
         # ...whose fraud column is a third table on the terminal sketch
         cms += nb * cfg.cms_depth * cfg.cms_width * 4
         # KeyDirectory: keys u32 + slots i32 over 2x slots, free i32 +
-        # free_top i32 per table.
+        # free_top i32 per table (one free_top per shard).
         for cap, present in ((cfg.customer_capacity,
                               cfg.customer_source != "cms"),
                              (cfg.terminal_capacity, True)):
             if present:
-                directory += 2 * cap * 8 + cap * 4 + 4
+                directory += 2 * cap * 8 + cap * 4 + 4 * max(n_shards, 1)
+    # per-device sketch replicas over the mesh (disjoint key partitions:
+    # each device sketches only its owners' traffic)
+    cms *= max(n_shards, 1)
     return {
         "dense": int(dense),
         "directory": int(directory),
@@ -529,6 +556,44 @@ def apply_feedback(
             state.terminal_cms, terminal_key, day, label, valid & ~hit))
     term_slot = _slot(terminal_key, cfg.terminal_capacity, cfg.key_mode)
     return apply_feedback_at_slot(state, term_slot, day, label, valid)
+
+
+def apply_feedback_sharded_exact(
+    state: FeatureState,
+    terminal_key: jnp.ndarray,  # uint32 [B] (already fold_key'd)
+    day: jnp.ndarray,  # int32 [B] — the day of the original transaction
+    label: jnp.ndarray,  # int32 [B] 0/1
+    valid: jnp.ndarray,  # bool [B]
+    cfg: FeatureConfig,
+) -> FeatureState:
+    """Sharded-exact twin of :func:`apply_feedback`: ownership is
+    ``key % n_shards`` (the same modulo the step's owner exchange
+    routes by), the slot comes from THAT shard's directory — a LOOKUP,
+    never an insert (feedback must not evict live traffic's slots).
+    Hits land in the owner's dense window rows (global table row =
+    ``owner * cap_local + local_slot``); misses land in the owner's
+    sketch replica's fraud column (``cms_add_fraud``'s owner-indexed
+    form — ONE bounded-lateness policy with the single-chip path).
+    Plain jitted global-array ops — GSPMD inserts the (off-hot-path)
+    collectives."""
+    from real_time_fraud_detection_system_tpu.ops.keydir import (
+        lookup_slots_stacked,
+    )
+
+    kd = state.terminal_dir
+    n_shards = int(kd.keys.shape[0])
+    cap_local = state.terminal.capacity // n_shards
+    owner = (terminal_key.astype(jnp.uint32)
+             % jnp.uint32(n_shards)).astype(jnp.int32)
+    slot, hit = lookup_slots_stacked(kd, owner, terminal_key, valid,
+                                     n_probes=cfg.keydir_probes)
+    grow = owner * cap_local + slot
+    state = apply_feedback_at_slot(state, grow, day, label, valid & hit)
+    if state.terminal_cms is None:  # defensive: exact states carry one
+        return state
+    return state._replace(terminal_cms=cms_add_fraud(
+        state.terminal_cms, terminal_key, day, label, valid & ~hit,
+        owner=owner))
 
 
 def apply_feedback_at_slot(

@@ -144,6 +144,10 @@ def _state_arrays(engine_state) -> Tuple[dict, dict]:
         "n_fs": len(leaves_fs),
         "n_p": len(leaves_p),
         "n_s": len(leaves_s),
+        # human/CLI leaf naming: fs_i -> pytree path, so `rtfds ckpt
+        # --inspect` can attribute bytes to named state planes
+        # (directories, tiers) without loading the arrays
+        "fs_leaves": _fs_leaf_names(engine_state.feature_state),
         # layouts are shape-identical permutations: the writer's device
         # count must travel with the state for cross-width restores
         "layout_devices": int(
@@ -153,7 +157,44 @@ def _state_arrays(engine_state) -> Tuple[dict, dict]:
         # loop can tell restored params from the current champion
         "model_version": getattr(engine_state, "model_version", None),
     }
+    occ = _directory_occupancy(engine_state.feature_state)
+    if occ:
+        # per-shard hot-tier occupancy at save time (tiered exact
+        # store): the state-skew signal `rtfds ckpt --inspect` surfaces
+        # from the manifest alone (shapes are static per shard — only
+        # the VALUES betray skew, and free_top is one int per shard)
+        meta["feature_state_occupancy"] = occ
     return arrays, meta
+
+
+def _fs_leaf_names(feature_state) -> dict:
+    """``fs_i`` → dotted pytree path of the feature-state leaf."""
+    try:
+        flat, _ = jax.tree_util.tree_flatten_with_path(feature_state)
+        return {
+            f"fs_{i}": jax.tree_util.keystr(path)
+            for i, (path, _leaf) in enumerate(flat)
+        }
+    except (TypeError, AttributeError):  # exotic pytree: names optional
+        return {}
+
+
+def _directory_occupancy(feature_state) -> dict:
+    """Per-table, per-shard occupied hot-tier slot counts (``{} `` when
+    the state carries no key directories — direct/hash/sequence)."""
+    out = {}
+    for table in ("customer", "terminal"):
+        kd = getattr(feature_state, f"{table}_dir", None)
+        if kd is None:
+            continue
+        tops = np.asarray(kd.free_top)
+        free = np.asarray(kd.free)
+        if tops.ndim == 0:  # single-chip layout
+            out[table] = [int(free.shape[0]) - int(tops)]
+        else:  # stacked per-shard layout
+            cap_local = int(free.shape[1])
+            out[table] = [cap_local - int(t) for t in tops]
+    return out
 
 
 def _apply_arrays(engine_state, meta: dict, arrays: dict):
@@ -711,7 +752,18 @@ class _CheckpointerBase:
     def _check_template(name, meta, manifest, arrays, template) -> None:
         """Structural compatibility vs the restore template: leaf counts
         and shapes always; dtypes + the config/feature-spec fingerprint
-        for v2 entries (v1 keeps its historical trusting shape check)."""
+        for v2 entries (v1 keeps its historical trusting shape check).
+
+        One sanctioned shape exception: when the checkpoint's recorded
+        ``layout_devices`` differs from the template engine's, the
+        FEATURE-STATE leaves may legitimately carry different shapes
+        (the exact store's per-shard directories are width-dependent —
+        stacked ``[n, ...]`` leaves). Those leaves skip the shape
+        equality (dtypes and per-leaf CRCs still hold, so corruption is
+        still caught) and the engine's ``_ensure_layout`` re-homes them
+        via the elastic reshard — which itself hard-fails on a genuine
+        capacity mismatch, loudly, instead of this path quarantining a
+        healthy cross-width checkpoint."""
         spec = _template_spec(template)
         n_fs = sum(1 for k in spec if k.startswith("fs_"))
         n_p = sum(1 for k in spec if k.startswith("p_"))
@@ -722,12 +774,32 @@ class _CheckpointerBase:
                 "incompatible",
                 f"{name}: leaf counts {meta.get('n_fs')}/{meta.get('n_p')}"
                 f"/{meta.get('n_s')} vs template {n_fs}/{n_p}/{n_s}")
+        cross_width = (
+            meta.get("layout_devices") is not None
+            and int(meta["layout_devices"]) != int(
+                getattr(template, "layout_devices", 1) or 1))
+        fs_names = meta.get("fs_leaves") or {}
+
+        def width_dependent(k: str) -> bool:
+            # Only the per-shard planes legitimately change shape with
+            # width: key directories (stacked [n, ...] leaves) and
+            # sketch replicas. Window tables are global [cap, NB] at
+            # EVERY width, so a capacity mismatch there must stay an
+            # 'incompatible' quarantine-and-fallback, not leak through
+            # to a hard reshard crash. Writers without leaf names
+            # (pre-sharded-exact) never produced width-dependent
+            # shapes, so they keep the strict check.
+            path = fs_names.get(k, "")
+            return "_dir" in path or "cms" in path
+
         for k, (shape, dtype) in spec.items():
             a = arrays.get(k)
             if a is None:
                 raise CorruptCheckpointError(
                     "truncated", f"{name}: leaf {k} absent")
-            if list(np.shape(a)) != list(shape):
+            if list(np.shape(a)) != list(shape) and not (
+                    cross_width and k.startswith("fs_")
+                    and width_dependent(k)):
                 raise CorruptCheckpointError(
                     "incompatible",
                     f"{name}: leaf {k} shape {list(np.shape(a))} vs "
@@ -932,3 +1004,51 @@ def make_checkpointer(path_or_url: str, keep: int = 3, full_every: int = 1,
                                  op_timeout_s=op_timeout_s,
                                  op_attempts=op_attempts)
     return Checkpointer(path_or_url, keep=keep, full_every=full_every)
+
+
+def feature_state_report(man: dict) -> Optional[dict]:
+    """Operator view of a checkpoint's feature-state plane from the
+    MANIFEST alone (no array loads): named leaves with per-shard byte
+    attribution, plus the per-shard directory occupancy the writer
+    recorded — so state skew across shards is visible from ``rtfds ckpt
+    --inspect`` without restoring the checkpoint.
+
+    Returns None when the entry predates leaf naming (v1, or a pre-
+    sharded-state v2 manifest)."""
+    meta = man.get("meta") or {}
+    names = meta.get("fs_leaves") or {}
+    spec = man.get("spec") or {}
+    if not names or not spec:
+        return None
+    layout = int(meta.get("layout_devices", 1) or 1)
+    stored = set(man.get("stored") or [])
+    leaves = []
+    total = 0
+    for k in sorted(names, key=lambda k: int(k.split("_")[1])):
+        if k not in spec:
+            continue
+        shape, dtype = spec[k]
+        nbytes = int(np.prod(shape, dtype=np.int64) if shape else 1) \
+            * np.dtype(dtype).itemsize
+        total += nbytes
+        row = {"leaf": k, "path": names[k], "shape": shape,
+               "dtype": dtype, "bytes": nbytes}
+        if stored:
+            # delta checkpoints: which state leaves actually churned
+            row["stored_in_entry"] = k in stored
+        if layout > 1 and shape and int(shape[0]) == layout:
+            # stacked per-shard leaf (directories, sketch replicas)
+            row["per_shard_bytes"] = nbytes // layout
+        leaves.append(row)
+    out: dict = {"layout_devices": layout, "total_bytes": total,
+                 "leaves": leaves}
+    occ = meta.get("feature_state_occupancy")
+    if occ:
+        out["occupancy_per_shard"] = occ
+        worst = {
+            t: int(max(range(len(v)), key=lambda s: v[s]))
+            for t, v in occ.items() if v}
+        out["worst_shard"] = {
+            t: {"shard": s, "occupied": occ[t][s]}
+            for t, s in worst.items()}
+    return out

@@ -660,6 +660,18 @@ def render_ops_html(
         sub_bits = [f"{_compact(reclaimed)} slot(s) reclaimed"]
         if served:
             sub_bits.append(f"{dense / served:.1%} dense")
+        per_shard = last.get("occupied_per_shard")
+        if per_shard:
+            # sharded exact serving: skew is the failure mode the modulo
+            # ownership hides — lead with the WORST shard's occupancy
+            # (its hot tier overflows to the sketch first)
+            worst = int(max(range(len(per_shard)),
+                            key=lambda s: per_shard[s]))
+            cap_shard = cap // max(len(per_shard), 1)
+            sub_bits.insert(0, (
+                f"worst shard {worst}: "
+                f"{_compact(int(per_shard[worst]))}/"
+                f"{_compact(cap_shard)}"))
         tiles.append((
             "Feature store",
             f"{_compact(occ)}/{_compact(cap)} slots" if cap

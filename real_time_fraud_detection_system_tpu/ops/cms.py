@@ -109,24 +109,35 @@ def cms_add_fraud(
     day: jnp.ndarray,  # int32 [B] — the ORIGINAL transaction's day
     label: jnp.ndarray,  # int32/float32 [B] 0/1
     valid: jnp.ndarray,  # bool [B]
+    owner: Optional[jnp.ndarray] = None,  # int32 [B] — shard per row
 ) -> CountMinSketch:
     """Late fraud-label feedback into the sketch tier: add fraud sums to
     the slice still holding ``day`` (counts unchanged — the row was
     already counted when it streamed through). Labels for days the ring
     has wrapped past are dropped, mirroring the dense tier's
-    bounded-lateness policy."""
+    bounded-lateness policy.
+
+    ``owner`` selects the sharded form: ``sk`` then carries STACKED
+    per-shard tables (``[n_shards, ND, depth, width]``) and row i lands
+    in shard ``owner[i]``'s replica — ONE bounded-lateness policy for
+    the single-chip and sharded feedback paths."""
     if sk.fraud is None:
         return sk
-    nd, depth, width = sk.count.shape
+    nd, depth, width = sk.count.shape[-3:]
     sl = jnp.remainder(day, nd)
-    live = valid & (sk.slice_day[sl] == day)
+    live_day = (sk.slice_day[sl] if owner is None
+                else sk.slice_day[owner, sl])
+    live = valid & (live_day == day)
     w = live.astype(jnp.float32) * label.astype(jnp.float32)
     cols = multi_hash(key, depth, width)  # [depth, B]
     rows = jnp.broadcast_to(
         jnp.arange(depth, dtype=jnp.int32)[:, None], cols.shape)
     slc = jnp.broadcast_to(sl[None, :], cols.shape)
     wb = jnp.broadcast_to(w[None, :], cols.shape)
-    return sk._replace(fraud=sk.fraud.at[slc, rows, cols].add(wb))
+    if owner is None:
+        return sk._replace(fraud=sk.fraud.at[slc, rows, cols].add(wb))
+    ob = jnp.broadcast_to(owner[None, :], cols.shape)
+    return sk._replace(fraud=sk.fraud.at[ob, slc, rows, cols].add(wb))
 
 
 def _cms_query_tables(

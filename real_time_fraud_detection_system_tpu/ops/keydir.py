@@ -122,6 +122,47 @@ def lookup_slots(
     return jnp.where(hit, slot, 0), hit
 
 
+def init_stacked_keydir(dir_capacity: int, slot_capacity: int,
+                        n_shards: int) -> KeyDirectory:
+    """``n_shards`` independent per-shard directories as ONE pytree with
+    a leading shard axis on every leaf (``keys``/``slots``
+    [n, dir_cap], ``free`` [n, slot_cap], ``free_top`` [n]) — the
+    layout the sharded engine places over the mesh (one directory per
+    device, sharded on axis 0). Inside ``shard_map`` each device
+    squeezes the axis off and runs the plain single-shard ops."""
+    kd = init_keydir(dir_capacity, slot_capacity)
+    return KeyDirectory(
+        keys=jnp.broadcast_to(kd.keys[None], (n_shards,) + kd.keys.shape),
+        slots=jnp.broadcast_to(kd.slots[None],
+                               (n_shards,) + kd.slots.shape),
+        free=jnp.broadcast_to(kd.free[None], (n_shards,) + kd.free.shape),
+        free_top=jnp.full((n_shards,), slot_capacity, dtype=jnp.int32),
+    )
+
+
+def lookup_slots_stacked(
+    kd: KeyDirectory,  # STACKED layout: [n_shards, ...] leaves
+    owner: jnp.ndarray,  # int32 [B] — shard that owns each row's key
+    key: jnp.ndarray,  # uint32 [B]
+    valid: jnp.ndarray,  # bool [B]
+    n_probes: int = 8,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Read-only probe into a stacked directory: row i probes shard
+    ``owner[i]``'s table. Returns (local_slot [B] int32, hit [B] bool) —
+    the slot is LOCAL to the owner shard (callers compose the global
+    table row as ``owner * slot_capacity + slot``). Off the hot path
+    (feedback); GSPMD inserts the cross-shard gathers."""
+    key = _canon(key)
+    dir_cap = int(kd.keys.shape[1])
+    pos = _probe_positions(key, dir_cap, n_probes)  # [B, P]
+    found = kd.keys[owner[:, None], pos] == key[:, None]  # [B, P]
+    pidx = jnp.argmax(found, axis=1)
+    entry = jnp.take_along_axis(pos, pidx[:, None], axis=1)[:, 0]
+    slot = kd.slots[owner, entry]
+    hit = valid & found.any(axis=1) & (slot >= 0)
+    return jnp.where(hit, slot, 0), hit
+
+
 def admit_slots(
     kd: KeyDirectory,
     key: jnp.ndarray,  # uint32 [B]

@@ -79,13 +79,14 @@ def shard_feature_state(
     see :mod:`.distributed`)."""
     row_sharded = NamedSharding(mesh, P(axis, None))
     dev_sharded = NamedSharding(mesh, P(axis))
+    n_dev = int(mesh.devices.size)
 
     def place_windows(ws):
         return jax.tree.map(lambda a: jax.device_put(a, row_sharded), ws)
 
-    cms = state.cms
-    if cms is not None:
-        n_dev = int(mesh.devices.size)
+    def place_sketch(cms):
+        if cms is None:
+            return None
         if cms.slice_day.ndim == 1:  # single-chip layout: add device axis
             # Build the per-device replicas shard-by-shard: each device
             # materializes ONE [1, ...] copy of the base sketch — never
@@ -99,15 +100,39 @@ def shard_feature_state(
                     lambda idx, b=base: b,
                 )
 
-            cms = jax.tree.map(_expand, cms)
-        else:
-            cms = jax.tree.map(
-                lambda a: jax.device_put(a, dev_sharded), cms
-            )
+            return jax.tree.map(_expand, cms)
+        return jax.tree.map(lambda a: jax.device_put(a, dev_sharded), cms)
+
+    def place_dir(kd, name: str):
+        if kd is None:
+            return None
+        # Per-shard key directories are built stacked ([n_shards, ...]
+        # leaves, init_feature_state(n_shards=...)): shapes are
+        # layout-carrying, so a width mismatch is detectable here —
+        # unlike the window tables, whose permutations are
+        # shape-identical.
+        if np.ndim(kd.keys) == 1 and n_dev == 1:
+            # degenerate mesh: a single-chip directory IS the one
+            # shard's directory — adopt it under the stacked layout
+            # (shard_map wants the leading shard axis even at width 1)
+            kd = jax.tree.map(lambda a: jax.numpy.asarray(a)[None], kd)
+        lead = int(np.shape(kd.keys)[0]) if np.ndim(kd.keys) == 2 else 1
+        if np.ndim(kd.keys) != 2 or lead != n_dev:
+            raise ValueError(
+                f"{name} is laid out for {lead} shard(s), mesh has "
+                f"{n_dev} — build the state with init_feature_state("
+                "n_shards=mesh width) or convert via "
+                "reshard_feature_state (pass feature_state_n_old to the "
+                "engine)")
+        return jax.tree.map(lambda a: jax.device_put(a, dev_sharded), kd)
+
     return FeatureState(
         customer=place_windows(state.customer),
         terminal=place_windows(state.terminal),
-        cms=cms,
+        cms=place_sketch(state.cms),
+        customer_dir=place_dir(state.customer_dir, "customer_dir"),
+        terminal_dir=place_dir(state.terminal_dir, "terminal_dir"),
+        terminal_cms=place_sketch(state.terminal_cms),
     )
 
 
@@ -136,7 +161,9 @@ def reshard_feature_state(
     single-chip checkpoint into an 8-way layout (or n→m after a topology
     change) is EXACT for the customer/terminal window tables: restore,
     reshard, and serving continues as if the stream had always run at the
-    new width. Layouts are positional, so the CALLER states ``n_old``
+    new width. ``exact`` key mode delegates to :func:`_reshard_exact`
+    (directory entries re-homed by ``key % n_new``, bit-exact for every
+    admitted key). Layouts are positional, so the CALLER states ``n_old``
     (the checkpoint's device count; shapes alone cannot distinguish
     layouts). Returns host-side arrays; place them with
     :func:`shard_feature_state` (or use directly at ``n_new == 1``).
@@ -153,8 +180,13 @@ def reshard_feature_state(
     replicated n× in host RAM).
     """
     fcfg = cfg.features
+    if fcfg.key_mode == "exact":
+        return _reshard_exact(state, fcfg, n_old, n_new)
     if fcfg.key_mode != "direct":
-        raise ValueError("elastic re-shard requires key_mode='direct'")
+        raise ValueError(
+            "elastic re-shard requires key_mode='direct' or 'exact' "
+            "(hash mode merges colliding keys — a permutation cannot "
+            "un-merge them)")
     for n in (n_old, n_new):
         if n < 1:
             raise ValueError(f"device counts must be >= 1, got {n}")
@@ -186,51 +218,225 @@ def reshard_feature_state(
 
         return jax.tree.map(re, ws)
 
-    cms = state.cms
-    if cms is not None:
-        # fraud is Optional (None on every pre-tiering config): merge
-        # only the tables that exist, keep None as None
-        leaves = [None if a is None else np.asarray(a) for a in cms]
-        if n_old > 1 and leaves[0].ndim > 1:
-            if leaves[0].shape[0] != n_old:
-                raise ValueError(
-                    f"cms device axis {leaves[0].shape[0]} != n_old "
-                    f"{n_old}")
-            # Disjoint key partitions make counts additive — but a quiet
-            # shard's day ring lags (slices only advance when that device
-            # sees traffic for the day). Exact-preserving merge: per
-            # slice, take the NEWEST stamp and sum only devices holding
-            # it (a stale slice would have been reset when that day
-            # arrived there, and its device provably saw no such-day
-            # traffic).
-            days = leaves[0]  # [n, ND]
-            max_day = days.max(axis=0)  # [ND]
-            fresh = (days == max_day[None]).astype(leaves[1].dtype)
-            single = type(cms)(
-                max_day,
-                *[None if a is None
-                  else (a * fresh[..., None, None]).sum(axis=0)
-                  for a in leaves[1:]],
-            )
-        else:
-            # already single-layout (n_old == 1, or a prior reshard's
-            # deferred-expansion output where only the windows carry the
-            # n_old layout)
-            single = type(cms)(*leaves)
-        # n_new > 1 keeps the SINGLE layout: shard_feature_state expands
-        # it per-device at placement time (shard-by-shard, never n_new
-        # host copies of a production-size sketch — the OOM its _expand
-        # branch exists to avoid).
-        cms = single
+    cms = _merge_sketch(state.cms, n_old)
 
-    # _replace: the tiered-store fields (directories, terminal sketch)
-    # pass through untouched — exact mode is single-chip today, so they
-    # are None on every state that can reach a reshard, but dropping
-    # them silently here would be a trap for the item-1 follow-up
+    # _replace: the tiered-store fields are None on every DIRECT-mode
+    # state (exact mode branched into _reshard_exact above); keep the
+    # passthrough so the structure survives whatever is attached
     return state._replace(
         customer=convert(state.customer, fcfg.customer_capacity),
         terminal=convert(state.terminal, fcfg.terminal_capacity),
         cms=cms,
+    )
+
+
+def _merge_sketch(cms, n_old: int):
+    """Sharded sketch replicas → ONE single-layout sketch (host-side).
+
+    fraud is Optional (None on every pre-tiering config): merge only the
+    tables that exist, keep None as None. The returned sketch always
+    carries the SINGLE-chip layout: :func:`shard_feature_state` expands
+    it per-device at placement time (shard-by-shard, never n_new host
+    copies of a production-size sketch — the OOM its ``_expand`` branch
+    exists to avoid).
+
+    Warm-start caveat (pre-existing, restated because exact mode now
+    SERVES sketch-tier features): expansion replicates the merged
+    sketch to every device so per-device estimates stay upper bounds
+    for every key; a LATER merge then sums those n warm-start copies
+    plus deltas, so repeated merge→expand cycles inflate pre-cycle
+    counts by up to n× per cycle. Still a valid upper bound (the CMS
+    contract), and the ring bounds it in time — an inflated slice
+    rotates out after ``n_day_buckets`` days of traffic. Dropping the
+    replication would break the bound (a key would query an empty
+    replica after re-homing), so the inflation is the documented cost
+    of elastic reshard on the approximate tier; the dense tier — the
+    serving majority — re-homes exactly."""
+    if cms is None:
+        return None
+    leaves = [None if a is None else np.asarray(a) for a in cms]
+    if n_old > 1 and leaves[0].ndim > 1:
+        if leaves[0].shape[0] != n_old:
+            raise ValueError(
+                f"cms device axis {leaves[0].shape[0]} != n_old "
+                f"{n_old}")
+        # Disjoint key partitions make counts additive — but a quiet
+        # shard's day ring lags (slices only advance when that device
+        # sees traffic for the day). Exact-preserving merge: per
+        # slice, take the NEWEST stamp and sum only devices holding
+        # it (a stale slice would have been reset when that day
+        # arrived there, and its device provably saw no such-day
+        # traffic).
+        days = leaves[0]  # [n, ND]
+        max_day = days.max(axis=0)  # [ND]
+        fresh = (days == max_day[None]).astype(leaves[1].dtype)
+        return type(cms)(
+            max_day,
+            *[None if a is None
+              else (a * fresh[..., None, None]).sum(axis=0)
+              for a in leaves[1:]],
+        )
+    # already single-layout (n_old == 1, or a prior reshard's
+    # deferred-expansion output where only the windows carry the
+    # n_old layout)
+    return type(cms)(*leaves)
+
+
+def _reshard_exact(state: FeatureState, fcfg, n_old: int, n_new: int
+                   ) -> FeatureState:
+    """Elastic N→M re-home of the TIERED exact state (directories +
+    windows + sketches) with bit-exact admitted-key state.
+
+    Unlike direct mode (a fixed layout permutation), exact-mode slot
+    placement is dynamic: each shard's directory granted slots in
+    admission order. Re-homing therefore works at the (key, window-row)
+    level: every live directory entry is extracted, its key's new owner
+    is ``key % n_new`` (the SAME modulo the step's owner exchange
+    routes by), its window row moves to the new owner's block, and each
+    new shard's directory is rebuilt with the same double-hash probe
+    discipline ``admit_slots`` uses at serve time. Slot ids within a
+    shard are assigned in sorted-key order — deterministic, so two
+    reshards of the same checkpoint are byte-identical. Sketches merge
+    via the newest-day rule (:func:`_merge_sketch`) and re-expand at
+    placement.
+
+    Loud failures, never silent state loss: a new shard whose key set
+    exceeds its local slot capacity (ownership skew after shrinking the
+    mesh — possible because total occupancy ≤ capacity does not bound
+    any single residue class) and a key that cannot place within
+    ``keydir_probes`` probes both raise, with the fix named.
+    """
+    from real_time_fraud_detection_system_tpu.ops.keydir import (
+        EMPTY_KEY,
+        _probe_positions,
+    )
+
+    n_probes = fcfg.keydir_probes
+    out = {}
+    for name, cap, present in (
+            ("customer", fcfg.customer_capacity,
+             fcfg.customer_source != "cms"),
+            ("terminal", fcfg.terminal_capacity, True)):
+        ws = getattr(state, name)
+        kd = getattr(state, f"{name}_dir")
+        if not present:
+            if kd is not None:
+                raise ValueError(
+                    f"{name}_dir present but customer_source="
+                    f"{fcfg.customer_source!r} builds none — the state "
+                    "does not match this config")
+            out[name] = jax.tree.map(np.asarray, ws)
+            out[f"{name}_dir"] = None
+            continue
+        if kd is None:
+            raise ValueError(
+                f"key_mode='exact' reshard needs the {name} key "
+                "directory; this state carries none (was it built "
+                "under a different key_mode?)")
+        keys = np.asarray(kd.keys)
+        slots = np.asarray(kd.slots)
+        if keys.ndim == 1:
+            keys, slots = keys[None], slots[None]
+        if keys.shape[0] != n_old:
+            raise ValueError(
+                f"{name}_dir is laid out for {keys.shape[0]} shard(s), "
+                f"caller says n_old={n_old}")
+        for n, who in ((n_old, "n_old"), (n_new, "n_new")):
+            if n < 1 or cap % n or ((cap // n) & (cap // n - 1)):
+                raise ValueError(
+                    f"{name}_capacity {cap} / {who}={n} must be a "
+                    "power of two")
+        bd = np.asarray(ws.bucket_day)
+        if bd.shape[0] != cap:
+            raise ValueError(
+                f"state table has {bd.shape[0]} rows, config says "
+                f"{cap} — re-sharding a checkpoint taken under a "
+                "different capacity would merge or drop keys")
+        cap_local_old = cap // n_old
+        cap_local_new = cap // n_new
+        # ---- extract live (key, window-row) pairs -----------------------
+        shard_idx, entry_idx = np.nonzero(slots >= 0)
+        lkeys = keys[shard_idx, entry_idx]
+        old_rows = (shard_idx * cap_local_old
+                    + slots[shard_idx, entry_idx].astype(np.int64))
+        # ---- re-home: owner = key % n_new, slots in sorted-key order ----
+        owner = (lkeys % np.uint32(n_new)).astype(np.int64)
+        order = np.lexsort((lkeys, owner))
+        owner_s, keys_s, rows_s = owner[order], lkeys[order], old_rows[order]
+        counts = np.bincount(owner_s, minlength=n_new)
+        if counts.max(initial=0) > cap_local_new:
+            worst = int(np.argmax(counts))
+            raise ValueError(
+                f"elastic reshard {n_old}→{n_new}: new shard {worst} "
+                f"would own {int(counts[worst])} live {name} keys but "
+                f"holds only {cap_local_new} slots — run compaction "
+                "before shrinking the mesh, or keep more shards")
+        rank = (np.arange(len(owner_s))
+                - np.concatenate(([0], np.cumsum(counts)))[owner_s])
+        new_rows = owner_s * cap_local_new + rank
+        # ---- move the window rows (bit-exact copies) --------------------
+        def rehome(leaf, fill):
+            a = np.asarray(leaf)
+            fresh = np.full_like(a, fill)
+            fresh[new_rows] = a[rows_s]
+            return fresh
+
+        out[name] = type(ws)(
+            bucket_day=rehome(ws.bucket_day, -1),
+            count=rehome(ws.count, 0.0),
+            amount=rehome(ws.amount, 0.0),
+            fraud=rehome(ws.fraud, 0.0),
+        )
+        # ---- rebuild the per-shard directories --------------------------
+        dir_cap_new = 2 * cap_local_new
+        nkeys = np.full((n_new, dir_cap_new), EMPTY_KEY, np.uint32)
+        nslots = np.full((n_new, dir_cap_new), -1, np.int32)
+        import jax.numpy as jnp
+
+        pos = np.asarray(_probe_positions(
+            jnp.asarray(keys_s), dir_cap_new, n_probes))  # [K, P]
+        flat_keys = nkeys.reshape(-1)
+        flat_slots = nslots.reshape(-1)
+        placed = np.zeros(len(keys_s), dtype=bool)
+        for j in range(n_probes):
+            active = ~placed
+            if not active.any():
+                break
+            gpos = owner_s * dir_cap_new + pos[:, j]
+            want = active & (flat_keys[gpos] == EMPTY_KEY)
+            # scatter-min claim rounds, the np mirror of admit_slots:
+            # among same-position racers the smallest key wins (keys are
+            # unique per shard, so every key wins exactly one round)
+            np.minimum.at(flat_keys, gpos[want], keys_s[want])
+            won = want & (flat_keys[gpos] == keys_s)
+            flat_slots[gpos[won]] = rank[won].astype(np.int32)
+            placed |= won
+        if not placed.all():
+            miss = int((~placed).sum())
+            raise ValueError(
+                f"elastic reshard {n_old}→{n_new}: {miss} {name} "
+                f"key(s) could not place within {n_probes} probes of "
+                "the rebuilt directory — raise keydir_probes or grow "
+                "the hot tier (admitted-key state must survive a "
+                "reshard bit-exactly, so dropping them is not an "
+                "option)")
+        free = np.broadcast_to(
+            np.arange(cap_local_new - 1, -1, -1, dtype=np.int32),
+            (n_new, cap_local_new)).copy()
+        kd_new_leaves = dict(
+            keys=nkeys, slots=nslots, free=free,
+            free_top=(cap_local_new - counts).astype(np.int32))
+        if n_new == 1:
+            kd_new_leaves = {
+                k: (v[0] if k != "free_top" else np.int32(v[0]))
+                for k, v in kd_new_leaves.items()}
+        out[f"{name}_dir"] = type(kd)(**kd_new_leaves)
+    return state._replace(
+        customer=out["customer"], terminal=out["terminal"],
+        cms=_merge_sketch(state.cms, n_old),
+        customer_dir=out["customer_dir"],
+        terminal_dir=out["terminal_dir"],
+        terminal_cms=_merge_sketch(state.terminal_cms, n_old),
     )
 
 

@@ -17,6 +17,17 @@ Distribution contract (SURVEY §2.3 mapping):
 
 Everything is static-shape: the exchange buffer is [n_dev × B_local] per
 field (worst case: every local row targets one owner).
+
+``key_mode="exact"`` (the tiered feature store) keeps this exact wire
+contract — ownership is still ``key % n_dev``, so the host partitioner
+and the owner exchange route identically — but the slot WITHIN a shard
+comes from that shard's private key directory instead of the
+``(key // n_dev) & (cap_local - 1)`` modulo math: each owner resolves
+its received (key, row) records through ``admit_slots`` locally,
+admission misses are served from the owner's per-device sketch replica,
+and per-shard [dense, cms] tier counts leave the step stacked
+[n_dev, 2]. :func:`make_sharded_compact` runs the recency-compaction
+pass per shard under the same ``shard_map``.
 """
 
 from __future__ import annotations
@@ -237,6 +248,8 @@ def make_sharded_step(
     n_dev = mesh.devices.size
     fcfg = cfg.features
     use_cms = fcfg.customer_source == "cms"
+    exact = fcfg.key_mode == "exact"
+    probes = fcfg.keydir_probes
     windows = tuple(fcfg.windows)
     nw = len(windows)
     c_cap_local = fcfg.customer_capacity // n_dev
@@ -250,10 +263,24 @@ def make_sharded_step(
                 f"{nm}_capacity / n_devices must be a power of two, "
                 f"got {cl}")
 
+    def _unstack(t):
+        """Shard-stacked leaves ([1, ...] local blocks under P(axis)) →
+        the per-device view the single-shard ops consume."""
+        return (jax.tree.map(lambda x: jnp.squeeze(x, 0), t)
+                if t is not None else None)
+
+    def _restack(t):
+        return (jax.tree.map(lambda x: x[None], t)
+                if t is not None else None)
+
     def local_step(fstate: FeatureState, params, scaler: Scaler, batch: TxBatch):
         from real_time_fraud_detection_system_tpu.ops.cms import (
             cms_query,
+            cms_query_fraud,
             cms_update,
+        )
+        from real_time_fraud_detection_system_tpu.ops.keydir import (
+            admit_slots,
         )
 
         bl = batch.customer_key.shape[0]
@@ -358,6 +385,96 @@ def make_sharded_step(
             if cms is not None
             else None
         )
+        if exact:
+            # Tiered exact store over the mesh: ownership stays the cheap
+            # stable modulo (key % n_dev — what the host partitioner and
+            # the owner exchange already route by), but the slot WITHIN a
+            # shard comes from that shard's private key directory. The
+            # capacity-bounded exchange ships the same (key, row) wire
+            # records as direct mode; each owner resolves slots locally
+            # via admit_slots, and admission misses are served from the
+            # owner's sketch replica — exactly the single-chip tiering,
+            # one instance per shard. Tier counts accumulate OWNER-side
+            # (skew is a per-shard property) and leave the step as a
+            # [n_dev, 2] stack.
+            c_kd = _unstack(fstate.customer_dir)
+            t_kd = _unstack(fstate.terminal_dir)
+            t_cms = _unstack(fstate.terminal_cms)
+            zero2 = jnp.zeros(2, jnp.float32)  # [dense, cms] rows served
+
+            def customer_fn_x(st, c_key, c_day, c_amt, c_fraud, c_valid):
+                kd, customer, lcms, cnt = st
+                if kd is None:
+                    # customer_source="cms": sketch-only velocity (no
+                    # dense customer tier, no tier accounting — matching
+                    # the single-chip exact engine)
+                    lcms = cms_update(lcms, c_key, c_amt, c_day, c_valid)
+                    cc, ca = cms_query(lcms, c_key, c_day, windows)
+                    return (kd, customer, lcms, cnt), jnp.concatenate(
+                        [cc, ca], axis=1)
+                kd, c_slot, c_adm = admit_slots(kd, c_key, c_valid,
+                                                n_probes=probes)
+                customer = update_windows(
+                    customer, c_slot, c_day, c_amt, c_fraud,
+                    c_valid & c_adm, track_fraud=False)
+                lcms = cms_update(lcms, c_key, c_amt, c_day, c_valid)
+                cc_t, ca_t, _ = query_windows(customer, c_slot, c_day,
+                                              windows)
+                cc_s, ca_s = cms_query(lcms, c_key, c_day, windows)
+                cc = jnp.where(c_adm[:, None], cc_t, cc_s)
+                ca = jnp.where(c_adm[:, None], ca_t, ca_s)
+                cnt = cnt + jnp.stack([
+                    jnp.sum((c_valid & c_adm).astype(jnp.float32)),
+                    jnp.sum((c_valid & ~c_adm).astype(jnp.float32))])
+                return (kd, customer, lcms, cnt), jnp.concatenate(
+                    [cc, ca], axis=1)
+
+            st0 = (c_kd, fstate.customer, local_cms, zero2)
+            if route_customers:
+                (c_kd, customer, local_cms, c_cnt), cb = exchanged_compute(
+                    batch.customer_key, customer_fn_x, st0)
+            else:
+                (c_kd, customer, local_cms, c_cnt), cb = customer_fn_x(
+                    st0, batch.customer_key, batch.day, batch.amount,
+                    fraud, batch.valid)
+            c_count, c_amount = cb[:, :nw], cb[:, nw:]
+            cms = jax.tree.map(lambda x: x[None], local_cms)
+
+            def terminal_fn_x(st, t_key, t_day, t_amt, t_fraud_in,
+                              t_valid):
+                kd, terminal, tcms, cnt = st
+                kd, t_slot, t_adm = admit_slots(kd, t_key, t_valid,
+                                                n_probes=probes)
+                terminal = update_windows(
+                    terminal, t_slot, t_day, t_amt, t_fraud_in,
+                    t_valid & t_adm, track_amount=False)
+                tcms = cms_update(tcms, t_key, t_amt, t_day, t_valid,
+                                  fraud=t_fraud_in)
+                tc_t, _, tf_t = query_windows(
+                    terminal, t_slot, t_day, windows,
+                    delay=fcfg.delay_days)
+                tc_s, _, tf_s = cms_query_fraud(
+                    tcms, t_key, t_day, windows, delay=fcfg.delay_days)
+                tc = jnp.where(t_adm[:, None], tc_t, tc_s)
+                tf = jnp.where(t_adm[:, None], tf_t, tf_s)
+                cnt = cnt + jnp.stack([
+                    jnp.sum((t_valid & t_adm).astype(jnp.float32)),
+                    jnp.sum((t_valid & ~t_adm).astype(jnp.float32))])
+                return (kd, terminal, tcms, cnt), jnp.concatenate(
+                    [tc, tf], axis=1)
+
+            (t_kd, terminal, t_cms, t_cnt), tb = exchanged_compute(
+                batch.terminal_key, terminal_fn_x,
+                (t_kd, fstate.terminal, t_cms, zero2))
+            t_count_l, t_fraud_l = tb[:, :nw], tb[:, nw:]
+            return _assemble_and_score(
+                fstate, params, scaler, batch, fraud,
+                customer, terminal, cms,
+                c_count, c_amount, t_count_l, t_fraud_l,
+                customer_dir=_restack(c_kd), terminal_dir=_restack(t_kd),
+                terminal_cms=_restack(t_cms),
+                tier=(c_cnt + t_cnt)[None])
+
         def customer_fn(st, c_key, c_day, c_amt, c_fraud, c_valid):
             """Owner-side customer velocity: sketch/window update + query
             on the rows this device owns; returns [*, 2·NW] aggregates."""
@@ -412,7 +529,21 @@ def make_sharded_step(
         terminal, tb = exchanged_compute(
             batch.terminal_key, terminal_fn, fstate.terminal)
         t_count_l, t_fraud_l = tb[:, :nw], tb[:, nw:]
+        return _assemble_and_score(
+            fstate, params, scaler, batch, fraud,
+            customer, terminal, cms,
+            c_count, c_amount, t_count_l, t_fraud_l)
 
+    def _assemble_and_score(fstate, params, scaler, batch, fraud,
+                            customer, terminal, cms,
+                            c_count, c_amount, t_count_l, t_fraud_l,
+                            customer_dir=None, terminal_dir=None,
+                            terminal_cms=None, tier=None):
+        """Shared tail of ``local_step``: 15-feature assembly (order =
+        features/spec.py), classify, optional psum'd online SGD, and the
+        new-state pytree — identical math for the direct/hash and exact
+        state planes, so the tiered store cannot drift the scoring
+        arithmetic."""
         # ---- assemble the 15-feature matrix (order = features/spec.py)
         c_avg = jnp.where(c_count > 0, c_amount / jnp.maximum(c_count, 1.0), 0.0)
         t_risk = jnp.where(
@@ -443,11 +574,15 @@ def make_sharded_step(
                                   params, g)
 
         new_state = FeatureState(customer=customer, terminal=terminal,
-                                 cms=cms)
+                                 cms=cms, customer_dir=customer_dir,
+                                 terminal_dir=terminal_dir,
+                                 terminal_cms=terminal_cms)
         if cfg.runtime.emit_dtype == "bfloat16":
             # halve the emitted matrix's D2H bytes; the classifier above
             # already consumed the f32 features (predictions unaffected)
             feats = feats.astype(jnp.bfloat16)
+        if tier is not None:
+            return new_state, params, probs, feats, tier
         return new_state, params, probs, feats
 
     from real_time_fraud_detection_system_tpu.parallel.mesh import (
@@ -468,14 +603,21 @@ def make_sharded_step(
         # specs need only the pytree STRUCTURE; in packed mode the
         # caller's template is the [7, B] array, so synthesize a TxBatch
         batch_t = TxBatch(*([0] * 7)) if packed else batch_template
+
+        def dev_stacked(t):
+            # per-shard leaves with a leading device axis (directories,
+            # sketch replicas): shard axis 0, one block per device
+            return (spec_like(t, P(axis)) if t is not None else None)
+
         in_specs = (
             FeatureState(
                 customer=spec_like(fstate_template.customer, P(axis, None)),
                 terminal=spec_like(fstate_template.terminal, P(axis, None)),
                 # Owner-sharded sketch: leading device axis (mesh.py).
-                cms=spec_like(fstate_template.cms, P(axis))
-                if fstate_template.cms is not None
-                else None,
+                cms=dev_stacked(fstate_template.cms),
+                customer_dir=dev_stacked(fstate_template.customer_dir),
+                terminal_dir=dev_stacked(fstate_template.terminal_dir),
+                terminal_cms=dev_stacked(fstate_template.terminal_cms),
             ),
             spec_like(params_template, P()),
             spec_like(scaler_template, P()),
@@ -486,7 +628,7 @@ def make_sharded_step(
             in_specs[1],
             P(axis),
             P(axis, None),
-        )
+        ) + ((P(axis, None),) if exact else ())  # [n_dev, 2] tier rows
         fn = _shard_map(local_step, in_specs, out_specs)
         thresh = float(cfg.runtime.emit_threshold)
         selective = cfg.runtime.emit_features and thresh > 0.0
@@ -494,9 +636,12 @@ def make_sharded_step(
 
         def outer(fstate, params, scaler, batch_in):
             batch = unpack_batch(batch_in) if packed else batch_in
-            fstate, params, probs, feats = fn(fstate, params, scaler,
-                                              batch)
+            out = fn(fstate, params, scaler, batch)
+            tier = out[4] if exact else None
+            fstate, params, probs, feats = out[:4]
             if not selective:
+                if exact:
+                    return fstate, params, probs, feats, tier
                 return fstate, params, probs, feats
             # Selective emission over the mesh: the same packed-transfer
             # contract as the single-chip engine (engine.py step tail) —
@@ -514,10 +659,84 @@ def make_sharded_step(
                 probs, count[None], idx.astype(jnp.float32),
                 feats[idx].reshape(-1),
             ])
-            return fstate, params, probs, {
-                "packed": packed_out, "full": feats,
-            }
+            emit = {"packed": packed_out, "full": feats}
+            if exact:
+                return fstate, params, probs, emit, tier
+            return fstate, params, probs, emit
 
         return jax.jit(outer, donate_argnums=(0,))
 
     return build
+
+
+def make_sharded_compact(
+    cfg: Config,
+    mesh: Mesh,
+    axis: "str | Tuple[str, ...]" = "data",
+):
+    """Per-shard recency compaction under ``shard_map`` — the sharded
+    twin of the single-chip ``("compact",)`` dispatch variant.
+
+    ``compact(fstate, now_day) -> (fstate', reclaimed [n_dev, 2])``:
+    every device runs :func:`~..features.online.compact_feature_state`
+    over ITS window-table block and ITS key directory (purely local —
+    zero collectives; a shard's dead slots are its own business), and
+    the per-shard reclaim counts come back stacked so the engine can
+    meter skew per shard. Fixed shapes throughout: one more
+    ``DispatchSignature``, AOT-compiled at warmup, never a recompile.
+    """
+    from real_time_fraud_detection_system_tpu.features.online import (
+        compact_feature_state,
+    )
+    from real_time_fraud_detection_system_tpu.parallel.mesh import (
+        compat_shard_map,
+    )
+
+    fcfg = cfg.features
+    has_cdir = fcfg.customer_source != "cms"
+
+    def spec_like(tree, spec):
+        return jax.tree.map(lambda _: spec, tree)
+
+    def outer(fstate: FeatureState, now_day: jnp.ndarray):
+        def local(customer, terminal, c_kd, t_kd, day):
+            st = FeatureState(
+                customer=customer, terminal=terminal, cms=None,
+                customer_dir=jax.tree.map(lambda x: jnp.squeeze(x, 0),
+                                          c_kd)
+                if c_kd is not None else None,
+                terminal_dir=jax.tree.map(lambda x: jnp.squeeze(x, 0),
+                                          t_kd),
+                terminal_cms=None,
+            )
+            new, reclaimed = compact_feature_state(st, day, fcfg)
+            return (
+                new.customer,
+                new.terminal,
+                jax.tree.map(lambda x: x[None], new.customer_dir)
+                if new.customer_dir is not None else None,
+                jax.tree.map(lambda x: x[None], new.terminal_dir),
+                reclaimed[None],  # [1, 2] → [n_dev, 2]
+            )
+
+        row = P(axis, None)
+        dev = P(axis)
+        in_specs = (
+            spec_like(fstate.customer, row),
+            spec_like(fstate.terminal, row),
+            spec_like(fstate.customer_dir, dev) if has_cdir else None,
+            spec_like(fstate.terminal_dir, dev),
+            P(),
+        )
+        out_specs = in_specs[:4] + (row,)
+        fn = compat_shard_map(local, mesh, in_specs, out_specs)
+        customer, terminal, c_kd, t_kd, reclaimed = fn(
+            fstate.customer, fstate.terminal,
+            fstate.customer_dir if has_cdir else None,
+            fstate.terminal_dir, now_day)
+        return fstate._replace(
+            customer=customer, terminal=terminal,
+            customer_dir=c_kd if has_cdir else fstate.customer_dir,
+            terminal_dir=t_kd), reclaimed
+
+    return jax.jit(outer, donate_argnums=(0,))

@@ -684,6 +684,12 @@ class ScoringEngine:
 
     # -- tiered feature store (key_mode="exact") ---------------------------
 
+    def _state_shards(self) -> int:
+        """Shard count the static ``state_bytes`` accounting uses: 1 for
+        the single-chip engine; the sharded engine reports its mesh
+        width (per-device sketch replicas multiply the cms tier)."""
+        return 1
+
     def _check_state_budget(self) -> None:
         """``features.state_hbm_budget_mb``: fail the BUILD, not the
         stream, when the configured feature state cannot fit the budget
@@ -692,7 +698,7 @@ class ScoringEngine:
         fcfg = self.cfg.features
         if fcfg.state_hbm_budget_mb <= 0:
             return
-        sb = state_bytes(fcfg)
+        sb = state_bytes(fcfg, n_shards=self._state_shards())
         budget = int(fcfg.state_hbm_budget_mb * 2 ** 20)
         if sb["total"] > budget:
             raise ValueError(
@@ -740,7 +746,7 @@ class ScoringEngine:
                 for t, present in tables if present
             }
         if self._exact or fcfg.state_hbm_budget_mb > 0:
-            sb = state_bytes(fcfg)
+            sb = state_bytes(fcfg, n_shards=self._state_shards())
             for tier in ("dense", "directory", "cms", "total"):
                 reg.gauge(
                     "rtfds_feature_state_bytes",
@@ -785,6 +791,11 @@ class ScoringEngine:
                     ("compact",), self._compact,
                     self.state.feature_state, day)
         self.state.feature_state = fstate
+        self._record_compaction(fstate, reclaimed)
+
+    def _record_compaction(self, fstate, reclaimed) -> None:
+        """Meter one compaction pass (counters, gauges, flight event) —
+        the sharded engine overrides with the per-shard breakdown."""
         rec = np.asarray(reclaimed)  # [customer, terminal]
         occupied = {}
         for i, table in enumerate(("customer", "terminal")):

@@ -15,6 +15,15 @@ key-hash partition analogue), computed host-side by
 :func:`~..parallel.step.partition_batch_spill`; a hot-key shard overflow
 spills into follow-on sub-steps instead of failing the stream.
 
+``key_mode="exact"`` (the tiered device-resident feature store) serves
+sharded too: ownership keeps the stable modulo above, but the slot
+WITHIN a shard comes from that shard's private key directory —
+per-shard ``keydir`` + hot tier + sketch replica, per-shard recency
+compaction as the ``("compact",)`` dispatch variant, and per-shard
+tier/occupancy telemetry (the ``shard`` label). With each shard's hot
+tier sized to hold its keys, sharded exact is bit-identical to
+single-engine exact (tests/test_sharded_exact.py).
+
 The engine inherits the single-chip engine's run loop, feedback-SGD path,
 and feature-cache plumbing; it overrides batch processing (partition →
 sharded step → re-assemble) and state feedback (the terminal table lives
@@ -105,16 +114,6 @@ class ShardedScoringEngine(ScoringEngine):
         permutations that nothing else can tell apart. Omit
         ``feature_state_n_old`` only when the state is already in this
         mesh's layout. Default: fresh state."""
-        if cfg.features.key_mode == "exact":
-            # The mesh step's owner layout routes keys by a global modulo
-            # (parallel/step.py) — the tiered exact store replaces that
-            # with a per-shard directory exchange, which is the ROADMAP
-            # item-1 follow-up. Refuse loudly rather than silently serve
-            # modulo placement under an "exact" flag.
-            raise ValueError(
-                "key_mode='exact' (the tiered device-resident feature "
-                "store) is single-chip for now; serve with --devices 1, "
-                "or keep key_mode direct/hash on the mesh")
         if cfg.runtime.nan_guard:
             # The sharded step donates state inside shard_map and a batch
             # spans several chunk steps — there is no pre-batch anchor to
@@ -128,6 +127,39 @@ class ShardedScoringEngine(ScoringEngine):
                 "supervisor's crash-loop bisection (--dead-letter)")
         mesh = mesh if mesh is not None else make_mesh(n_devices)
         n_mesh = int(mesh.devices.size)
+        # state_bytes accounting needs the width BEFORE the base
+        # constructor runs its budget check / bytes gauges
+        self.n_dev = n_mesh
+        exact = cfg.features.key_mode == "exact" and kind != "sequence"
+        if exact:
+            # Per-shard tiered store: validate the partition up front
+            # (the base class would only catch it after building state).
+            for nm in ("customer_capacity", "terminal_capacity"):
+                cap = getattr(cfg.features, nm)
+                local = cap // n_mesh if cap % n_mesh == 0 else 0
+                if local <= 0 or (local & (local - 1)):
+                    raise ValueError(
+                        f"key_mode='exact' on a {n_mesh}-wide mesh needs "
+                        f"{nm} / n_devices to be a power of two, got "
+                        f"{cap} / {n_mesh}")
+        if exact and feature_state is not None \
+                and feature_state_n_old is None:
+            # Exact-mode layouts are shape-carrying (stacked per-shard
+            # directories), so a mislaid state is detectable — refuse
+            # with the fix named instead of serving split key histories.
+            kd = feature_state.terminal_dir
+            # metadata only — .ndim/.shape exist on numpy AND jax
+            # arrays, so no device-to-host copy of a possibly-huge
+            # directory leaf just to read its layout
+            lead = (int(kd.keys.shape[0])
+                    if kd is not None
+                    and getattr(kd.keys, "ndim", 1) == 2 else 1)
+            if kd is None or lead != n_mesh:
+                raise ValueError(
+                    f"provided exact feature_state is laid out for "
+                    f"{lead} shard(s), mesh has {n_mesh} — pass "
+                    "feature_state_n_old to let the engine re-home the "
+                    "directory entries (elastic reshard)")
         if feature_state is not None and feature_state_n_old is not None:
             from real_time_fraud_detection_system_tpu.parallel.mesh import (
                 reshard_engine_state,
@@ -170,6 +202,12 @@ class ShardedScoringEngine(ScoringEngine):
             # transiently double the footprint (same reasoning as the
             # sequence pre_state above)
             pre_state = feature_state
+            if exact and pre_state is None:
+                # exact mode's directory shapes are width-dependent
+                # (per-shard key directories): build the SHARDED layout
+                # first, never the single-chip one
+                pre_state = init_feature_state(cfg.features,
+                                               n_shards=n_mesh)
         super().__init__(
             cfg, kind, params, scaler, feature_state=pre_state,
             online_lr=online_lr, feature_cache=feature_cache,
@@ -260,6 +298,118 @@ class ShardedScoringEngine(ScoringEngine):
         self._sharded_step = None  # built on first batch (needs templates)
         self._sharded_step_routed = None
         self._sharded_sf = None
+        self._sharded_sf_exact = None
+        if self._exact:
+            # replace the base class's single-chip compaction jit with
+            # the shard_map'd per-shard pass (same ("compact",) dispatch
+            # key, same donation, per-shard reclaim counts out)
+            from real_time_fraud_detection_system_tpu.parallel.step import (
+                make_sharded_compact,
+            )
+
+            self._compact = make_sharded_compact(cfg, self.mesh,
+                                                 axis=self.axis)
+
+    # -- per-shard feature-state telemetry ---------------------------------
+
+    def _state_shards(self) -> int:
+        # set before super().__init__ so the base budget check and bytes
+        # gauges account the per-device sketch replicas
+        return int(getattr(self, "n_dev", 1) or 1)
+
+    def _init_state_telemetry(self) -> None:
+        """Base series (the healthz/global view) PLUS the per-shard
+        breakdown — skew is the failure mode modulo ownership hides, so
+        every tier/occupancy/reclaim series also exists with a
+        ``shard`` label."""
+        super()._init_state_telemetry()
+        self._m_tier_shard = None
+        self._m_slots_occ_shard = None
+        self._m_slots_rec_shard = None
+        if not self._exact:
+            return
+        reg = self.metrics
+        n = self._state_shards()
+        fcfg = self.cfg.features
+        tables = [t for t, present in
+                  (("customer", fcfg.customer_source != "cms"),
+                   ("terminal", True)) if present]
+        self._m_tier_shard = {
+            (t, s): reg.counter(
+                "rtfds_feature_tier_rows_total",
+                "row x keyspace feature reads served per tier "
+                "(dense = private hot-tier slot; cms = count-min "
+                "sketch fallback after an admission miss)",
+                tier=t, shard=str(s))
+            for t in ("dense", "cms") for s in range(n)
+        }
+        self._m_slots_occ_shard = {
+            (t, s): reg.gauge(
+                "rtfds_feature_slots_occupied",
+                "hot-tier slots currently owned by a key "
+                "(updated at compaction cadence)",
+                table=t, shard=str(s))
+            for t in tables for s in range(n)
+        }
+        self._m_slots_rec_shard = {
+            (t, s): reg.counter(
+                "rtfds_feature_slots_reclaimed_total",
+                "hot-tier slots reclaimed by recency compaction "
+                "(the slot held only history older than "
+                "delay + max(window))",
+                table=t, shard=str(s))
+            for t in tables for s in range(n)
+        }
+
+    def _record_compaction(self, fstate, reclaimed) -> None:
+        """Per-shard compaction metering: ``reclaimed`` arrives
+        ``[n_dev, 2]`` ([customer, terminal] per shard) from the
+        shard_map'd pass; occupancy reads come from the stacked
+        ``free_top`` leaves. The base (table-level) series are fed the
+        shard sums, so the single-chip healthz/dashboard contracts hold
+        unchanged on the mesh."""
+        rec = np.asarray(reclaimed)  # [n_dev, 2]
+        occupied = {}
+        occupied_per_shard = [0] * self.n_dev
+        cap_total = 0
+        for i, table in enumerate(("customer", "terminal")):
+            if table in (self._m_slots_rec or {}):
+                self._m_slots_rec[table].inc(int(rec[:, i].sum()))
+            kd = getattr(fstate, f"{table}_dir")
+            if kd is None:
+                continue
+            cap_local = int(kd.free.shape[1])
+            cap_total += cap_local * self.n_dev
+            tops = np.asarray(kd.free_top)  # [n_dev]
+            occ_t = 0
+            for s in range(self.n_dev):
+                occ = cap_local - int(tops[s])
+                occ_t += occ
+                occupied_per_shard[s] += occ
+                if self._m_slots_occ_shard is not None:
+                    self._m_slots_occ_shard[(table, s)].set(occ)
+                if self._m_slots_rec_shard is not None:
+                    self._m_slots_rec_shard[(table, s)].inc(
+                        int(rec[s, i]))
+            if table in (self._m_slots_occ or {}):
+                self._m_slots_occ[table].set(occ_t)
+            occupied[table] = occ_t
+        from real_time_fraud_detection_system_tpu.utils.metrics import (
+            active_recorder,
+        )
+
+        recorder = self.recorder if self.recorder is not None \
+            else active_recorder()
+        if recorder is not None:
+            tiers = {t: m.value for t, m in (self._m_tier or {}).items()}
+            recorder.record_event(
+                "feature_state", reclaimed=int(rec.sum()),
+                occupied=sum(occupied.values()),
+                capacity=cap_total,
+                occupied_per_shard=occupied_per_shard,
+                dense_rows=tiers.get("dense", 0.0),
+                cms_rows=tiers.get("cms", 0.0),
+                batch=self.state.batches_done)
 
     # -- sharding upkeep ---------------------------------------------------
 
@@ -349,7 +499,9 @@ class ShardedScoringEngine(ScoringEngine):
         """Enumerate every sharded dispatch signature — ONE shape family
         (chunks are always ``[7, n_dev * rows_per_shard]``) × TWO step
         variants: the owner-local step and the dense-spill ROUTED step
-        (``partition_batch_spill`` overflow re-packing). Same
+        (``partition_batch_spill`` overflow re-packing) — plus the
+        per-shard ``("compact",)`` recency-compaction pass when the
+        tiered exact store runs with a cadence. Same
         single-source-of-truth contract as the single-chip inventory:
         ``precompile`` compiles this list, ``_start_batch`` dispatches
         under these keys, and ``tools/rtfdsverify`` proves contracts
@@ -363,7 +515,7 @@ class ShardedScoringEngine(ScoringEngine):
             return []
         zmode_kinds = ("tree", "forest", "gbt")
         total = self.n_dev * self.rows_per_shard
-        return [
+        sigs = [
             DispatchSignature(
                 key=("sharded", routed),
                 variant="sharded-routed" if routed else "sharded-local",
@@ -377,6 +529,24 @@ class ShardedScoringEngine(ScoringEngine):
             )
             for routed in (False, True)
         ]
+        if self._compact_every:
+            # Per-shard recency compaction is part of the compiled step
+            # family on the mesh too: ONE shape (the sharded state + an
+            # int32 day scalar), fired from the same batch cadence —
+            # enumerated so precompile/verify cover it and the cadence
+            # can never pay a mid-stream compile.
+            sigs.append(DispatchSignature(
+                key=("compact",),
+                variant="compact",
+                kind=self.kind,
+                z_mode=None,
+                bucket=0,
+                donate=(0,),
+                selective=False,
+                emit_dtype=self.cfg.runtime.emit_dtype,
+                use_pallas=False,
+            ))
+        return sigs
 
     def _ensure_step(self, routed: bool):
         """THE lazy build+cache+meter point for both step variants —
@@ -409,6 +579,8 @@ class ShardedScoringEngine(ScoringEngine):
         """The shard_map step the signature dispatches to — the same
         lazily-built jit object ``_start_batch`` serves, so a
         lower/trace of this callable IS the serving program."""
+        if sig.variant == "compact":
+            return self._compact
         return self._ensure_step(sig.variant == "sharded-routed")
 
     def precompile(self) -> dict:
@@ -502,6 +674,7 @@ class ShardedScoringEngine(ScoringEngine):
         # phase decomposition matches the single-chip engine's.
         t_prep = time.perf_counter()
         parts = []
+        tier_parts = []  # exact mode: per-chunk [n_dev, 2] tier rows
         t_fetch = None  # last chunk's async-fetch issue time
         for part_cols, rows, pos in chunks:
             batch = make_batch(
@@ -557,11 +730,17 @@ class ShardedScoringEngine(ScoringEngine):
                 static=(self.kind, routed, self.n_dev, self.z_mode))
             with self._recompile.step(sig):
                 step = self._ensure_step(routed)
-                fstate, params, probs, feats = self._dispatch_step(
+                out = self._dispatch_step(
                     ("sharded", routed), step,
                     self.state.feature_state, self.state.params,
                     self.state.scaler, jbatch,
                 )
+            fstate, params, probs, feats = out[:4]
+            if self._exact:
+                # [n_dev, 2] per-shard [dense, cms] rows served this
+                # chunk — accumulated across chunks, materialized at
+                # finish (scalar-sized; no async fetch needed)
+                tier_parts.append(out[4])
             self.state.feature_state = fstate
             self.state.params = params
             # async D2H per chunk: each chunk's transfer starts the
@@ -575,9 +754,15 @@ class ShardedScoringEngine(ScoringEngine):
             # jit calls are its children on the profiler timeline)
             self.tracer.add_span("dispatch", t_prep, t_disp,
                                  chunks=len(chunks))
-        return {"cols": cols, "n": n, "parts": parts, "t0": t0,
-                "prep_s": t_prep - t0, "dispatch_s": t_disp - t_prep,
-                "fetch_issue_t": t_fetch}
+        handle = {"cols": cols, "n": n, "parts": parts, "t0": t0,
+                  "prep_s": t_prep - t0, "dispatch_s": t_disp - t_prep,
+                  "fetch_issue_t": t_fetch}
+        if tier_parts:
+            handle["tier_shard"] = tier_parts
+        # notify compaction's recency cutoff (the base engine does this
+        # in its own _start_batch; the sharded path overrides it wholesale)
+        self._note_batch_days(cols)
+        return handle
 
     def _finish_batch(self, handle: dict) -> BatchResult:
         n = handle["n"]
@@ -632,6 +817,20 @@ class ShardedScoringEngine(ScoringEngine):
             # once per batch, matching the single-chip counter semantics
             # (engine.py: "batches whose flagged-row count overflowed")
             self.selective_overflows += 1
+        tier_parts = handle.pop("tier_shard", None)
+        if tier_parts is not None:
+            # per-shard tier accounting ([n_dev, 2] summed over chunks):
+            # shard-labeled counters get their own rows, the base
+            # table-level counters get the shard sums — so the global
+            # healthz/dashboard contract is identical on the mesh.
+            tier = np.zeros((self.n_dev, 2), np.float64)
+            for t in tier_parts:
+                tier += np.asarray(t)
+            if self._m_tier_shard is not None:
+                for s in range(self.n_dev):
+                    self._m_tier_shard[("dense", s)].inc(float(tier[s, 0]))
+                    self._m_tier_shard[("cms", s)].inc(float(tier[s, 1]))
+            handle["tier"] = tier.sum(axis=0)  # [dense, cms] global
         return self._emit_result(handle, probs_np, feats_np)
 
     # -- feedback into the owner-partitioned terminal table ----------------
@@ -663,14 +862,33 @@ class ShardedScoringEngine(ScoringEngine):
         n_dev = self.n_dev
         cap_local = self.cfg.features.terminal_capacity // n_dev
         key = fold_key(np.asarray(terminal_ids)[mask]).astype(np.uint32)
-        gslot = (
-            (key % np.uint32(n_dev)).astype(np.int64) * cap_local
-            + ((key // np.uint32(n_dev)) & np.uint32(cap_local - 1))
-        ).astype(np.int32)
-        if self._sharded_sf is None:
+        if self._exact:
+            # Directory-routed feedback: ownership is key % n_dev (the
+            # step's routing modulo), the slot is a LOOKUP into the
+            # owner's directory — hits land in the owner's dense window
+            # rows, misses in the owner's sketch replica's fraud column
+            # (features/online.apply_feedback_sharded_exact; never an
+            # insert, so feedback cannot evict live traffic's slots).
+            if self._sharded_sf_exact is None:
+                from real_time_fraud_detection_system_tpu.features.online \
+                    import apply_feedback_sharded_exact
+
+                fcfg = self.cfg.features
+
+                def sfx(fstate, tk, dd, yy, valid):
+                    return apply_feedback_sharded_exact(
+                        fstate, tk, dd, yy, valid, fcfg)
+
+                self._sharded_sf_exact = jax.jit(sfx, donate_argnums=(0,))
+        elif self._sharded_sf is None:
             self._sharded_sf = jax.jit(
                 apply_feedback_at_slot, donate_argnums=(0,)
             )
+        if not self._exact:
+            gslot = (
+                (key % np.uint32(n_dev)).astype(np.int64) * cap_local
+                + ((key // np.uint32(n_dev)) & np.uint32(cap_local - 1))
+            ).astype(np.int32)
         d = np.asarray(days)[mask].astype(np.int32)
         y = labels[mask].astype(np.int32)
         # Bucket-pad like the single-chip path (engine.py) so a stream of
@@ -680,14 +898,22 @@ class ShardedScoringEngine(ScoringEngine):
         for s in range(0, len(y), biggest):
             m = len(y[s : s + biggest])
             pad = bucket_size(m, self.cfg.runtime.batch_buckets)
-            gs = np.zeros(pad, dtype=np.int32)
-            gs[:m] = gslot[s : s + m]
             dd = np.zeros(pad, dtype=np.int32)
             dd[:m] = d[s : s + m]
             yy = np.zeros(pad, dtype=np.int32)
             yy[:m] = y[s : s + m]
             valid = np.zeros(pad, dtype=bool)
             valid[:m] = True
+            if self._exact:
+                tk = np.zeros(pad, dtype=np.uint32)
+                tk[:m] = key[s : s + m]
+                self.state.feature_state = self._sharded_sf_exact(
+                    self.state.feature_state, jnp.asarray(tk),
+                    jnp.asarray(dd), jnp.asarray(yy), jnp.asarray(valid),
+                )
+                continue
+            gs = np.zeros(pad, dtype=np.int32)
+            gs[:m] = gslot[s : s + m]
             self.state.feature_state = self._sharded_sf(
                 self.state.feature_state, jnp.asarray(gs), jnp.asarray(dd),
                 jnp.asarray(yy), jnp.asarray(valid),

@@ -274,6 +274,19 @@ class MetricsRegistry:
             return None
         return float(sum(vals))
 
+    def family_series(self, name: str) -> List[Tuple[Dict[str, str],
+                                                     float]]:
+        """Read-only ``[(labels, value)]`` rows for a counter/gauge
+        family (histograms excluded; [] when never registered) — the
+        introspection the healthz per-shard breakdowns use. Never
+        creates."""
+        with self._lock:
+            fam = self._series.get(name)
+            if fam is None:
+                return []
+            return [(dict(m.labels), m.value) for m in fam.values()
+                    if not isinstance(m, Histogram)]
+
     def clear(self) -> None:
         """Drop every registered family (test isolation)."""
         with self._lock:
@@ -671,10 +684,44 @@ class MetricsServer:
                 occ_tables[table] = g.value
         if occ_tables:
             fstate: Dict[str, object] = {"slots_occupied": occ_tables}
-            rec = self.registry.family_total(
-                "rtfds_feature_slots_reclaimed_total")
-            if rec is not None:
-                fstate["slots_reclaimed"] = rec
+            # Sum the TABLE-level series only: the sharded engine also
+            # registers shard-labeled rows of the same family (they
+            # break the same totals down, so a blind family_total would
+            # double-count).
+            rec_rows = [
+                v for labels, v in self.registry.family_series(
+                    "rtfds_feature_slots_reclaimed_total")
+                if "shard" not in labels]
+            if rec_rows:
+                fstate["slots_reclaimed"] = float(sum(rec_rows))
+            # Per-shard breakdown (sharded exact serving): occupancy per
+            # shard summed over tables, plus the worst shard — skew is
+            # the failure mode the modulo ownership hides, so it gets a
+            # first-class health surface.
+            shard_occ: Dict[str, float] = {}
+            for labels, v in self.registry.family_series(
+                    "rtfds_feature_slots_occupied"):
+                s = labels.get("shard")
+                if s is not None:
+                    shard_occ[s] = shard_occ.get(s, 0.0) + v
+            if shard_occ:
+                fstate["slots_occupied_per_shard"] = {
+                    s: shard_occ[s]
+                    for s in sorted(shard_occ, key=int)}
+                worst = max(shard_occ, key=lambda s: shard_occ[s])
+                fstate["worst_shard"] = {
+                    "shard": int(worst), "occupied": shard_occ[worst]}
+                shard_tiers: Dict[str, Dict[str, float]] = {}
+                for labels, v in self.registry.family_series(
+                        "rtfds_feature_tier_rows_total"):
+                    s = labels.get("shard")
+                    if s is not None:
+                        shard_tiers.setdefault(
+                            s, {})[labels.get("tier", "?")] = v
+                if shard_tiers:
+                    fstate["tier_rows_per_shard"] = {
+                        s: shard_tiers[s]
+                        for s in sorted(shard_tiers, key=int)}
             dense = self.registry.get("rtfds_feature_tier_rows_total",
                                       tier="dense")
             cms_t = self.registry.get("rtfds_feature_tier_rows_total",

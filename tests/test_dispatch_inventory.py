@@ -179,3 +179,36 @@ def test_exact_mode_inventory_enumerates_compact_variant():
                          metrics=MetricsRegistry())
     assert [s.key for s in eng2.dispatch_inventory()] \
         == [("step", 7, 64), ("step", 7, 256)]
+
+
+def test_sharded_exact_inventory_enumerates_compact_variant():
+    """The sharded engine's exact-mode inventory carries the per-shard
+    compaction signature beside both step variants; precompile compiles
+    all three (registry-counted), and the serving keys agree."""
+    import dataclasses as _dc
+
+    from real_time_fraud_detection_system_tpu.runtime.sharded_engine \
+        import ShardedScoringEngine
+
+    reg = MetricsRegistry()
+    cfg = _cfg()
+    cfg = cfg.replace(features=_dc.replace(
+        cfg.features, key_mode="exact", compact_every=4))
+    eng = ShardedScoringEngine(
+        cfg, "forest", _forest_params(), _scaler(),
+        n_devices=2, rows_per_shard=32, metrics=reg)
+    inv = eng.dispatch_inventory()
+    assert sorted((s.key for s in inv), key=str) == sorted(
+        [("sharded", False), ("sharded", True), ("compact",)], key=str)
+    compact = [s for s in inv if s.variant == "compact"][0]
+    assert compact.z_mode is None and not compact.use_pallas
+    before = reg.get("rtfds_precompiled_steps_total").value
+    eng.precompile()
+    assert reg.get("rtfds_precompiled_steps_total").value - before \
+        == len(inv)
+    assert sorted(eng._aot, key=str) == sorted(
+        (s.key for s in inv), key=str)
+    # idempotent
+    eng.precompile()
+    assert reg.get("rtfds_precompiled_steps_total").value - before \
+        == len(inv)

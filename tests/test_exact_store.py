@@ -272,19 +272,29 @@ def test_state_bytes_accounting_matches_live_state():
     assert sb["dense"] + sb["directory"] + sb["cms"] == sb["total"]
 
 
-def test_sharded_engine_refuses_exact_mode():
+def test_sharded_engine_serves_exact_mode():
+    """The PR-13 refusal is gone: the sharded engine builds per-shard
+    directories and serves exact mode (full coverage, incl. the pinned
+    errors for the combos that STAY unsupported, lives in
+    tests/test_sharded_exact.py — this pins that the old refusal does
+    not resurface)."""
     from real_time_fraud_detection_system_tpu.runtime.sharded_engine \
         import ShardedScoringEngine
 
     cfg = Config(features=_fcfg(key_mode="exact"),
                  runtime=RuntimeConfig(batch_buckets=(64,),
                                        max_batch_rows=64))
-    with pytest.raises(ValueError, match="single-chip"):
-        ShardedScoringEngine(
-            cfg, "logreg", init_logreg(15),
-            Scaler(mean=np.zeros(15, np.float32),
-                   scale=np.ones(15, np.float32)),
-            n_devices=1)
+    eng = ShardedScoringEngine(
+        cfg, "logreg", init_logreg(15),
+        Scaler(mean=np.zeros(15, np.float32),
+               scale=np.ones(15, np.float32)),
+        n_devices=2)
+    assert eng.state.feature_state.terminal_dir is not None
+    # stacked per-shard layout: one directory per device
+    import numpy as _np
+
+    assert _np.asarray(
+        eng.state.feature_state.terminal_dir.keys).shape[0] == 2
 
 
 def test_sequence_kind_refuses_exact_mode():

@@ -81,12 +81,15 @@ class AotCoverageCheck:
                 ("step", 7, int(b))
                 for b in sorted(set(eng.cfg.runtime.batch_buckets))
             }
-            fcfg = eng.cfg.features
-            if (getattr(fcfg, "key_mode", "") == "exact"
-                    and getattr(fcfg, "compact_every", 0) > 0):
-                # engine.py::_maybe_compact dispatches the recency-
-                # compaction pass under this key on its batch cadence
-                expected.add(("compact",))
+        fcfg = eng.cfg.features
+        if (eng.kind != "sequence"
+                and getattr(fcfg, "key_mode", "") == "exact"
+                and getattr(fcfg, "compact_every", 0) > 0):
+            # engine.py::_maybe_compact dispatches the recency-
+            # compaction pass under this key on its batch cadence —
+            # single-chip AND sharded (the mesh engine swaps in the
+            # shard_map'd per-shard pass under the same key)
+            expected.add(("compact",))
         for key in sorted(expected - set(keys), key=str):
             out.append(_f(
                 self.name, "P0", target,

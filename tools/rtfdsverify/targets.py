@@ -154,6 +154,12 @@ def build_default_targets() -> List[VerifyTarget]:
         features_kw={"key_mode": "exact", "compact_every": 8}))
     # sharded local + routed variants
     out.append(make_target("forest", sharded=True, z_mode="int8"))
+    # the sharded tiered store: per-shard directories + sketch replicas
+    # in BOTH step variants plus the shard_map'd compaction signature
+    out.append(make_target(
+        "forest", name="sharded/forest/int8/exact", sharded=True,
+        z_mode="int8",
+        features_kw={"key_mode": "exact", "compact_every": 8}))
     return out
 
 
