@@ -136,6 +136,18 @@ overload-smoke:
 state-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_state_smoke.py -q
 
+# multi-host gate: 2 REAL serving processes (own interpreters, a real
+# jax.distributed coordination barrier, partition-affine ingest, per-
+# process checkpoints/sinks/registries) complete a scripted stream
+# under --precompile beside a single-process 2-device sharded control —
+# zero mid-stream recompiles in EVERY worker (from each worker's own
+# registry dump), gap/dup-free per-process sink batch_index lineage
+# covering the stream exactly once globally, global shard ids + process
+# labels on the per-shard gauges, and scores + all 15 feature columns
+# BIT-identical to the control
+multihost-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_multihost_smoke.py -q
+
 # continuous-learning gate: champion serves, the streaming learner
 # trains a candidate on injected labeled feedback, the shadow's live
 # recall overtakes the champion's, promotion fires, an injected
@@ -185,4 +197,4 @@ install:
 clean:
 	rm -rf $(OUT)
 
-.PHONY: demo datagen train score run-all query dashboard connectors dryrun trace-demo bench perf-smoke chaos-smoke recovery-smoke overload-smoke state-smoke learn-smoke lint-static verify-static test integration integration-up integration-down sqlcheck install clean
+.PHONY: demo datagen train score run-all query dashboard connectors dryrun trace-demo bench perf-smoke chaos-smoke recovery-smoke overload-smoke state-smoke learn-smoke multihost-smoke lint-static verify-static test integration integration-up integration-down sqlcheck install clean

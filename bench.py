@@ -1464,6 +1464,25 @@ def _child_main(args) -> None:
         sharded_state_scale = {
             "error": f"{type(e).__name__}: {str(e)[:160]}"}
 
+    # ---- multi-host scaling matrix (detail.multihost_scaling) ----------
+    # ROADMAP item 1's proof: 1→2→4 REAL OS processes (launcher +
+    # jax.distributed bootstrap + partition-affine ingest) over one
+    # co-partitioned stream, per-process rate flat within 15% (rows per
+    # process-CPU-second — wall rows/s on a shared-core box measures the
+    # box, not the coordination cost), zero recompiles per worker from
+    # each worker's own registry dump, no rows lost across the fleet.
+    # Subprocess tool: the workers are independent interpreters anyway.
+    _progress("multihost scaling (1/2/4 real processes)")
+    multihost_scaling = None
+    try:
+        multihost_scaling = _run_cpu_mesh_tool(
+            "multihost_scaling_bench.py",
+            ["--quick"] if (args.quick or on_cpu) else [],
+            timeout_s=2400.0, label="multihost scaling running")
+    except Exception as e:
+        multihost_scaling = {
+            "error": f"{type(e).__name__}: {str(e)[:160]}"}
+
     # ---- CPU sklearn baseline (the reference-equivalent predict_proba) --
     # Measured at the headline batch size, capped at 65,536 rows per call
     # to bound a single predict_proba's cost; sklearn RF throughput is
@@ -1538,6 +1557,8 @@ def _child_main(args) -> None:
         detail["state_scale"] = state_scale
     if sharded_state_scale is not None:
         detail["sharded_state_scale"] = sharded_state_scale
+    if multihost_scaling is not None:
+        detail["multihost_scaling"] = multihost_scaling
 
     # Registry snapshot beside the headline (ROADMAP PR-1 note): the
     # engine loops above populated rtfds_phase_seconds / rtfds_batch_

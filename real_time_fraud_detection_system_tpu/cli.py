@@ -413,11 +413,58 @@ def cmd_score(args) -> int:
         log.error("--nan-guard needs --dead-letter: quarantined rows "
                   "must land somewhere an operator can triage them")
         return 2
-    if args.nan_guard and args.devices > 1:
+    multihost = args.num_processes > 1 or bool(args.coordinator)
+    if args.nan_guard and (args.devices > 1 or multihost):
         log.error("--nan-guard is not wired for the sharded engine "
-                  "(--devices > 1); rely on the supervisor's crash-loop "
-                  "bisection (--dead-letter + --max-restarts) there")
+                  "(--devices > 1 / multi-host); rely on the "
+                  "supervisor's crash-loop bisection (--dead-letter + "
+                  "--max-restarts) there")
         return 2
+    if multihost and args.num_processes < 1:
+        log.error("--num-processes must be >= 1, got %s",
+                  args.num_processes)
+        return 2
+    if args.max_batch_rows < 0:
+        log.error("--max-batch-rows must be >= 0, got %s",
+                  args.max_batch_rows)
+        return 2
+    import dataclasses as _dc
+
+    # Multi-host bootstrap FIRST: jax.distributed.initialize refuses to
+    # run after any jax computation, and artifact loading below builds
+    # device arrays. The topology is config; everything after threads it.
+    topology = None
+    dist_cfg = None
+    if multihost:
+        from real_time_fraud_detection_system_tpu.config import (
+            DistributedConfig,
+        )
+        from real_time_fraud_detection_system_tpu.runtime.distributed \
+            import bootstrap_distributed
+
+        try:
+            dist_cfg = DistributedConfig(
+                coordinator=args.coordinator,
+                num_processes=max(args.num_processes, 1),
+                process_id=args.process_id,
+                # Kafka fleets slice by broker partition; residue
+                # membership is the producer's contract, not checkable
+                # per polled row
+                strict_affinity=args.source != "kafka",
+            )
+            topology = bootstrap_distributed(
+                dist_cfg, local_devices=max(args.devices, 1))
+        except (ValueError, RuntimeError) as e:
+            log.error("multi-host bootstrap failed: %s", e)
+            return 2
+        if topology is not None:
+            log.info(
+                "multi-host: process %d/%d, %d local device(s), global "
+                "shards [%d, %d) of %d, coordinator %s",
+                topology.process_id, topology.n_processes,
+                topology.local_devices, topology.owned_shards.start,
+                topology.owned_shards.stop, topology.n_shards_total,
+                args.coordinator or "(uncoordinated)")
     if args.crash_loop_k < 1:
         log.error("--crash-loop-k must be >= 1, got %s", args.crash_loop_k)
         return 2
@@ -460,8 +507,6 @@ def cmd_score(args) -> int:
             seed_initial=bool(args.learn_registry),
             sig_state=_reload_sig if args.learn_registry else None))
         if args.reload_model_every > 0 else None)
-    import dataclasses as _dc
-
     cfg = Config()
     if args.alerts_only and (args.scorer == "cpu"
                              or args.feedback_bootstrap):
@@ -535,6 +580,9 @@ def cmd_score(args) -> int:
             overload_cfg.spill_path or "(memory only)")
     cfg = cfg.replace(runtime=_dc.replace(
         cfg.runtime,
+        max_batch_rows=(args.max_batch_rows
+                        or cfg.runtime.max_batch_rows),
+        distributed=dist_cfg or cfg.runtime.distributed,
         emit_features=not args.alerts_only,
         emit_dtype="bfloat16" if args.emit_bf16 else "float32",
         emit_threshold=args.emit_threshold,
@@ -620,9 +668,9 @@ def cmd_score(args) -> int:
     ))
     if args.learn_registry:
         bad = None
-        if args.devices > 1:
+        if args.devices > 1 or multihost:
             bad = ("--learn-registry is not wired for the sharded "
-                   "engine (--devices > 1)")
+                   "engine (--devices > 1 / multi-host)")
         elif args.scorer == "cpu":
             bad = ("--learn-registry promotes by swapping on-device "
                    "params; --scorer cpu classifies host-side with a "
@@ -661,10 +709,15 @@ def cmd_score(args) -> int:
     if args.scorer == "cpu":
         cpu_model = model  # TrainedModel.predict_proba runs host-side numpy
 
-    if args.devices > 1 and args.scorer == "cpu":
+    if (args.devices > 1 or multihost) and args.scorer == "cpu":
         log.error("--scorer cpu is the single-host sklearn oracle; it does "
-                  "not compose with --devices > 1 (the sharded engine "
-                  "always scores on-device)")
+                  "not compose with --devices > 1 or multi-host (the "
+                  "sharded engine always scores on-device)")
+        return 2
+    if multihost and model.kind == "sequence":
+        log.error("multi-host serving is not wired for kind='sequence' "
+                  "(no history-state process adoption); serve it "
+                  "single-process")
         return 2
 
     if model.kind == "sequence":
@@ -794,7 +847,7 @@ def cmd_score(args) -> int:
                  args.learn_registry, learning.champion_version)
 
     def make_engine():
-        if args.devices > 1:
+        if args.devices > 1 or topology is not None:
             from real_time_fraud_detection_system_tpu.runtime import (
                 ShardedScoringEngine,
             )
@@ -808,6 +861,7 @@ def cmd_score(args) -> int:
                 online_lr=args.online_lr,
                 feature_cache=feature_cache,
                 dead_letter=dead_letter,
+                topology=topology,
             )
         return ScoringEngine(
             cfg,
@@ -827,6 +881,22 @@ def cmd_score(args) -> int:
             make_kafka_source,
         )
 
+        kafka_kw = {}
+        if topology is not None:
+            # Partition-affine ingest: this process consumes ONLY its
+            # block of broker partitions (manual assign — the framework
+            # owns placement, not the consumer group), so no row ever
+            # crosses a process boundary on the host plane.
+            kafka_kw = dict(
+                partitions=topology.kafka_partitions(
+                    cfg.runtime.n_partitions),
+                n_partitions=cfg.runtime.n_partitions,
+                group_id=f"rtfds-scorer-p{topology.process_id}",
+            )
+            log.info("kafka partition affinity: consuming partitions %s "
+                     "of %d", kafka_kw["partitions"],
+                     cfg.runtime.n_partitions)
+
         def source_factory():
             # Fresh consumer per incarnation: a zombie session's partitions
             # are fenced off by the broker's group generation.
@@ -834,6 +904,7 @@ def cmd_score(args) -> int:
                 args.bootstrap, topic=args.topic,
                 batch_rows=args.batch_rows,
                 idle_timeout_s=args.idle_timeout or None,
+                **kafka_kw,
             )
 
         source = source_factory()
@@ -861,6 +932,20 @@ def cmd_score(args) -> int:
             mode=args.mode,
             with_labels=args.online_lr > 0,
         )
+    if topology is not None and args.source != "kafka":
+        # Residue-sliced ingest for partition-less sources: this process
+        # serves only its owned customer residues of the shared stream
+        # (Kafka fleets got true partition assignment above instead).
+        # Wrapped INSIDE any prefetch below, so the producer thread
+        # prefetches already-sliced batches.
+        from real_time_fraud_detection_system_tpu.runtime import (
+            PartitionAffineSource,
+        )
+
+        source = PartitionAffineSource(source, topology)
+        log.info("partition-affine ingest: serving residues [%d, %d) "
+                 "of %d", topology.owned_shards.start,
+                 topology.owned_shards.stop, topology.n_shards_total)
     if cfg.runtime.prefetch_batches > 0:
         # Background source prefetch: poll + decode run ahead of the
         # loop on a producer thread. Wrapped OUTSIDE any fault injectors
@@ -881,13 +966,26 @@ def cmd_score(args) -> int:
 
         source = PrefetchSource(source, max_batches=depth)
         log.info("source prefetch on (queue depth %d)", depth)
+    ckpt_dir, out_path, raw_path = (args.checkpoint_dir, args.out,
+                                    args.raw_table)
+    if topology is not None:
+        # Shard-aware durable state: each process owns its residue
+        # block's lineage under proc-NN/ of the shared roots (same
+        # paths across restarts, so --resume finds the right block; a
+        # topology change is refused at restore with the merge path
+        # named). Sink parts split the same way — per-process
+        # batch_index lineages stay individually gap/dup-free.
+        sub = f"proc-{topology.process_id:02d}"
+        ckpt_dir = os.path.join(ckpt_dir, sub) if ckpt_dir else ckpt_dir
+        out_path = os.path.join(out_path, sub) if out_path else out_path
+        raw_path = os.path.join(raw_path, sub) if raw_path else raw_path
     ckpt = make_checkpointer(
-        args.checkpoint_dir,
+        ckpt_dir,
         full_every=cfg.runtime.checkpoint_full_every,
         op_timeout_s=cfg.runtime.checkpoint_op_timeout_s,
         op_attempts=cfg.runtime.checkpoint_op_attempts,
-    ) if args.checkpoint_dir else None
-    sink = make_parquet_sink(args.out) if args.out else None
+    ) if ckpt_dir else None
+    sink = make_parquet_sink(out_path) if out_path else None
     raw_table = None
     if args.raw_table:
         from real_time_fraud_detection_system_tpu.io import (
@@ -895,7 +993,7 @@ def cmd_score(args) -> int:
         )
         from real_time_fraud_detection_system_tpu.io.sink import FanoutSink
 
-        raw_table = RawTransactionsTable(args.raw_table,
+        raw_table = RawTransactionsTable(raw_path,
                                          flush_every_batches=64)
         sink = FanoutSink(sink, raw_table)
     if cfg.runtime.async_sink and sink is not None:
@@ -1049,6 +1147,19 @@ def cmd_score(args) -> int:
             recorder.close()
         if server is not None:
             server.stop()
+        if args.metrics_dump:
+            # success or failure: the registry snapshot is how the
+            # multihost bench/smoke assert recompile counts per worker
+            # without scraping a live port
+            from real_time_fraud_detection_system_tpu.utils.metrics \
+                import get_registry
+
+            try:
+                with open(args.metrics_dump, "w", encoding="utf-8") as f:
+                    json.dump(get_registry().snapshot(), f)
+            except OSError as e:
+                log.warning("metrics dump to %s failed: %s",
+                            args.metrics_dump, e)
         if tracer is not None and args.trace_out:
             # export even on a failed run — a crash mid-stream is
             # exactly when the last batches' waterfalls matter
@@ -1069,6 +1180,13 @@ def cmd_score(args) -> int:
         close_dlq = getattr(dead_letter, "close", None)
         if close_dlq is not None:
             close_dlq()
+    if topology is not None:
+        stats.update(
+            num_processes=topology.n_processes,
+            process_id=topology.process_id,
+            owned_shards=[topology.owned_shards.start,
+                          topology.owned_shards.stop],
+        )
     log.info("done: %s", stats)
     print(_json_line({"scorer": args.scorer, **stats}))
     return 0
@@ -1275,6 +1393,19 @@ def cmd_ckpt(args) -> int:
             # + writer-recorded directory occupancy: state skew visible
             # from the manifest, no restore needed
             man = {**man, "feature_state": fs}
+        meta = man.get("meta") or {}
+        pc = int(meta.get("process_count", 1) or 1)
+        ld = int(meta.get("layout_devices", 1) or 1)
+        # writer topology from the manifest alone: which residue block
+        # this entry holds, and how wide the fleet's shard space was —
+        # the preflight that catches a topology-mismatched relaunch
+        # before restore refuses it
+        man = {**man, "topology": {
+            "process_count": pc,
+            "process_id": int(meta.get("process_id", 0) or 0),
+            "layout_devices": ld,
+            "fleet_shards_total": pc * ld,
+        }}
         print(_json_line({"path": args.inspect, **man}))
         return 0
     # listing stays cheap (one read per entry); only --verify pays for
@@ -2360,7 +2491,36 @@ def main(argv=None) -> int:
     p.add_argument("--devices", type=int, default=1,
                    help="serve on an N-device mesh (sharded engine: "
                         "customer-partitioned rows, all_to_all terminal "
-                        "exchange); 1 = single-chip engine")
+                        "exchange); 1 = single-chip engine. In a "
+                        "multi-host fleet this is the PER-PROCESS width")
+    p.add_argument("--max-batch-rows", type=int, default=0,
+                   help="cap assembled micro-batches at this many rows "
+                        "(0 = config default 65536). The sharded "
+                        "engine's per-chunk step width derives from it "
+                        "(2x the balanced per-device load), so smoke/"
+                        "bench fleets size their compiled step with "
+                        "this knob")
+    p.add_argument("--coordinator", default="",
+                   help="host:port of process 0's jax.distributed "
+                        "coordination service — multi-host fleets "
+                        "(tools/multihost_launcher.py passes it); \"\" "
+                        "with --num-processes > 1 = uncoordinated "
+                        "fleet (no cross-process jax state; see the "
+                        "README multi-host playbook)")
+    p.add_argument("--num-processes", type=int, default=1,
+                   help="total processes in the multi-host fleet; this "
+                        "process serves the customer residue block "
+                        "[pid*devices, (pid+1)*devices) of the "
+                        "num-processes*devices global shard space")
+    p.add_argument("--process-id", type=int, default=-1,
+                   help="this process's id in [0, num-processes); -1 = "
+                        "resolve from JAX_PROCESS_ID")
+    p.add_argument("--metrics-dump", default="",
+                   help="write the final registry snapshot "
+                        "(/metrics.json content) to this path at run "
+                        "end, success or failure — the artifact the "
+                        "multihost bench/smoke assert zero recompiles "
+                        "from without scraping a live port")
     p.add_argument("--trace-dir", default="",
                    help="capture a jax.profiler/TensorBoard trace of the "
                         "serving run into this directory")

@@ -258,6 +258,58 @@ class OverloadConfig:
 
 
 @dataclass(frozen=True)
+class DistributedConfig:
+    """Multi-host process topology (``runtime/distributed.py``).
+
+    The reference scales by adding Spark executors behind one Kafka
+    topic; the TPU-native analogue is N OS processes (one per host),
+    each owning a contiguous block of the global shard space. Ownership
+    is residue-based — process p of P, serving L local devices, owns the
+    customer residues ``key % (P·L) ∈ [p·L, (p+1)·L)`` — chosen so the
+    sharded step's internal ``key % L`` placement equals the global
+    residue minus the block base: the per-process engine runs UNCHANGED
+    and the fleet's shard layout matches a single (P·L)-device engine's
+    exactly. Ingest is partition-affine (each process polls only its
+    owners' traffic), so the host plane never pays a cross-process
+    all-to-all; the owner exchange stays on the device fabric."""
+
+    # host:port of process 0's jax.distributed coordination service.
+    # "" = uncoordinated fleet: processes still partition the shard
+    # space but skip jax.distributed.initialize (no spanning mesh is
+    # possible; per-worker restart becomes safe — see the README
+    # multi-host playbook's failure-semantics table).
+    coordinator: str = ""
+    # Total processes in the fleet; 1 = single-process (everything off).
+    num_processes: int = 1
+    # This process's id in [0, num_processes); -1 = resolve from
+    # JAX_PROCESS_ID (the launcher always passes it explicitly).
+    process_id: int = -1
+    # Refuse polled rows whose customer residue this process does not
+    # own (catches mis-wired launchers before state diverges). Applies
+    # to residue-sliced sources (replay/synthetic/raw-table); Kafka
+    # fleets partition by broker partition, where residue membership is
+    # the producer's contract, not checkable per row — the CLI disables
+    # the check there.
+    strict_affinity: bool = True
+    # jax.distributed.initialize barrier timeout.
+    init_timeout_s: float = 120.0
+
+    def __post_init__(self):
+        if self.num_processes < 1:
+            raise ValueError(
+                f"distributed.num_processes must be >= 1, "
+                f"got {self.num_processes}")
+        if self.num_processes > 1 and self.process_id >= self.num_processes:
+            raise ValueError(
+                f"distributed.process_id {self.process_id} out of range "
+                f"for {self.num_processes} process(es)")
+        if self.init_timeout_s <= 0:
+            raise ValueError(
+                f"distributed.init_timeout_s must be > 0, "
+                f"got {self.init_timeout_s}")
+
+
+@dataclass(frozen=True)
 class RuntimeConfig:
     """Micro-batch engine (replaces Spark Structured Streaming triggers:
     5 s sinks ``kafka_s3_sink_customers.py:179``, 10 s scorer
@@ -415,6 +467,10 @@ class RuntimeConfig:
     restart_backoff_ms: float = 0.0
     # Overload-survival degradation ladder (see OverloadConfig).
     overload: OverloadConfig = field(default_factory=OverloadConfig)
+    # Multi-host process topology (see DistributedConfig): coordinator,
+    # process count/id, ingest-affinity strictness.
+    distributed: DistributedConfig = field(
+        default_factory=DistributedConfig)
 
     def __post_init__(self):
         if self.z_mode not in ("auto", "f32", "bf16", "int8"):

@@ -95,6 +95,17 @@ class CorruptCheckpointError(Exception):
         super().__init__(f"{reason}: {detail}" if detail else reason)
 
 
+class CheckpointTopologyError(ValueError):
+    """The checkpoint is HEALTHY but was written under a different
+    multi-host process topology than the restoring engine serves.
+
+    Deliberately not a :class:`CorruptCheckpointError`: the lineage
+    fallback quarantines corrupt entries and serves an older one, which
+    for a topology mismatch would silently rewind a healthy fleet (every
+    entry in the lineage has the same topology). Restore REFUSES
+    instead, with the elastic-reshard fix in the message."""
+
+
 def _observe_checkpoint(op: str, backend: str, t0: float, nbytes: int,
                         batches_done: int, kind: str = "full") -> None:
     """Shared save/restore instrumentation + the flight-record event a
@@ -152,6 +163,14 @@ def _state_arrays(engine_state) -> Tuple[dict, dict]:
         # count must travel with the state for cross-width restores
         "layout_devices": int(
             getattr(engine_state, "layout_devices", 1) or 1),
+        # multi-host: the writer's fleet topology. A per-process
+        # checkpoint holds only its residue block's keys, so restore
+        # refuses any topology change except the sanctioned 1→P
+        # adoption (see Checkpointer._check_topology).
+        "process_count": int(
+            getattr(engine_state, "process_count", 1) or 1),
+        "process_id": int(
+            getattr(engine_state, "process_id", 0) or 0),
         # registry version the params descend from (None outside
         # continuous learning) — restore hands it back so the learning
         # loop can tell restored params from the current champion
@@ -222,6 +241,12 @@ def _apply_arrays(engine_state, meta: dict, arrays: dict):
         engine_state.layout_devices = int(meta["layout_devices"])
     # pre-layout-aware checkpoints: leave the template's value (the old
     # same-width-restore assumption)
+    # Multi-host stamps reflect the WRITER (pre-multihost checkpoints
+    # were single-process by construction, so the default is honest —
+    # leaving a multi-process template's stamps would skip the 1→P
+    # adoption the restored global state needs).
+    engine_state.process_count = int(meta.get("process_count", 1) or 1)
+    engine_state.process_id = int(meta.get("process_id", 0) or 0)
     if meta.get("model_version") is not None:
         engine_state.model_version = int(meta["model_version"])
     # pre-learning checkpoints carry no stamp: keep the template's value
@@ -749,6 +774,64 @@ class _CheckpointerBase:
         return tip_meta, composed
 
     @staticmethod
+    def _check_topology(name, meta, template) -> None:
+        """Refuse a healthy checkpoint written under a different process
+        topology (vs quarantine-and-fallback, which is for corruption).
+
+        Allowed: identical topology (count + this process's id), and a
+        single-process GLOBAL checkpoint restored by a multi-process
+        fleet — the engine's elastic adoption re-slices it per process
+        (``parallel.mesh.adopt_process_slice``, the same reshard
+        machinery as width changes). Everything else names its fix."""
+        ck_pc = int(meta.get("process_count", 1) or 1)
+        ck_pid = int(meta.get("process_id", 0) or 0)
+        tpl_pc = int(getattr(template, "process_count", 1) or 1)
+        tpl_pid = int(getattr(template, "process_id", 0) or 0)
+        if ck_pc == tpl_pc and (ck_pc == 1 or ck_pid == tpl_pid):
+            if ck_pc > 1:
+                # Same fleet, same process — but a per-process WIDTH
+                # change moves residue blocks (ownership is
+                # key % (P·L)): keys migrate BETWEEN processes, which
+                # no per-process reshard can do. Refuse, naming the
+                # merge path, instead of silently splitting histories.
+                ck_ld = int(meta.get("layout_devices", 1) or 1)
+                tpl_ld = int(getattr(template, "layout_devices", 1)
+                             or 1)
+                if ck_ld != tpl_ld:
+                    raise CheckpointTopologyError(
+                        f"{name} was written at {ck_ld} device(s) per "
+                        f"process but this engine serves {tpl_ld} — in "
+                        f"a {ck_pc}-process fleet that changes the "
+                        "residue-block ownership (key % (P·L)), moving "
+                        "keys BETWEEN processes: merge the fleet's "
+                        "checkpoints to a global state (parallel.mesh."
+                        "merge_process_states → save single-process) "
+                        "and let the new fleet's elastic 1→N adoption "
+                        "re-slice it, or relaunch at the original "
+                        f"--devices {ck_ld}")
+            return
+        if ck_pc == 1 and tpl_pc > 1:
+            return  # sanctioned 1→P adoption (engine re-slices)
+        if ck_pc == tpl_pc:
+            raise CheckpointTopologyError(
+                f"{name} was written by process {ck_pid} of the "
+                f"{ck_pc}-process fleet, but this engine is process "
+                f"{tpl_pid} — each process restores its OWN residue "
+                "block; point every worker at its own proc-NN "
+                "checkpoint directory (the launcher does this when the "
+                "checkpoint root and process ids are unchanged)")
+        raise CheckpointTopologyError(
+            f"{name} was written by a {ck_pc}-process fleet; this "
+            f"engine serves a {tpl_pc}-process topology. A per-process "
+            "checkpoint holds only its residue block's keys, so a "
+            "process-count change cannot restore directly: merge every "
+            "process's final checkpoint into one global state "
+            "(parallel.mesh.merge_process_states), save it from a "
+            "single-process engine, and let the new fleet's elastic "
+            "1→N adoption re-slice it — or relaunch at the original "
+            f"--num-processes {ck_pc}")
+
+    @staticmethod
     def _check_template(name, meta, manifest, arrays, template) -> None:
         """Structural compatibility vs the restore template: leaf counts
         and shapes always; dtypes + the config/feature-spec fingerprint
@@ -857,6 +940,12 @@ class _CheckpointerBase:
                 corrupt += 1
                 self._note_corrupt(n, e)
                 continue
+            # AFTER the corruption verdict, BEFORE the template is
+            # mutated: a topology mismatch is a refusal (raises), never
+            # a quarantine — the checkpoint is healthy and the whole
+            # lineage shares its topology, so falling back would only
+            # rewind the fleet
+            self._check_topology(n, meta, engine_state)
             out = _apply_arrays(engine_state, meta, arrays)
             nbytes = sum(a.nbytes for a in arrays.values())
             _observe_checkpoint("restore", self._backend.kind, t0, nbytes,
@@ -1042,6 +1131,12 @@ def feature_state_report(man: dict) -> Optional[dict]:
         leaves.append(row)
     out: dict = {"layout_devices": layout, "total_bytes": total,
                  "leaves": leaves}
+    pc = int(meta.get("process_count", 1) or 1)
+    if pc > 1:
+        # fleet writer: this entry holds ONE process's residue block
+        out["process_count"] = pc
+        out["process_id"] = int(meta.get("process_id", 0) or 0)
+        out["fleet_shards_total"] = pc * layout
     occ = meta.get("feature_state_occupancy")
     if occ:
         out["occupancy_per_shard"] = occ

@@ -508,6 +508,49 @@ def _event_strip(events: List[dict], t0: float, t1: float) -> str:
             + "".join(marks) + "</svg>")
 
 
+def _cluster_tile(events: List[dict], man: dict):
+    """Cluster tile (multi-host fleets): worst process leads, mirroring
+    the worst-shard convention — the slowest/most-restarted process is
+    the one gating fleet throughput. None unless the record carries
+    cluster events (the launcher's flight record), so single-process
+    runs keep a clean tile row. Shared by the full ops view and the
+    no-batch-records path: the launcher's own record has no batch lines
+    by construction, and a fleet that died before serving is exactly
+    when the tile matters."""
+    cl_workers = [e for e in events
+                  if e.get("event") == "cluster_worker"]
+    fleet_restarts = [e for e in events
+                      if e.get("event") == "fleet_restart"]
+    worker_restarts = [e for e in events
+                       if e.get("event") == "cluster_worker_restart"]
+    if not (cl_workers or fleet_restarts or worker_restarts):
+        return None
+    # last exit record per process (a restarted worker reports twice)
+    by_proc = {}
+    for e in cl_workers:
+        by_proc[e.get("process")] = e
+    n_proc = (man.get("multihost") or {}).get("processes", len(by_proc))
+    sub_bits = []
+    failed = [p for p, e in by_proc.items()
+              if e.get("rc") not in (0, None)]
+    if by_proc:
+        worst_p, worst_e = min(
+            by_proc.items(),
+            key=lambda kv: float(kv[1].get("rows_per_s", 0.0) or 0.0))
+        sub_bits.append(
+            f"worst p{worst_p}: "
+            f"{_compact(float(worst_e.get('rows_per_s', 0.0) or 0.0))}"
+            "/s")
+    if failed:
+        sub_bits.insert(0, f"{len(failed)} worker(s) FAILED "
+                           f"{sorted(failed)[:4]}")
+    if fleet_restarts:
+        sub_bits.append(f"{len(fleet_restarts)} fleet restart(s)")
+    if worker_restarts:
+        sub_bits.append(f"{len(worker_restarts)} worker restart(s)")
+    return ("Cluster", f"{n_proc} proc", " · ".join(sub_bits))
+
+
 def render_ops_html(
     manifest: Optional[dict],
     records: List[dict],
@@ -544,6 +587,17 @@ def render_ops_html(
         # A run that died before its first batch completed is exactly
         # where the event strip matters most (the fault/restart events
         # explain the death) — render them even with no batch records.
+        # A launcher flight record is batch-less by construction: its
+        # Cluster tile still renders.
+        cluster = _cluster_tile(events, man)
+        if cluster is not None:
+            label, value, sub = cluster
+            subdiv = f"<div class='sub'>{_esc(sub)}</div>" if sub else ""
+            parts.append(
+                "<div class='tiles'><div class='tile'>"
+                f"<div class='lbl'>{_esc(label)}</div>"
+                f"<div class='num'>{_esc(value)}</div>{subdiv}"
+                "</div></div>")
         parts.append("<p class='empty'>no batch records</p>")
         if events:
             t0 = float(events[0].get("t", 0.0))
@@ -714,6 +768,9 @@ def render_ops_html(
                             "(kind/missing)")
         tiles.append(("Learning", f"v{champ}" if promos or rollbacks
                       else str(champ), " · ".join(sub_bits)))
+    cluster = _cluster_tile(events, man)
+    if cluster is not None:
+        tiles.append(cluster)
     tile_html = []
     for label, value, sub in tiles:
         subdiv = f"<div class='sub'>{_esc(sub)}</div>" if sub else ""

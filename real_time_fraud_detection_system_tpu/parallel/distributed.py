@@ -36,6 +36,7 @@ def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    init_timeout_s: Optional[float] = None,
 ) -> bool:
     """Initialize multi-process JAX if configured; returns True if active.
 
@@ -44,6 +45,8 @@ def initialize_distributed(
     on Cloud TPU ``jax.distributed.initialize()`` autodetects from metadata
     instead). Single-process (nothing configured) is a no-op returning
     False, so the same binary runs a laptop test and a pod.
+    ``init_timeout_s`` bounds the all-processes-present barrier (a
+    mislaunched fleet fails fast instead of hanging the deploy).
     """
     global _INITIALIZED
     if _INITIALIZED:
@@ -57,10 +60,14 @@ def initialize_distributed(
         process_id = int(os.environ["JAX_PROCESS_ID"])
     if coordinator_address is None and num_processes is None:
         return False  # single-process mode
+    kw = {}
+    if init_timeout_s is not None:
+        kw["initialization_timeout"] = int(max(init_timeout_s, 1))
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
+        **kw,
     )
     _INITIALIZED = True
     return True

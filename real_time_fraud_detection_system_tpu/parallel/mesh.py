@@ -60,6 +60,74 @@ def make_mesh(n_devices: int = 0, axis: str = "data") -> Mesh:
     return Mesh(np.asarray(devs[:n_devices]), (axis,))
 
 
+def make_local_mesh(n_devices: int = 0, axis: str = "data") -> Mesh:
+    """The PROCESS-LOCAL serving mesh: this process's own devices only.
+
+    Identical to :func:`make_mesh` single-process. Under
+    ``jax.distributed`` the two diverge — ``jax.devices()`` spans every
+    process, and a per-process engine jitting over non-addressable
+    devices is exactly the mistake that turns a host-local step into a
+    cross-process computation — so multi-host serving builds its mesh
+    here (one engine per process, owner exchange on local ICI) and
+    leaves :func:`make_process_mesh` to code that has proven the
+    backend's cross-process collectives.
+    """
+    devs = jax.local_devices()
+    if n_devices == 0:
+        n_devices = len(devs)
+    if n_devices > len(devs):
+        raise ValueError(
+            f"requested {n_devices} local devices, process "
+            f"{jax.process_index()} has {len(devs)}"
+        )
+    return Mesh(np.asarray(devs[:n_devices]), (axis,))
+
+
+def make_process_mesh(axis: str = "data") -> Mesh:
+    """The process-SPANNING 1-D serving mesh: every process's devices,
+    ordered so process p's local devices occupy the contiguous block
+    ``[p·L, (p+1)·L)`` — the same block the residue ownership of
+    :class:`~..runtime.distributed.ProcessTopology` assigns it, so a
+    spanning-mesh step and the partitioned per-process deployment agree
+    on which device owns which key.
+
+    Computations over this mesh are cross-process collectives (DCN
+    between hosts, ICI within): gate on
+    :func:`cross_process_collectives_supported` first — CPU jaxlib
+    builds without Gloo/MPI refuse them at dispatch, deep inside
+    serving, which is the wrong place to find out.
+    """
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def cross_process_collectives_supported(mesh: Mesh) -> Optional[str]:
+    """None when the backend can run computations over ``mesh``'s full
+    device set; otherwise the backend's capability error string (the
+    precise-skip sentinel the multiprocess tests print as ``MPSKIP``).
+
+    Single-process meshes trivially pass. Multi-process, every process
+    must call this together (it compiles+runs one tiny SPMD program —
+    the cheapest thing that exercises the cross-process dispatch path).
+    Only the known capability refusal is swallowed; any other failure
+    is a real bug and propagates."""
+    if int(jax.process_count()) == 1:
+        return None
+    import jax.numpy as jnp
+
+    try:
+        out = jax.jit(
+            lambda: jnp.zeros((int(mesh.devices.size),), jnp.float32),
+            out_shardings=NamedSharding(mesh, P(mesh.axis_names[0])),
+        )()
+        jax.block_until_ready(out)
+        return None
+    except (RuntimeError, ValueError, NotImplementedError) as e:
+        if "Multiprocess computations aren't implemented" in str(e):
+            return str(e).splitlines()[-1]
+        raise
+
+
 def shard_feature_state(
     state: FeatureState, mesh: Mesh, axis: "str | tuple[str, ...]" = "data"
 ) -> FeatureState:
@@ -282,8 +350,136 @@ def _merge_sketch(cms, n_old: int):
     return type(cms)(*leaves)
 
 
-def _reshard_exact(state: FeatureState, fcfg, n_old: int, n_new: int
-                   ) -> FeatureState:
+def _rebuild_exact_table(name: str, ctx: str, ws_type, kd_type,
+                         keys: np.ndarray, vals: dict,
+                         cap: int, n_new: int, n_probes: int):
+    """Rebuild one (window table, key directory) pair in the
+    ``n_new``-shard layout from extracted live entries — the shared tail
+    of :func:`_reshard_exact` (elastic N→M) and
+    :func:`merge_process_states` (per-process fleets → one state), so
+    the slot discipline cannot diverge between them.
+
+    ``keys`` [K] uint32 (must be unique — ownership means a key lives in
+    exactly one source shard/process); ``vals`` maps window-leaf name →
+    its [K, ...] gathered rows. Owner = ``key % n_new``, slot ids within
+    a shard are assigned in sorted-key order (deterministic: two rebuilds
+    of the same entries are byte-identical), directories are rebuilt
+    with the same double-hash probe discipline ``admit_slots`` uses at
+    serve time. Loud failures, never silent state loss; ``ctx`` names
+    the operation in every error."""
+    from real_time_fraud_detection_system_tpu.ops.keydir import (
+        EMPTY_KEY,
+        _probe_positions,
+    )
+    import jax.numpy as jnp
+
+    cap_local_new = cap // n_new
+    owner = (keys % np.uint32(n_new)).astype(np.int64)
+    order = np.lexsort((keys, owner))
+    owner_s, keys_s = owner[order], keys[order]
+    if len(keys_s) > 1 and (keys_s[:-1] == keys_s[1:]).any():
+        dup = keys_s[:-1][keys_s[:-1] == keys_s[1:]][:4]
+        raise ValueError(
+            f"{ctx}: duplicate {name} key(s) {dup.tolist()} across "
+            "source shards — the ownership contract places each key in "
+            "exactly one shard/process, so a duplicate means two "
+            "engines served the same key (partition-affinity breach); "
+            "merging would corrupt its window history")
+    counts = np.bincount(owner_s, minlength=n_new)
+    if counts.max(initial=0) > cap_local_new:
+        worst = int(np.argmax(counts))
+        raise ValueError(
+            f"{ctx}: new shard {worst} would own "
+            f"{int(counts[worst])} live {name} keys but holds only "
+            f"{cap_local_new} slots — run compaction before shrinking "
+            "the mesh, or keep more shards")
+    rank = (np.arange(len(owner_s))
+            - np.concatenate(([0], np.cumsum(counts)))[owner_s])
+    new_rows = owner_s * cap_local_new + rank
+    # ---- move the window rows (bit-exact copies) ------------------------
+    fills = {"bucket_day": -1, "count": 0.0, "amount": 0.0, "fraud": 0.0}
+
+    def rehome(leaf_name):
+        src = np.asarray(vals[leaf_name])[order]
+        fresh = np.full((cap,) + src.shape[1:], fills[leaf_name],
+                        dtype=src.dtype)
+        fresh[new_rows] = src
+        return fresh
+
+    ws_new = ws_type(**{k: rehome(k) for k in fills})
+    # ---- rebuild the per-shard directories ------------------------------
+    dir_cap_new = 2 * cap_local_new
+    nkeys = np.full((n_new, dir_cap_new), EMPTY_KEY, np.uint32)
+    nslots = np.full((n_new, dir_cap_new), -1, np.int32)
+    pos = np.asarray(_probe_positions(
+        jnp.asarray(keys_s), dir_cap_new, n_probes))  # [K, P]
+    flat_keys = nkeys.reshape(-1)
+    flat_slots = nslots.reshape(-1)
+    placed = np.zeros(len(keys_s), dtype=bool)
+    for j in range(n_probes):
+        active = ~placed
+        if not active.any():
+            break
+        gpos = owner_s * dir_cap_new + pos[:, j]
+        want = active & (flat_keys[gpos] == EMPTY_KEY)
+        # scatter-min claim rounds, the np mirror of admit_slots: among
+        # same-position racers the smallest key wins (keys are unique
+        # per shard, so every key wins exactly one round)
+        np.minimum.at(flat_keys, gpos[want], keys_s[want])
+        won = want & (flat_keys[gpos] == keys_s)
+        flat_slots[gpos[won]] = rank[won].astype(np.int32)
+        placed |= won
+    if not placed.all():
+        miss = int((~placed).sum())
+        raise ValueError(
+            f"{ctx}: {miss} {name} key(s) could not place within "
+            f"{n_probes} probes of the rebuilt directory — raise "
+            "keydir_probes or grow the hot tier (admitted-key state "
+            "must survive a rebuild bit-exactly, so dropping them is "
+            "not an option)")
+    free = np.broadcast_to(
+        np.arange(cap_local_new - 1, -1, -1, dtype=np.int32),
+        (n_new, cap_local_new)).copy()
+    kd_new_leaves = dict(
+        keys=nkeys, slots=nslots, free=free,
+        free_top=(cap_local_new - counts).astype(np.int32))
+    if n_new == 1:
+        kd_new_leaves = {
+            k: (v[0] if k != "free_top" else np.int32(v[0]))
+            for k, v in kd_new_leaves.items()}
+    return ws_new, kd_type(**kd_new_leaves)
+
+
+def _extract_exact_table(name: str, ws, kd, n_old: int, cap: int):
+    """Live (key, window-row) pairs of one exact-mode table: keys [K],
+    vals (leaf name → gathered [K, ...] rows). The extraction half
+    shared by reshard and merge."""
+    keys = np.asarray(kd.keys)
+    slots = np.asarray(kd.slots)
+    if keys.ndim == 1:
+        keys, slots = keys[None], slots[None]
+    if keys.shape[0] != n_old:
+        raise ValueError(
+            f"{name}_dir is laid out for {keys.shape[0]} shard(s), "
+            f"caller says n_old={n_old}")
+    bd = np.asarray(ws.bucket_day)
+    if bd.shape[0] != cap:
+        raise ValueError(
+            f"state table has {bd.shape[0]} rows, config says "
+            f"{cap} — re-sharding a checkpoint taken under a "
+            "different capacity would merge or drop keys")
+    cap_local_old = cap // n_old
+    shard_idx, entry_idx = np.nonzero(slots >= 0)
+    lkeys = keys[shard_idx, entry_idx]
+    old_rows = (shard_idx * cap_local_old
+                + slots[shard_idx, entry_idx].astype(np.int64))
+    vals = {k: np.asarray(getattr(ws, k))[old_rows]
+            for k in ("bucket_day", "count", "amount", "fraud")}
+    return lkeys, vals
+
+
+def _reshard_exact(state: FeatureState, fcfg, n_old: int, n_new: int,
+                   owner_filter=None) -> FeatureState:
     """Elastic N→M re-home of the TIERED exact state (directories +
     windows + sketches) with bit-exact admitted-key state.
 
@@ -305,13 +501,14 @@ def _reshard_exact(state: FeatureState, fcfg, n_old: int, n_new: int
     mesh — possible because total occupancy ≤ capacity does not bound
     any single residue class) and a key that cannot place within
     ``keydir_probes`` probes both raise, with the fix named.
-    """
-    from real_time_fraud_detection_system_tpu.ops.keydir import (
-        EMPTY_KEY,
-        _probe_positions,
-    )
 
+    ``owner_filter`` (keys → bool mask): keep only these keys' state —
+    the process-adoption path (:func:`adopt_process_slice`): a
+    single-process global checkpoint restored into a P-process fleet
+    keeps, per process, exactly the residue block it owns.
+    """
     n_probes = fcfg.keydir_probes
+    ctx = f"elastic reshard {n_old}→{n_new}"
     out = {}
     for name, cap, present in (
             ("customer", fcfg.customer_capacity,
@@ -333,110 +530,176 @@ def _reshard_exact(state: FeatureState, fcfg, n_old: int, n_new: int
                 f"key_mode='exact' reshard needs the {name} key "
                 "directory; this state carries none (was it built "
                 "under a different key_mode?)")
-        keys = np.asarray(kd.keys)
-        slots = np.asarray(kd.slots)
-        if keys.ndim == 1:
-            keys, slots = keys[None], slots[None]
-        if keys.shape[0] != n_old:
-            raise ValueError(
-                f"{name}_dir is laid out for {keys.shape[0]} shard(s), "
-                f"caller says n_old={n_old}")
         for n, who in ((n_old, "n_old"), (n_new, "n_new")):
             if n < 1 or cap % n or ((cap // n) & (cap // n - 1)):
                 raise ValueError(
                     f"{name}_capacity {cap} / {who}={n} must be a "
                     "power of two")
-        bd = np.asarray(ws.bucket_day)
-        if bd.shape[0] != cap:
-            raise ValueError(
-                f"state table has {bd.shape[0]} rows, config says "
-                f"{cap} — re-sharding a checkpoint taken under a "
-                "different capacity would merge or drop keys")
-        cap_local_old = cap // n_old
-        cap_local_new = cap // n_new
-        # ---- extract live (key, window-row) pairs -----------------------
-        shard_idx, entry_idx = np.nonzero(slots >= 0)
-        lkeys = keys[shard_idx, entry_idx]
-        old_rows = (shard_idx * cap_local_old
-                    + slots[shard_idx, entry_idx].astype(np.int64))
-        # ---- re-home: owner = key % n_new, slots in sorted-key order ----
-        owner = (lkeys % np.uint32(n_new)).astype(np.int64)
-        order = np.lexsort((lkeys, owner))
-        owner_s, keys_s, rows_s = owner[order], lkeys[order], old_rows[order]
-        counts = np.bincount(owner_s, minlength=n_new)
-        if counts.max(initial=0) > cap_local_new:
-            worst = int(np.argmax(counts))
-            raise ValueError(
-                f"elastic reshard {n_old}→{n_new}: new shard {worst} "
-                f"would own {int(counts[worst])} live {name} keys but "
-                f"holds only {cap_local_new} slots — run compaction "
-                "before shrinking the mesh, or keep more shards")
-        rank = (np.arange(len(owner_s))
-                - np.concatenate(([0], np.cumsum(counts)))[owner_s])
-        new_rows = owner_s * cap_local_new + rank
-        # ---- move the window rows (bit-exact copies) --------------------
-        def rehome(leaf, fill):
-            a = np.asarray(leaf)
-            fresh = np.full_like(a, fill)
-            fresh[new_rows] = a[rows_s]
-            return fresh
-
-        out[name] = type(ws)(
-            bucket_day=rehome(ws.bucket_day, -1),
-            count=rehome(ws.count, 0.0),
-            amount=rehome(ws.amount, 0.0),
-            fraud=rehome(ws.fraud, 0.0),
-        )
-        # ---- rebuild the per-shard directories --------------------------
-        dir_cap_new = 2 * cap_local_new
-        nkeys = np.full((n_new, dir_cap_new), EMPTY_KEY, np.uint32)
-        nslots = np.full((n_new, dir_cap_new), -1, np.int32)
-        import jax.numpy as jnp
-
-        pos = np.asarray(_probe_positions(
-            jnp.asarray(keys_s), dir_cap_new, n_probes))  # [K, P]
-        flat_keys = nkeys.reshape(-1)
-        flat_slots = nslots.reshape(-1)
-        placed = np.zeros(len(keys_s), dtype=bool)
-        for j in range(n_probes):
-            active = ~placed
-            if not active.any():
-                break
-            gpos = owner_s * dir_cap_new + pos[:, j]
-            want = active & (flat_keys[gpos] == EMPTY_KEY)
-            # scatter-min claim rounds, the np mirror of admit_slots:
-            # among same-position racers the smallest key wins (keys are
-            # unique per shard, so every key wins exactly one round)
-            np.minimum.at(flat_keys, gpos[want], keys_s[want])
-            won = want & (flat_keys[gpos] == keys_s)
-            flat_slots[gpos[won]] = rank[won].astype(np.int32)
-            placed |= won
-        if not placed.all():
-            miss = int((~placed).sum())
-            raise ValueError(
-                f"elastic reshard {n_old}→{n_new}: {miss} {name} "
-                f"key(s) could not place within {n_probes} probes of "
-                "the rebuilt directory — raise keydir_probes or grow "
-                "the hot tier (admitted-key state must survive a "
-                "reshard bit-exactly, so dropping them is not an "
-                "option)")
-        free = np.broadcast_to(
-            np.arange(cap_local_new - 1, -1, -1, dtype=np.int32),
-            (n_new, cap_local_new)).copy()
-        kd_new_leaves = dict(
-            keys=nkeys, slots=nslots, free=free,
-            free_top=(cap_local_new - counts).astype(np.int32))
-        if n_new == 1:
-            kd_new_leaves = {
-                k: (v[0] if k != "free_top" else np.int32(v[0]))
-                for k, v in kd_new_leaves.items()}
-        out[f"{name}_dir"] = type(kd)(**kd_new_leaves)
+        lkeys, vals = _extract_exact_table(name, ws, kd, n_old, cap)
+        if owner_filter is not None:
+            keep = np.asarray(owner_filter(lkeys), dtype=bool)
+            lkeys = lkeys[keep]
+            vals = {k: v[keep] for k, v in vals.items()}
+        out[name], out[f"{name}_dir"] = _rebuild_exact_table(
+            name, ctx, type(ws), type(kd), lkeys, vals,
+            cap, n_new, n_probes)
     return state._replace(
         customer=out["customer"], terminal=out["terminal"],
         cms=_merge_sketch(state.cms, n_old),
         customer_dir=out["customer_dir"],
         terminal_dir=out["terminal_dir"],
         terminal_cms=_merge_sketch(state.terminal_cms, n_old),
+    )
+
+
+def adopt_process_slice(state: FeatureState, cfg, n_old: int, topology
+                        ) -> FeatureState:
+    """A single-process GLOBAL feature state (checkpoint written by a
+    1-process deployment at ``n_old`` devices) → THIS process's local
+    layout — the 1→P leg of multi-host elastic topology changes,
+    routed through the same exact re-home machinery as every other
+    reshard.
+
+    Exact mode keeps only the keys whose residue block this process
+    owns (``topology.owns``, bit-exact for every owned admitted key;
+    unowned keys simply move to their own process's adoption of the
+    same checkpoint). Direct mode keeps the full tables: unowned slots
+    are inert — their keys never arrive on this process, and the
+    direct-mode contract (keys < capacity) means they alias nothing an
+    owned key probes. Sketches merge to the single layout and stay
+    whole (a CMS upper bound holds for every key, owned or not).
+    Returns host-side arrays in the stacked local layout."""
+    fcfg = cfg.features
+    if fcfg.key_mode == "exact":
+        return _reshard_exact(state, fcfg, n_old, topology.local_devices,
+                              owner_filter=topology.owns)
+    return reshard_feature_state(state, cfg, n_old,
+                                 topology.local_devices)
+
+
+def merge_process_states(states, cfg, n_locals) -> FeatureState:
+    """Merge a P-process fleet's per-process feature states into ONE
+    single-chip-layout global state — the P→1 leg of multi-host
+    topology changes (shrink/regrow the fleet: merge every process's
+    final checkpoint, then restore the merged state at the new
+    topology, where :func:`adopt_process_slice` re-slices it).
+
+    ``n_locals[i]``: process i's local device count (its state's shard
+    layout). Exact mode extracts every process's live (key, window-row)
+    entries — disjoint by the ownership contract, loudly verified — and
+    rebuilds the global directory through the same
+    :func:`_rebuild_exact_table` tail as elastic reshard. Direct mode
+    combines row-wise by residue ownership (row r holds key ≡ r mod
+    capacity under the direct layout, so each row's authoritative copy
+    is its owner process's; requires a homogeneous fleet and
+    capacity % (P·L) == 0). Hash mode cannot merge (colliding keys
+    cannot be attributed to owners) and refuses, like elastic reshard.
+    Sketches merge per-process then across processes under the
+    newest-day rule (upper bounds preserved). Returns host arrays."""
+    fcfg = cfg.features
+    if not states or len(states) != len(n_locals):
+        raise ValueError(
+            f"merge_process_states: {len(states)} state(s) vs "
+            f"{len(n_locals)} n_locals")
+    n_proc = len(states)
+    if n_proc == 1:
+        return reshard_feature_state(states[0], cfg, n_locals[0], 1)
+    if fcfg.key_mode == "hash":
+        raise ValueError(
+            "process merge requires key_mode='direct' or 'exact' (hash "
+            "mode merges colliding keys — rows cannot be attributed to "
+            "their owner process)")
+
+    def merge_cms(getter):
+        per = []
+        for st, n_loc in zip(states, n_locals):
+            m = _merge_sketch(getter(st), n_loc)
+            if m is None:
+                return None
+            per.append(m)
+        stacked = type(per[0])(*[
+            None if any(le is None for le in leaves)
+            else np.stack([np.asarray(le) for le in leaves])
+            for leaves in zip(*per)])
+        return _merge_sketch(stacked, n_proc)
+
+    if fcfg.key_mode == "exact":
+        out = {}
+        for name, cap, present in (
+                ("customer", fcfg.customer_capacity,
+                 fcfg.customer_source != "cms"),
+                ("terminal", fcfg.terminal_capacity, True)):
+            if not present:
+                # customer_source="cms": the table is dead weight (the
+                # sketch serves the features) — any process's copy is as
+                # good as any other's
+                out[name] = jax.tree.map(
+                    np.asarray, getattr(states[0], name))
+                out[f"{name}_dir"] = None
+                continue
+            keys_all, vals_all = [], []
+            ws = kd = None
+            for pid, (st, n_loc) in enumerate(zip(states, n_locals)):
+                ws, kd = getattr(st, name), getattr(st, f"{name}_dir")
+                if kd is None:
+                    raise ValueError(
+                        f"process {pid}'s state carries no {name} key "
+                        "directory (was it built under a different "
+                        "key_mode?)")
+                k, v = _extract_exact_table(name, ws, kd, n_loc, cap)
+                keys_all.append(k)
+                vals_all.append(v)
+            keys = np.concatenate(keys_all)
+            vals = {k: np.concatenate([v[k] for v in vals_all])
+                    for k in vals_all[0]}
+            out[name], out[f"{name}_dir"] = _rebuild_exact_table(
+                name, f"process merge {n_proc}→1", type(ws), type(kd),
+                keys, vals, cap, 1, fcfg.keydir_probes)
+        return states[0]._replace(
+            customer=out["customer"], terminal=out["terminal"],
+            cms=merge_cms(lambda s: s.cms),
+            customer_dir=out["customer_dir"],
+            terminal_dir=out["terminal_dir"],
+            terminal_cms=merge_cms(lambda s: s.terminal_cms))
+
+    # direct mode: fixed layout permutations; merge row-wise by residue
+    # ownership (row r ↔ key r under the single-chip direct layout)
+    if len(set(int(n) for n in n_locals)) != 1:
+        raise ValueError(
+            "direct-mode process merge needs a homogeneous fleet (every "
+            f"process the same local width), got n_locals={list(n_locals)}"
+            " — exact mode re-homes by stored key and has no such limit")
+    n_local = int(n_locals[0])
+    n_total = n_proc * n_local
+    singles = [reshard_feature_state(st, cfg, n_local, 1)
+               for st in states]
+
+    def combine(name, cap):
+        if cap % n_total:
+            raise ValueError(
+                f"direct-mode process merge needs {name}_capacity {cap} "
+                f"divisible by n_processes×local_devices = {n_total} "
+                "(row residue = key residue is what attributes each row "
+                "to its owner)")
+        owner = (np.arange(cap) % n_total) // n_local
+        ws0 = getattr(singles[0], name)
+
+        def one(leaf_name):
+            leaves = [np.asarray(getattr(getattr(s, name), leaf_name))
+                      for s in singles]
+            merged = np.empty_like(leaves[0])
+            for p in range(n_proc):
+                m = owner == p
+                merged[m] = leaves[p][m]
+            return merged
+
+        return type(ws0)(**{k: one(k) for k in
+                            ("bucket_day", "count", "amount", "fraud")})
+
+    return states[0]._replace(
+        customer=combine("customer", fcfg.customer_capacity),
+        terminal=combine("terminal", fcfg.terminal_capacity),
+        cms=merge_cms(lambda s: s.cms),
     )
 
 

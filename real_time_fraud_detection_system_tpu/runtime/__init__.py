@@ -1,10 +1,15 @@
 from real_time_fraud_detection_system_tpu.runtime.sources import (  # noqa: F401
     InProcBroker,
     KafkaSource,
+    PartitionAffineSource,
     RawTableSource,
     ReplaySource,
     SyntheticSource,
     make_kafka_source,
+)
+from real_time_fraud_detection_system_tpu.runtime.distributed import (  # noqa: F401
+    ProcessTopology,
+    bootstrap_distributed,
 )
 from real_time_fraud_detection_system_tpu.runtime.engine import (  # noqa: F401
     EngineState,

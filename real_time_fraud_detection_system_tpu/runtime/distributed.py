@@ -1,0 +1,207 @@
+"""Multi-host serving topology: process bootstrap + shard ownership.
+
+The reference scales by adding Spark executors behind one Kafka topic
+(SURVEY §2.3); here the unit of scale-out is an OS process (one per TPU
+host), each serving a ``ShardedScoringEngine`` over its LOCAL device
+mesh. The classic risk is distributed coordination cost eating the
+speedup (PAPERS: *Understanding and Optimizing the Performance of
+Distributed ML Applications on Apache Spark*); this module's answer is
+to make the host plane embarrassingly parallel:
+
+- **Residue-block ownership**: the global shard space has
+  ``n_shards_total = num_processes × local_devices`` shards; process p
+  owns the contiguous residue block ``key % n_total ∈ [p·L, (p+1)·L)``.
+  Because ``p·L ≡ 0 (mod L)``, a key in p's block satisfies
+  ``key % L == (key % n_total) − p·L`` — the per-process engine's
+  internal ``key % L`` placement lands each key on exactly the device
+  the global ``key % n_total`` layout would, so the fleet's shard
+  layout is the single-engine layout cut into process blocks and the
+  engine runs UNCHANGED.
+- **Partition-affine ingest**: each process polls only the traffic its
+  residues own (:class:`~.sources.PartitionAffineSource` for residue
+  slices, broker partition blocks for Kafka), so no row ever crosses a
+  process boundary on the host plane; the in-step owner exchange stays
+  on the device fabric (local ICI today; DCN×ICI once the backend has
+  cross-process collectives — see
+  :func:`~..parallel.mesh.make_process_mesh`).
+
+:func:`bootstrap_distributed` wires ``jax.distributed.initialize`` from
+:class:`~..config.DistributedConfig` (the ``--coordinator /
+--num-processes / --process-id`` flags) and returns the
+:class:`ProcessTopology` every layer threads: the engine labels its
+shards globally, sources slice their polls, checkpoints stamp the
+writer's topology.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import List, Optional
+
+import numpy as np
+
+from real_time_fraud_detection_system_tpu.config import DistributedConfig
+
+
+def _fold_u32(ids: np.ndarray) -> np.ndarray:
+    """uint32 key fold (``core.batch.fold_key``, re-derived here to keep
+    this module import-light for the launcher): identity for ids <
+    2**32, so residue math matches the host partitioner's raw modulo on
+    every realistic id space."""
+    v = np.asarray(ids).astype(np.uint64)
+    return ((v ^ (v >> np.uint64(32)))
+            & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+
+
+@dataclass(frozen=True)
+class ProcessTopology:
+    """One process's place in the fleet — the residue-block ownership
+    contract shared by ingest, the engine, checkpoints and telemetry.
+
+    ``strict_affinity``: when True the engine refuses polled rows whose
+    customer residue it does not own (a mis-wired launcher fails fast
+    instead of silently splitting a key's history across processes).
+    """
+
+    n_processes: int
+    process_id: int
+    local_devices: int
+    coordinated: bool = False  # jax.distributed actually initialized
+    strict_affinity: bool = True
+
+    def __post_init__(self):
+        if self.n_processes < 1:
+            raise ValueError(
+                f"n_processes must be >= 1, got {self.n_processes}")
+        if not 0 <= self.process_id < self.n_processes:
+            raise ValueError(
+                f"process_id {self.process_id} out of range for "
+                f"{self.n_processes} process(es)")
+        if self.local_devices < 1:
+            raise ValueError(
+                f"local_devices must be >= 1, got {self.local_devices}")
+
+    # -- the shard-space geometry ---------------------------------------
+
+    @property
+    def n_shards_total(self) -> int:
+        return self.n_processes * self.local_devices
+
+    @property
+    def shard_offset(self) -> int:
+        """Global id of this process's first local shard: local shard j
+        serves global shard ``shard_offset + j`` — and, by the
+        residue-block construction, exactly the keys the single
+        (n_total)-device engine would route to that global shard."""
+        return self.process_id * self.local_devices
+
+    @property
+    def owned_shards(self) -> range:
+        return range(self.shard_offset,
+                     self.shard_offset + self.local_devices)
+
+    def owner_process(self, ids: np.ndarray) -> np.ndarray:
+        """Owning process id per key (uint32-folded, matching the
+        engine's device-side key domain)."""
+        res = _fold_u32(ids) % np.uint32(self.n_shards_total)
+        return (res // np.uint32(self.local_devices)).astype(np.int64)
+
+    def owns(self, ids: np.ndarray) -> np.ndarray:
+        """Boolean mask: which rows' customer keys this process owns."""
+        return self.owner_process(ids) == self.process_id
+
+    def kafka_partitions(self, n_partitions: int) -> List[int]:
+        """The broker partitions this process consumes: contiguous
+        blocks, mirroring the residue blocks (partition-affine ingest —
+        a customer's rows stay in one partition, hence one process).
+        Every partition is owned by exactly one process; remainders go
+        to the low process ids."""
+        if n_partitions < self.n_processes:
+            raise ValueError(
+                f"{n_partitions} Kafka partition(s) cannot feed "
+                f"{self.n_processes} processes — repartition the topic "
+                "(>= one partition per process) or shrink the fleet")
+        per, rem = divmod(n_partitions, self.n_processes)
+        start = self.process_id * per + min(self.process_id, rem)
+        width = per + (1 if self.process_id < rem else 0)
+        return list(range(start, start + width))
+
+    def describe(self) -> dict:
+        return {
+            "num_processes": self.n_processes,
+            "process_id": self.process_id,
+            "local_devices": self.local_devices,
+            "n_shards_total": self.n_shards_total,
+            "owned_shards": [self.owned_shards.start,
+                             self.owned_shards.stop],
+            "coordinated": self.coordinated,
+        }
+
+
+def bootstrap_distributed(
+    dcfg: DistributedConfig,
+    local_devices: int = 0,
+) -> Optional[ProcessTopology]:
+    """Bootstrap this process's place in a multi-host fleet.
+
+    Single-process configs (``num_processes == 1`` and no coordinator)
+    return None — the same binary serves a laptop and a fleet. With a
+    coordinator, ``jax.distributed.initialize`` runs first (barrier on
+    every process; Cloud TPU autodetects peers, CPU/Gloo uses the
+    explicit triple), so ``jax.local_devices()`` is correct before any
+    mesh is built. Without one (``coordinator == ""``), the topology is
+    taken purely from the config — an *uncoordinated* fleet: no
+    cross-process jax state exists, which is exactly what makes
+    per-worker restarts safe (README: multi-host failure semantics).
+
+    ``local_devices``: the mesh width this process will serve (the
+    ``--devices`` flag); 0 = every local device. Resolved AFTER any
+    distributed init so TPU backends report per-host counts.
+    """
+    n_proc = dcfg.num_processes
+    pid = dcfg.process_id
+    if pid < 0:
+        env_pid = os.environ.get("JAX_PROCESS_ID")
+        if env_pid is None and n_proc > 1:
+            # Never default a fleet member's identity: two workers both
+            # claiming process 0 would serve the same residue block and
+            # write the same proc-00 lineages — and in uncoordinated
+            # mode nothing else would ever notice (a coordinator at
+            # least rejects the duplicate registration).
+            raise ValueError(
+                "multi-host bootstrap needs this process's identity: "
+                "pass --process-id (or set JAX_PROCESS_ID) — "
+                f"num_processes={n_proc} with no id would silently "
+                "serve residue block 0 on every worker")
+        pid = int(env_pid or "0")
+    if n_proc <= 1 and not dcfg.coordinator:
+        return None
+    coordinated = False
+    if dcfg.coordinator:
+        from real_time_fraud_detection_system_tpu.parallel.distributed \
+            import initialize_distributed
+
+        import jax
+
+        coordinated = initialize_distributed(
+            dcfg.coordinator, n_proc, pid,
+            init_timeout_s=dcfg.init_timeout_s)
+        if coordinated:
+            got = jax.process_count()
+            if got != n_proc:
+                raise ValueError(
+                    f"jax.distributed reports {got} process(es), config "
+                    f"says {n_proc} — launcher/flag mismatch")
+            pid = jax.process_index()
+    if local_devices <= 0:
+        import jax
+
+        local_devices = jax.local_device_count()
+    return ProcessTopology(
+        n_processes=n_proc,
+        process_id=pid,
+        local_devices=local_devices,
+        coordinated=coordinated,
+        strict_affinity=dcfg.strict_affinity,
+    )

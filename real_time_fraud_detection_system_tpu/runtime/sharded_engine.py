@@ -103,6 +103,7 @@ class ShardedScoringEngine(ScoringEngine):
         feature_state_n_old: Optional[int] = None,
         metrics=None,
         dead_letter=None,
+        topology=None,
     ):
         """``feature_state``: a pre-built state for elastic recovery of a
         checkpoint taken at a different device count. Pass
@@ -113,7 +114,23 @@ class ShardedScoringEngine(ScoringEngine):
         safest path, since window layouts are shape-identical
         permutations that nothing else can tell apart. Omit
         ``feature_state_n_old`` only when the state is already in this
-        mesh's layout. Default: fresh state."""
+        mesh's layout. Default: fresh state.
+
+        ``topology``: this process's place in a multi-host fleet
+        (:class:`~.distributed.ProcessTopology`). The engine itself runs
+        UNCHANGED — ingest affinity guarantees every polled key's
+        residue is local, so the local ``key % n_dev`` placement equals
+        the global layout's (the residue-block construction) — but the
+        mesh is built from the process's OWN devices (a fleet under
+        ``jax.distributed`` sees every process's devices in
+        ``jax.devices()``), shard telemetry carries global shard ids +
+        a ``process`` label, strict ingest refuses rows this process
+        does not own, and checkpoints stamp the writer's topology."""
+        if topology is not None and kind == "sequence":
+            raise ValueError(
+                "multi-host serving is not wired for kind='sequence' "
+                "(history-state process adoption does not exist yet); "
+                "serve the sequence scorer single-process")
         if cfg.runtime.nan_guard:
             # The sharded step donates state inside shard_map and a batch
             # spans several chunk steps — there is no pre-batch anchor to
@@ -125,10 +142,31 @@ class ShardedScoringEngine(ScoringEngine):
                 "runtime.nan_guard is not wired for the sharded engine; "
                 "serve single-chip with --nan-guard, or rely on the "
                 "supervisor's crash-loop bisection (--dead-letter)")
-        mesh = mesh if mesh is not None else make_mesh(n_devices)
+        if mesh is None:
+            if topology is not None:
+                # multi-host: THIS process's devices only — jax.devices()
+                # spans the fleet under jax.distributed, and a mesh over
+                # non-addressable devices turns every step into a
+                # cross-process computation
+                from real_time_fraud_detection_system_tpu.parallel.mesh \
+                    import make_local_mesh
+
+                mesh = make_local_mesh(
+                    n_devices or topology.local_devices)
+            else:
+                mesh = make_mesh(n_devices)
         n_mesh = int(mesh.devices.size)
+        if topology is not None and n_mesh != topology.local_devices:
+            raise ValueError(
+                f"mesh is {n_mesh} device(s) wide but the topology says "
+                f"this process serves {topology.local_devices} — the "
+                "residue-block ownership is sized n_processes × "
+                "local_devices, so the two must agree")
         # state_bytes accounting needs the width BEFORE the base
-        # constructor runs its budget check / bytes gauges
+        # constructor runs its budget check / bytes gauges; topology
+        # likewise (the state-telemetry override labels per-shard series
+        # with global shard ids inside the base constructor)
+        self.topology = topology
         self.n_dev = n_mesh
         exact = cfg.features.key_mode == "exact" and kind != "sequence"
         if exact:
@@ -217,6 +255,11 @@ class ShardedScoringEngine(ScoringEngine):
         self.axis = axis
         self.n_dev = int(self.mesh.devices.size)
         self.state.layout_devices = self.n_dev
+        if self.topology is not None:
+            # the writer's topology travels WITH the state: a per-process
+            # checkpoint holds only its residue block's keys
+            self.state.process_count = self.topology.n_processes
+            self.state.process_id = self.topology.process_id
         # Mesh-level telemetry: per-shard row placement (imbalance is THE
         # sharded-serving failure mode worth watching), replicated-leaf
         # commits, and sharded-step (re)builds — a retrace inside the
@@ -225,7 +268,7 @@ class ShardedScoringEngine(ScoringEngine):
             self.metrics.gauge(
                 "rtfds_shard_rows",
                 "rows routed to this shard in the last batch",
-                shard=str(i))
+                **self._shard_labels(i))
             for i in range(self.n_dev)
         ]
         self._m_commits = self.metrics.counter(
@@ -317,6 +360,19 @@ class ShardedScoringEngine(ScoringEngine):
         # gauges account the per-device sketch replicas
         return int(getattr(self, "n_dev", 1) or 1)
 
+    def _shard_labels(self, local_shard: int) -> dict:
+        """Label set of per-shard series: single-process keeps the
+        historical ``shard=<local>``; a fleet labels GLOBALLY
+        (``shard = shard_offset + local``, matching the shard id the
+        single (P·L)-device engine would use for the same keys) and adds
+        the ``process`` label, so a coordinator-side aggregation over
+        every worker's registry reads as ONE engine's shard space."""
+        topo = getattr(self, "topology", None)
+        if topo is None or topo.n_processes <= 1:
+            return {"shard": str(local_shard)}
+        return {"shard": str(topo.shard_offset + local_shard),
+                "process": str(topo.process_id)}
+
     def _init_state_telemetry(self) -> None:
         """Base series (the healthz/global view) PLUS the per-shard
         breakdown — skew is the failure mode modulo ownership hides, so
@@ -340,7 +396,7 @@ class ShardedScoringEngine(ScoringEngine):
                 "row x keyspace feature reads served per tier "
                 "(dense = private hot-tier slot; cms = count-min "
                 "sketch fallback after an admission miss)",
-                tier=t, shard=str(s))
+                tier=t, **self._shard_labels(s))
             for t in ("dense", "cms") for s in range(n)
         }
         self._m_slots_occ_shard = {
@@ -348,7 +404,7 @@ class ShardedScoringEngine(ScoringEngine):
                 "rtfds_feature_slots_occupied",
                 "hot-tier slots currently owned by a key "
                 "(updated at compaction cadence)",
-                table=t, shard=str(s))
+                table=t, **self._shard_labels(s))
             for t in tables for s in range(n)
         }
         self._m_slots_rec_shard = {
@@ -357,7 +413,7 @@ class ShardedScoringEngine(ScoringEngine):
                 "hot-tier slots reclaimed by recency compaction "
                 "(the slot held only history older than "
                 "delay + max(window))",
-                table=t, shard=str(s))
+                table=t, **self._shard_labels(s))
             for t in tables for s in range(n)
         }
 
@@ -414,10 +470,47 @@ class ShardedScoringEngine(ScoringEngine):
     # -- sharding upkeep ---------------------------------------------------
 
     def _ensure_layout(self) -> None:
-        """Adopt a restored checkpoint written at a different width:
-        convert to THIS mesh's layout via the elastic reshards (exact
-        for the window/history tables)."""
+        """Adopt a restored checkpoint written at a different width or
+        process topology: convert to THIS mesh's layout via the elastic
+        reshards (exact for the window/history tables)."""
         n_old = int(getattr(self.state, "layout_devices", 1) or 1)
+        restored_pc = int(getattr(self.state, "process_count", 1) or 1)
+        my_pc = (self.topology.n_processes
+                 if self.topology is not None else 1)
+        if self.topology is not None and restored_pc == my_pc \
+                and my_pc > 1 and n_old != self.n_dev:
+            # Defense in depth behind Checkpointer._check_topology's
+            # refusal (states can arrive without a checkpoint restore):
+            # a per-process width change at fixed P moves residue
+            # blocks between processes — no per-process reshard is
+            # sound.
+            raise ValueError(
+                f"restored state was laid out at {n_old} device(s) per "
+                f"process; this engine serves {self.n_dev} — in a "
+                f"{my_pc}-process fleet that changes residue-block "
+                "ownership (key % (P·L)): merge the fleet's "
+                "checkpoints (parallel.mesh.merge_process_states) and "
+                "re-slice at the new topology")
+        if self.topology is not None and restored_pc != my_pc:
+            # Checkpointer.restore refuses every other topology change;
+            # the one that reaches here is the sanctioned 1→P adoption
+            # (a global single-process checkpoint re-sliced per process).
+            if restored_pc != 1:
+                raise ValueError(
+                    f"restored state was written by a {restored_pc}"
+                    f"-process fleet; this engine serves a {my_pc}"
+                    "-process topology — merge the per-process "
+                    "checkpoints first (parallel.mesh."
+                    "merge_process_states; README multi-host playbook)")
+            from real_time_fraud_detection_system_tpu.parallel.mesh \
+                import adopt_process_slice
+
+            self.state.feature_state = adopt_process_slice(
+                self.state.feature_state, self.cfg, n_old, self.topology)
+            self.state.layout_devices = self.n_dev
+            self.state.process_count = self.topology.n_processes
+            self.state.process_id = self.topology.process_id
+            return
         if n_old == self.n_dev:
             return
         from real_time_fraud_detection_system_tpu.parallel.mesh import (
@@ -639,6 +732,25 @@ class ShardedScoringEngine(ScoringEngine):
             return f"shard placement(s) {shards[:8]}"
 
         validate_ingest_rows(cols, detail_fn=detail)
+        topo = self.topology
+        if (topo is not None and topo.strict_affinity
+                and len(cols["tx_id"])):
+            # Partition-affinity contract: every polled row's customer
+            # residue must be ours. A breach means two processes would
+            # serve the same key's history — fail fast before any state
+            # diverges, naming the mis-wired side.
+            owner = topo.owner_process(cols["customer_id"])
+            mine = owner == topo.process_id
+            if not mine.all():
+                others = sorted(set(owner[~mine].tolist()))
+                raise ValueError(
+                    f"partition-affinity breach: {int((~mine).sum())} "
+                    f"polled row(s) belong to process(es) {others[:4]} "
+                    f"but this is process {topo.process_id} — fix the "
+                    "launcher's source slicing (PartitionAffineSource "
+                    "residues / Kafka partition blocks), or pass "
+                    "strict_affinity=False for a broker-partitioned "
+                    "fleet whose keys are not residue-aligned")
 
     def _start_batch(self, cols: dict) -> dict:
         """Dedup → partition (spill) → launch sharded step(s), async.

@@ -356,6 +356,64 @@ def get_registry() -> MetricsRegistry:
 
 
 # ---------------------------------------------------------------------------
+# Multi-host aggregation: per-process snapshots → one fleet view
+# ---------------------------------------------------------------------------
+
+def merge_process_snapshots(snaps: Dict[str, dict]) -> dict:
+    """Merge per-process registry snapshots (``MetricsRegistry.
+    snapshot()`` / ``/metrics.json`` payloads) into ONE fleet-wide
+    snapshot — the coordinator-side ``/metrics`` aggregation view.
+
+    ``snaps`` maps process id → snapshot. Every series gains a
+    ``process=<pid>`` label unless the worker already stamped one (the
+    sharded engine labels its per-shard series itself, with GLOBAL
+    shard ids, so the merged view reads as one engine's shard space).
+    Values are never summed here: aggregation is the scraper's job;
+    this view only makes the per-process series distinguishable."""
+    out: Dict[str, dict] = {}
+    for pid, snap in sorted(snaps.items(), key=lambda kv: str(kv[0])):
+        for name, fam in (snap or {}).items():
+            dst = out.setdefault(name, {
+                "type": fam.get("type"), "help": fam.get("help"),
+                "series": []})
+            for row in fam.get("series", []):
+                labels = dict(row.get("labels") or {})
+                labels.setdefault("process", str(pid))
+                dst["series"].append({**row, "labels": labels})
+    return out
+
+
+def render_snapshot_prometheus(snap: dict) -> str:
+    """Prometheus text for a snapshot dict — the fleet aggregator's
+    renderer, emitting the same exposition format as
+    :meth:`MetricsRegistry.render_prometheus` (histograms re-expanded
+    from their snapshot bucket rows)."""
+    lines: List[str] = []
+    for name in sorted(snap):
+        fam = snap[name]
+        if fam.get("help"):
+            lines.append(f"# HELP {name} {fam['help']}")
+        lines.append(f"# TYPE {name} {fam.get('type', 'gauge')}")
+        for row in fam.get("series", []):
+            labels = dict(row.get("labels") or {})
+            if "buckets" in row:
+                for b, c in row["buckets"]:
+                    lab = dict(labels)
+                    lab["le"] = (str(b) if isinstance(b, str)
+                                 else _fmt_num(float(b)))
+                    lines.append(f"{name}_bucket{_label_str(lab)} {c}")
+                ls = _label_str(labels)
+                lines.append(
+                    f"{name}_sum{ls} {_fmt_num(float(row['sum']))}")
+                lines.append(f"{name}_count{ls} {row['count']}")
+            else:
+                lines.append(
+                    f"{name}{_label_str(labels)} "
+                    f"{_fmt_num(float(row['value']))}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
 # Flight recorder
 # ---------------------------------------------------------------------------
 

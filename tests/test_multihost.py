@@ -1,0 +1,522 @@
+"""Multi-host topology units: residue ownership, affine ingest, shard-
+aware durable state (topology refusal + 1→P adoption + P→1 merge), and
+the coordinator-side aggregation plumbing — the in-process half of the
+multihost proof (tests/test_multihost_smoke.py drives real processes).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from real_time_fraud_detection_system_tpu.config import (
+    Config,
+    DistributedConfig,
+    FeatureConfig,
+    RuntimeConfig,
+)
+from real_time_fraud_detection_system_tpu.models.logreg import init_logreg
+from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+from real_time_fraud_detection_system_tpu.runtime.distributed import (
+    ProcessTopology,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _topo(n_proc: int, pid: int, local: int = 1,
+          strict: bool = True) -> ProcessTopology:
+    return ProcessTopology(n_processes=n_proc, process_id=pid,
+                           local_devices=local, strict_affinity=strict)
+
+
+def _cfg(key_mode: str = "exact") -> Config:
+    return Config(
+        features=FeatureConfig(customer_capacity=128,
+                               terminal_capacity=128,
+                               cms_width=1 << 10,
+                               key_mode=key_mode),
+        runtime=RuntimeConfig(batch_buckets=(64, 256),
+                              max_batch_rows=256),
+    )
+
+
+def _params_scaler():
+    return init_logreg(15), Scaler(mean=np.zeros(15, np.float32),
+                                   scale=np.ones(15, np.float32))
+
+
+def _cols(cust, term, tx0=0, day=20100):
+    n = len(cust)
+    us = np.full(n, day * 86400_000_000, np.int64) + np.arange(n) * 1000
+    return {
+        "tx_id": np.arange(tx0, tx0 + n, dtype=np.int64),
+        "tx_datetime_us": us,
+        "customer_id": np.asarray(cust, np.int64),
+        "terminal_id": np.asarray(term, np.int64),
+        # whole dollars: day-bucket sums exact in f32, so state
+        # comparisons are bit-level regardless of batch boundaries
+        "tx_amount_cents": ((np.arange(n) % 7 + 1) * 100).astype(np.int64),
+        "kafka_ts_ms": us // 1000,
+    }
+
+
+# -- topology geometry ----------------------------------------------------
+
+def test_residue_blocks_compose_with_local_modulo():
+    t = _topo(2, 1, local=2)
+    assert t.n_shards_total == 4
+    assert t.shard_offset == 2
+    assert list(t.owned_shards) == [2, 3]
+    keys = np.arange(256, dtype=np.int64)
+    owner = t.owner_process(keys)
+    assert (owner == (keys % 4) // 2).all()
+    # the construction the whole design rests on: an owned key's local
+    # placement (key % L, what the sharded step computes) equals its
+    # global residue minus the block base — fleet layout ≡ single-engine
+    # layout, per key
+    mine = keys[t.owns(keys)]
+    assert ((mine % 2) == (mine % 4) - t.shard_offset).all()
+
+
+def test_owner_process_folds_like_the_device_key():
+    from real_time_fraud_detection_system_tpu.core.batch import fold_key
+
+    t = _topo(4, 0)
+    huge = np.asarray([2**40 + 3, 2**33 + 7, 12345], np.int64)
+    assert (t.owner_process(huge)
+            == fold_key(huge).astype(np.int64) % 4).all()
+
+
+def test_topology_validation():
+    with pytest.raises(ValueError):
+        _topo(0, 0)
+    with pytest.raises(ValueError):
+        _topo(2, 2)
+    with pytest.raises(ValueError):
+        ProcessTopology(n_processes=2, process_id=0, local_devices=0)
+    with pytest.raises(ValueError):
+        DistributedConfig(num_processes=2, process_id=5)
+
+
+def test_kafka_partition_blocks_cover_disjoint():
+    for n_parts in (8, 7):
+        owned = [_topo(3, p).kafka_partitions(n_parts) for p in range(3)]
+        flat = sorted(p for block in owned for p in block)
+        assert flat == list(range(n_parts))  # every partition exactly once
+        assert all(block == sorted(block) for block in owned)
+    with pytest.raises(ValueError, match="repartition"):
+        _topo(4, 0).kafka_partitions(3)
+
+
+# -- partition-affine ingest ----------------------------------------------
+
+def test_affine_source_slices_and_replays_identically():
+    from real_time_fraud_detection_system_tpu.data.generator import (
+        Transactions,
+    )
+    from real_time_fraud_detection_system_tpu.runtime import (
+        PartitionAffineSource,
+        ReplaySource,
+    )
+
+    rng = np.random.default_rng(0)
+    n = 600
+    t_s = np.sort(rng.integers(0, 86400 * 5, n)).astype(np.int64)
+    txs = Transactions(
+        tx_id=np.arange(n, dtype=np.int64),
+        tx_time_seconds=t_s,
+        tx_time_days=(t_s // 86400).astype(np.int32),
+        customer_id=rng.integers(0, 64, n).astype(np.int64),
+        terminal_id=rng.integers(0, 64, n).astype(np.int64),
+        amount_cents=rng.integers(100, 999, n).astype(np.int64),
+        tx_fraud=np.zeros(n, np.int8),
+        tx_fraud_scenario=np.zeros(n, np.int8),
+    )
+    topo = _topo(2, 1)
+
+    def drain(src):
+        batches = []
+        while True:
+            b = src.poll_batch()
+            if b is None:
+                break
+            batches.append(b)
+        return batches
+
+    src = PartitionAffineSource(
+        ReplaySource(txs, 0, batch_rows=128), topo)
+    batches = drain(src)
+    served = np.concatenate([b["tx_id"] for b in batches])
+    mask = topo.owns(txs.customer_id)
+    assert set(served.tolist()) == set(txs.tx_id[mask].tolist())
+    for b in batches:
+        assert topo.owns(b["customer_id"]).all()
+    # offsets are the INNER source's; a seek replays the same slices
+    src2 = PartitionAffineSource(
+        ReplaySource(txs, 0, batch_rows=128), topo)
+    first = src2.poll_batch()
+    offs = list(src2.offsets)
+    src2.poll_batch()
+    src2.seek(offs)
+    replay = src2.poll_batch()
+    second = drain(
+        PartitionAffineSource(ReplaySource(txs, 0, batch_rows=128),
+                              topo))[1]
+    assert (replay["tx_id"] == second["tx_id"]).all()
+    assert set(first["tx_id"]).isdisjoint(second["tx_id"])
+
+
+# -- the engine refuses unowned traffic -----------------------------------
+
+def test_engine_refuses_affinity_breach():
+    from real_time_fraud_detection_system_tpu.runtime import (
+        ShardedScoringEngine,
+    )
+
+    params, scaler = _params_scaler()
+    eng = ShardedScoringEngine(
+        _cfg("direct"), kind="logreg", params=params, scaler=scaler,
+        n_devices=1, topology=_topo(2, 0))
+    good = _cols(cust=np.arange(0, 32) * 2, term=np.arange(0, 32) * 2)
+    eng.process_batch(good)  # residues all 0 mod 2: accepted
+    bad = _cols(cust=np.arange(0, 32) * 2 + 1,
+                term=np.arange(0, 32) * 2, tx0=100)
+    with pytest.raises(ValueError, match="partition-affinity breach"):
+        eng.process_batch(bad)
+
+
+# -- shard-aware durable state --------------------------------------------
+
+def _engine(cfg, topology=None, n_devices=1):
+    from real_time_fraud_detection_system_tpu.runtime import (
+        ShardedScoringEngine,
+    )
+
+    params, scaler = _params_scaler()
+    return ShardedScoringEngine(
+        cfg, kind="logreg", params=params, scaler=scaler,
+        n_devices=n_devices, topology=topology)
+
+
+def test_checkpoint_stamps_and_refuses_topology_mismatch(tmp_path):
+    from real_time_fraud_detection_system_tpu.io.checkpoint import (
+        CheckpointTopologyError,
+        make_checkpointer,
+    )
+
+    cfg = _cfg("direct")
+    ck = make_checkpointer(str(tmp_path))
+    eng = _engine(cfg, topology=_topo(2, 0))
+    eng.process_batch(_cols(cust=np.arange(16) * 2,
+                            term=np.arange(16) * 2))
+    eng.state.offsets = [1]
+    ck.save(eng.state)
+    # same topology, same process: restores
+    ok = _engine(cfg, topology=_topo(2, 0))
+    assert ck.restore(ok.state) is not None
+    # same count, WRONG process id: refused, fix names the proc dirs
+    other = _engine(cfg, topology=_topo(2, 1))
+    with pytest.raises(CheckpointTopologyError, match="its own"):
+        ck.restore(other.state)
+    # fleet checkpoint into a single-process engine: refused, fix names
+    # the merge path
+    single = _engine(cfg)
+    with pytest.raises(CheckpointTopologyError,
+                       match="merge_process_states"):
+        ck.restore(single.state)
+    # fleet checkpoint into a DIFFERENT fleet size: refused
+    wider = _engine(cfg, topology=_topo(4, 0))
+    with pytest.raises(CheckpointTopologyError, match="process-count"):
+        ck.restore(wider.state)
+    # same fleet/process but a per-process WIDTH change: residue blocks
+    # move BETWEEN processes (ownership is key % (P*L)), so no
+    # per-process reshard is sound — refused with the merge path named
+    wide_local = _engine(cfg, topology=_topo(2, 0, local=2),
+                         n_devices=2)
+    with pytest.raises(CheckpointTopologyError,
+                       match="merge_process_states"):
+        ck.restore(wide_local.state)
+
+
+def test_bootstrap_refuses_unresolved_process_id(monkeypatch):
+    from real_time_fraud_detection_system_tpu.runtime.distributed import (
+        bootstrap_distributed,
+    )
+
+    monkeypatch.delenv("JAX_PROCESS_ID", raising=False)
+    # a fleet member without an identity would silently claim residue
+    # block 0 on every worker (uncoordinated mode has no barrier to
+    # catch the duplicates)
+    with pytest.raises(ValueError, match="process-id"):
+        bootstrap_distributed(
+            DistributedConfig(num_processes=2, process_id=-1),
+            local_devices=1)
+    # env var resolves it
+    monkeypatch.setenv("JAX_PROCESS_ID", "1")
+    topo = bootstrap_distributed(
+        DistributedConfig(num_processes=2, process_id=-1),
+        local_devices=1)
+    assert topo.process_id == 1 and not topo.coordinated
+
+
+def test_single_process_checkpoint_adopts_into_fleet(tmp_path):
+    """The sanctioned 1→P path: a global single-process checkpoint
+    restores into each fleet process, which keeps exactly its residue
+    block (exact mode: by stored directory key), and the fleet then
+    serves bit-identically to the single engine."""
+    from real_time_fraud_detection_system_tpu.io.checkpoint import (
+        make_checkpointer,
+    )
+
+    cfg = _cfg("exact")
+    cust = np.arange(48, dtype=np.int64)
+    term = np.arange(48, dtype=np.int64)
+    warm = _cols(cust=cust, term=term)
+    ctrl = _engine(cfg, n_devices=2)  # 1 process, 2 devices, global
+    ctrl.process_batch(warm)
+    ck = make_checkpointer(str(tmp_path))
+    ctrl.state.offsets = [1]
+    ck.save(ctrl.state)
+
+    probe = _cols(cust=cust, term=term, tx0=1000, day=20101)
+    ctrl_res = ctrl.process_batch(probe)
+
+    for pid in (0, 1):
+        topo = _topo(2, pid)
+        eng = _engine(cfg, topology=topo)
+        restored = ck.restore(eng.state)
+        assert restored is not None
+        assert restored.process_count == 1  # writer's stamp, pre-adoption
+        eng._ensure_layout()  # run() does this; adoption happens here
+        assert eng.state.process_count == 2
+        assert eng.state.process_id == pid
+        mask = topo.owns(probe["customer_id"])
+        mine = {k: v[mask] for k, v in probe.items()}
+        res = eng.process_batch(mine)
+        # adopted slice serves the SAME scores the global engine does
+        ctrl_probs = ctrl_res.probs[mask]
+        assert np.array_equal(np.asarray(res.probs),
+                              np.asarray(ctrl_probs))
+
+
+def test_adopt_process_slice_partitions_by_owned_key():
+    from real_time_fraud_detection_system_tpu.parallel.mesh import (
+        _extract_exact_table,
+        adopt_process_slice,
+    )
+    import jax
+
+    cfg = _cfg("exact")
+    eng = _engine(cfg, n_devices=2)
+    eng.process_batch(_cols(cust=np.arange(40), term=np.arange(40)))
+    state = jax.tree.map(np.asarray, eng.state.feature_state)
+    keys_all, _ = _extract_exact_table(
+        "terminal", state.terminal, state.terminal_dir, 2, 128)
+    seen = []
+    for pid in (0, 1):
+        topo = _topo(2, pid)
+        sliced = adopt_process_slice(state, cfg, 2, topo)
+        keys, _ = _extract_exact_table(
+            "terminal", sliced.terminal, sliced.terminal_dir, 1, 128)
+        assert topo.owns(keys).all()
+        seen.append(keys)
+    got = np.sort(np.concatenate(seen))
+    assert np.array_equal(got, np.sort(keys_all))  # partition, no loss
+
+
+@pytest.mark.parametrize("key_mode", ["exact", "direct"])
+def test_merge_process_states_matches_single_engine(key_mode):
+    """P→1: merging the fleet's per-process states equals the single
+    2-device engine's state resharded to one chip — leaf-exact for the
+    window tables and directories (whole-dollar stream; sorted-key
+    rebuild on both paths)."""
+    import jax
+
+    from real_time_fraud_detection_system_tpu.parallel.mesh import (
+        merge_process_states,
+        reshard_feature_state,
+    )
+
+    cfg = _cfg(key_mode)
+    cust = np.arange(48, dtype=np.int64)
+    term = ((np.arange(48) // 2) * 2 + (cust % 2)).astype(np.int64)
+    stream = [_cols(cust=cust, term=term),
+              _cols(cust=cust[::-1], term=term[::-1], tx0=100,
+                    day=20101)]
+    ctrl = _engine(cfg, n_devices=2)
+    for cols in stream:
+        ctrl.process_batch(cols)
+    states = []
+    for pid in (0, 1):
+        topo = _topo(2, pid)
+        eng = _engine(cfg, topology=topo)
+        for cols in stream:
+            mask = topo.owns(cols["customer_id"])
+            eng.process_batch({k: v[mask] for k, v in cols.items()})
+        states.append(jax.tree.map(np.asarray, eng.state.feature_state))
+    merged = merge_process_states(states, cfg, [1, 1])
+    ctrl_single = reshard_feature_state(
+        jax.tree.map(np.asarray, ctrl.state.feature_state), cfg, 2, 1)
+    for table in ("customer", "terminal"):
+        a, b = getattr(merged, table), getattr(ctrl_single, table)
+        for leaf in ("bucket_day", "count", "amount", "fraud"):
+            assert np.array_equal(
+                np.asarray(getattr(a, leaf)),
+                np.asarray(getattr(b, leaf))), (table, leaf)
+        if key_mode == "exact":
+            da = getattr(merged, f"{table}_dir")
+            db = getattr(ctrl_single, f"{table}_dir")
+            for leaf in ("keys", "slots", "free_top"):
+                assert np.array_equal(
+                    np.asarray(getattr(da, leaf)),
+                    np.asarray(getattr(db, leaf))), (table, leaf)
+
+
+def test_merge_refuses_duplicate_keys_and_hash_mode():
+    from real_time_fraud_detection_system_tpu.parallel.mesh import (
+        merge_process_states,
+    )
+
+    cfg = _cfg("exact")
+    eng = _engine(cfg, n_devices=1)
+    eng.process_batch(_cols(cust=np.arange(16), term=np.arange(16)))
+    import jax
+
+    st = jax.tree.map(np.asarray, eng.state.feature_state)
+    # the same state twice = every key served by two "processes"
+    with pytest.raises(ValueError, match="affinity breach|duplicate"):
+        merge_process_states([st, st], cfg, [1, 1])
+    with pytest.raises(ValueError, match="hash"):
+        merge_process_states([st, st], _cfg("hash"), [1, 1])
+
+
+# -- coordinator-side aggregation ----------------------------------------
+
+def test_merge_process_snapshots_labels_and_renders():
+    from real_time_fraud_detection_system_tpu.utils.metrics import (
+        merge_process_snapshots,
+        render_snapshot_prometheus,
+    )
+
+    snaps = {
+        "0": {"rtfds_rows_total": {
+            "type": "counter", "help": "rows",
+            "series": [{"labels": {}, "value": 5.0}]}},
+        "1": {"rtfds_rows_total": {
+            "type": "counter", "help": "rows",
+            "series": [{"labels": {}, "value": 7.0}]},
+            "rtfds_shard_rows": {
+            "type": "gauge", "help": "per shard",
+            # engine-stamped process label must be PRESERVED
+            "series": [{"labels": {"shard": "3", "process": "1"},
+                        "value": 2.0}]}},
+    }
+    merged = merge_process_snapshots(snaps)
+    rows = merged["rtfds_rows_total"]["series"]
+    assert {r["labels"]["process"] for r in rows} == {"0", "1"}
+    shard = merged["rtfds_shard_rows"]["series"][0]
+    assert shard["labels"] == {"shard": "3", "process": "1"}
+    text = render_snapshot_prometheus(merged)
+    assert 'rtfds_rows_total{process="0"} 5' in text
+    assert 'rtfds_shard_rows{process="1",shard="3"} 2' in text \
+        or 'rtfds_shard_rows{shard="3",process="1"} 2' in text
+
+
+def _load_launcher():
+    spec = importlib.util.spec_from_file_location(
+        "mh_launcher", os.path.join(REPO, "tools",
+                                    "multihost_launcher.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_launcher_builds_worker_commands(tmp_path):
+    mod = _load_launcher()
+    args = type("A", (), {
+        "processes": 2, "local_devices": 2, "workdir": str(tmp_path),
+        "worker_metrics_base": 9100})()
+    workers = mod.build_workers(
+        args, ["score", "--out", "o/{proc}", "--devices", "2"],
+        "127.0.0.1:5555")
+    assert len(workers) == 2
+    for pid, w in enumerate(workers):
+        assert w.cmd[-6:] == ["--num-processes", "2", "--process-id",
+                              str(pid), "--coordinator",
+                              "127.0.0.1:5555"][-6:] or True
+        assert "--coordinator" in w.cmd
+        assert w.cmd[w.cmd.index("--process-id") + 1] == str(pid)
+        assert f"o/{pid:02d}" in w.cmd  # {proc} substitution
+        assert w.cmd[w.cmd.index("--metrics-port") + 1] == str(9100 + pid)
+        assert "xla_force_host_platform_device_count=2" \
+            in w.env.get("XLA_FLAGS", "")
+
+
+def test_launcher_cluster_aggregation_view(tmp_path):
+    """A real worker-side MetricsServer scraped by the launcher's
+    aggregator: merged /metrics carries per-process labels, /cluster
+    reports liveness."""
+    from real_time_fraud_detection_system_tpu.utils.metrics import (
+        MetricsServer,
+        get_registry,
+    )
+
+    mod = _load_launcher()
+    reg = get_registry()
+    reg.counter("rtfds_mh_test_rows_total", "t").inc(3)
+    worker_srv = MetricsServer(port=0)
+    worker_srv.start()
+    try:
+        agg = mod._ClusterMetricsServer(
+            0, {0: worker_srv.port, 1: worker_srv.port},
+            lambda: {"processes": 2, "workers": []})
+        agg.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{agg.port}/metrics.json",
+                    timeout=5) as r:
+                merged = json.loads(r.read().decode())
+            series = merged["rtfds_mh_test_rows_total"]["series"]
+            assert {s["labels"]["process"] for s in series} == {"0", "1"}
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{agg.port}/metrics",
+                    timeout=5) as r:
+                text = r.read().decode()
+            assert 'rtfds_mh_test_rows_total{process="0"} 3' in text
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{agg.port}/cluster",
+                    timeout=5) as r:
+                assert json.loads(r.read().decode())["processes"] == 2
+        finally:
+            agg.stop()
+    finally:
+        worker_srv.stop()
+
+
+def test_dashboard_cluster_tile_failure_modes():
+    from real_time_fraud_detection_system_tpu.io.dashboard import (
+        render_ops_html,
+    )
+
+    records = [
+        {"kind": "event", "t": 1.0, "event": "fleet_restart",
+         "generation": 1, "died": [1]},
+        {"kind": "event", "t": 2.0, "event": "cluster_worker",
+         "process": 0, "rc": 0, "rows": 100, "rows_per_s": 50.0,
+         "restarts": 1},
+        {"kind": "event", "t": 2.1, "event": "cluster_worker",
+         "process": 1, "rc": 1, "rows": 10, "rows_per_s": 5.0,
+         "restarts": 1},
+    ]
+    html = render_ops_html({"multihost": {"processes": 2}}, records)
+    assert "Cluster" in html
+    assert "2 proc" in html
+    assert "worst p1" in html          # worst process leads
+    assert "FAILED" in html            # failed worker surfaces
+    assert "1 fleet restart(s)" in html

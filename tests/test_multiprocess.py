@@ -108,6 +108,142 @@ def mp_env():
     return port
 
 
+_BOOT_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("XLA_FLAGS", None)  # 1 local device per process
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    pid, port = int(sys.argv[1]), sys.argv[2]
+
+    from real_time_fraud_detection_system_tpu.config import (
+        DistributedConfig,
+    )
+    from real_time_fraud_detection_system_tpu.runtime.distributed import (
+        bootstrap_distributed,
+    )
+
+    topo = bootstrap_distributed(
+        DistributedConfig(coordinator=f"127.0.0.1:{port}",
+                          num_processes=2, process_id=pid),
+        local_devices=1)
+    assert topo is not None and topo.coordinated, topo
+    assert jax.process_count() == 2, jax.process_count()
+    assert topo.n_shards_total == 2
+    assert list(topo.owned_shards) == [pid]
+
+    # Local-mesh serving computation under the REAL distributed runtime:
+    # this is what the partitioned multi-host deployment executes, and
+    # it must work on EVERY backend (no capability involved) — a hard
+    # assertion, never a skip.
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from real_time_fraud_detection_system_tpu.parallel.mesh import (
+        compat_shard_map,
+        make_local_mesh,
+    )
+
+    mesh = make_local_mesh(1)
+    assert int(mesh.devices.size) == 1
+    f = jax.jit(compat_shard_map(
+        lambda x: x * 2 + pid, mesh, P("data"), P("data")))
+    y = f(jnp.arange(8.0))
+    assert float(y.sum()) == 2 * 28 + 8 * pid, y
+    print(f"BOOTOK {pid}", flush=True)
+
+    # The process-SPANNING mesh: cross-process collectives — the one
+    # leg that is a backend capability. Probe first; refusal prints the
+    # precise MPSKIP sentinel, support runs a REAL global computation.
+    from real_time_fraud_detection_system_tpu.parallel.mesh import (
+        cross_process_collectives_supported,
+        make_process_mesh,
+    )
+
+    pmesh = make_process_mesh()
+    assert int(pmesh.devices.size) == 2
+    assert [d.process_index for d in pmesh.devices.flat] == [0, 1]
+    err = cross_process_collectives_supported(pmesh)
+    if err is not None:
+        print("MPSKIP " + err[:200], flush=True)
+        sys.exit(0)
+    from jax.sharding import NamedSharding
+    out = jax.jit(
+        lambda: jnp.ones((2,), jnp.float32) * (pid + 1),
+        out_shardings=NamedSharding(pmesh, P("data")))()
+    total = float(jnp.sum(out))  # cross-process reduction
+    print(f"SPANOK {pid} {total}", flush=True)
+""")
+
+
+@pytest.fixture(scope="module")
+def boot_run(tmp_path_factory):
+    """ONE 2-process distributed-bootstrap run shared by the promoted
+    tests below (worker launches cost seconds; the two halves assert
+    different contracts over the same run). Probes its own port/spawn
+    capability (module-scoped; ``mp_env`` stays function-scoped for the
+    TP test)."""
+    try:
+        port = str(_free_port())
+    except OSError as e:
+        pytest.skip(f"cannot bind a loopback port for the coordinator: {e}")
+    try:
+        p = subprocess.run([sys.executable, "-c", "print('spawn-ok')"],
+                           capture_output=True, text=True, timeout=60)
+        assert "spawn-ok" in p.stdout
+    except Exception as e:  # noqa: BLE001 — any spawn failure is a skip
+        pytest.skip(f"cannot spawn worker subprocesses: {e}")
+    worker = tmp_path_factory.mktemp("mp") / "boot_worker.py"
+    worker.write_text(_BOOT_WORKER)
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(pid), port],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=repo, env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process worker timed out")
+        outs.append(out)
+    return procs, outs
+
+
+def test_two_process_distributed_bootstrap_and_local_serving(boot_run):
+    """The promoted half that runs — and must PASS — on EVERY backend:
+    2 real processes, a real jax.distributed coordination barrier, the
+    ProcessTopology contract, and a local-mesh shard_map serving
+    computation under the distributed runtime. No capability skip
+    exists on this path: the partitioned multi-host deployment needs
+    nothing more, so a failure here is a real regression."""
+    procs, outs = boot_run
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} rc={p.returncode}:\n{out}"
+        assert f"BOOTOK {pid}" in out, out
+
+
+def test_two_process_spanning_mesh_collective(boot_run):
+    """The collective leg: a REAL cross-process reduction over the
+    process-spanning mesh where jaxlib's CPU collectives support it;
+    the precise MPSKIP sentinel otherwise (bootstrap/local-serving
+    failures still fail in the test above — never a vacuous pass)."""
+    _, outs = boot_run
+    skips = [ln for out in outs for ln in out.splitlines()
+             if ln.startswith("MPSKIP")]
+    if skips:
+        pytest.skip(skips[0][len("MPSKIP "):])
+    for pid, out in enumerate(outs):
+        assert f"SPANOK {pid}" in out, out
+
+
 def test_two_process_tp_step(tmp_path, mp_env):
     worker = tmp_path / "worker.py"
     worker.write_text(_WORKER)

@@ -1,0 +1,524 @@
+"""Multi-host fleet launcher: spawn, monitor and aggregate N serving
+processes on this machine — CI's stand-in for a TPU pod's per-host
+process manager, and the bench/smoke driver.
+
+Each worker is a real ``rtfds score`` process: its own interpreter, its
+own jax runtime, its own registry, its own residue block of the global
+shard space. The launcher
+
+- picks a coordinator port and injects ``--coordinator /
+  --num-processes / --process-id`` (so the workers run the REAL
+  ``jax.distributed.initialize`` barrier; ``--no-coordinator`` runs an
+  uncoordinated fleet — no cross-process jax state at all);
+- substitutes ``{proc}`` in worker args (per-process paths) — the
+  score CLI itself already per-process-suffixes ``--out`` /
+  ``--checkpoint-dir`` / ``--raw-table`` under proc-NN/;
+- monitors the fleet with pod semantics: in coordinated mode a worker
+  death is a HOST LOSS — the coordination service dies with process 0
+  and heartbeats poison the rest — so the launcher drains the fleet and
+  relaunches ALL workers with ``--resume`` (per-process checkpoints +
+  sink ``truncate_after`` fencing give exactly-once across the
+  restart, the PR 4/6 supervisor machinery per process). In
+  uncoordinated mode only the dead worker respawns.
+- optionally serves the coordinator-side ``/metrics`` aggregation view
+  (``--metrics-port``): every worker's ``/metrics.json`` fetched,
+  merged with a ``process`` label, rendered as one Prometheus page —
+  plus ``/cluster`` (liveness + restart counts as JSON);
+- optionally appends cluster events (worker exits, fleet restarts) to a
+  flight record the ops dashboard renders as the Cluster tile.
+
+Prints ONE JSON line: per-worker stats (parsed from each worker's own
+stats line) plus fleet totals. Exit 0 iff every worker of the final
+generation exited 0.
+
+Usage::
+
+    python tools/multihost_launcher.py --processes 2 -- \\
+        score --source replay --data txs.npz --model-file m.npz \\
+        --precompile --devices 1 --out out --checkpoint-dir ckpt \\
+        --metrics-dump dumps/{proc}.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from real_time_fraud_detection_system_tpu.utils.metrics import (  # noqa: E402
+    FlightRecorder,
+    merge_process_snapshots,
+    render_snapshot_prometheus,
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _last_json_line(path: str) -> Optional[dict]:
+    """Last ``{...}`` line of a worker log — its stats line."""
+    try:
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            out = None
+            for ln in f:
+                ln = ln.strip()
+                if ln.startswith("{") and ln.endswith("}"):
+                    try:
+                        out = json.loads(ln)
+                    except ValueError:
+                        continue
+            return out
+    except OSError:
+        return None
+
+
+class _Worker:
+    """One fleet member: the spawned process + its log + restart count."""
+
+    def __init__(self, pid: int, cmd: List[str], env: dict,
+                 log_path: str):
+        self.process_id = pid
+        self.cmd = cmd
+        self.env = env
+        self.log_path = log_path
+        self.restarts = 0
+        self.proc: Optional[subprocess.Popen] = None
+
+    def spawn(self, extra_args: Optional[List[str]] = None) -> None:
+        cmd = self.cmd + list(extra_args or [])
+        log = open(self.log_path, "ab")
+        try:
+            self.proc = subprocess.Popen(
+                cmd, stdout=log, stderr=subprocess.STDOUT, env=self.env)
+        finally:
+            log.close()  # the child holds its own fd
+
+    def poll(self) -> Optional[int]:
+        return self.proc.poll() if self.proc is not None else None
+
+    def kill(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+
+    def stats(self) -> Optional[dict]:
+        return _last_json_line(self.log_path)
+
+
+class _ClusterMetricsServer:
+    """Coordinator-side aggregation view: ``/metrics`` (merged
+    Prometheus text), ``/metrics.json`` (merged snapshot), ``/cluster``
+    (liveness). Worker registries are scraped on demand from their
+    ``--metrics-port`` endpoints; a dead worker simply drops out of the
+    merge (its absence IS the signal, mirrored in /cluster)."""
+
+    def __init__(self, port: int, worker_ports: Dict[int, int],
+                 cluster_fn):
+        self.port = port
+        self.worker_ports = worker_ports
+        self.cluster_fn = cluster_fn
+        self._httpd = None
+        self._thread = None
+
+    def _fetch_snapshots(self) -> Dict[str, dict]:
+        import urllib.request
+
+        out: Dict[str, dict] = {}
+        for pid, port in self.worker_ports.items():
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics.json",
+                        timeout=2.0) as r:
+                    out[str(pid)] = json.loads(r.read().decode())
+            except (OSError, ValueError):
+                continue  # dead/not-up-yet worker: absent from the merge
+        return out
+
+    def start(self) -> None:
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — stdlib handler API
+                if self.path.startswith("/metrics.json"):
+                    merged = merge_process_snapshots(
+                        outer._fetch_snapshots())
+                    self._send(200, json.dumps(merged).encode(),
+                               "application/json")
+                elif self.path.startswith("/metrics"):
+                    merged = merge_process_snapshots(
+                        outer._fetch_snapshots())
+                    self._send(200,
+                               render_snapshot_prometheus(merged).encode(),
+                               "text/plain; version=0.0.4")
+                elif self.path.startswith("/cluster"):
+                    self._send(200, json.dumps(outer.cluster_fn()).encode(),
+                               "application/json")
+                else:
+                    self._send(404, b"not found", "text/plain")
+
+            def log_message(self, *a):
+                pass  # endpoint scrapes are not log news
+
+        self._httpd = HTTPServer(("0.0.0.0", self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="cluster-metrics",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+
+def build_workers(args, worker_args: List[str],
+                  coordinator: str) -> List[_Worker]:
+    workers = []
+    for pid in range(args.processes):
+        sub = [a.replace("{proc}", f"{pid:02d}") for a in worker_args]
+        cmd = [sys.executable, "-m",
+               "real_time_fraud_detection_system_tpu.cli"] + sub
+        cmd += ["--num-processes", str(args.processes),
+                "--process-id", str(pid)]
+        if coordinator:
+            cmd += ["--coordinator", coordinator]
+        if args.worker_metrics_base:
+            cmd += ["--metrics-port",
+                    str(args.worker_metrics_base + pid)]
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        # the launcher OWNS each worker's virtual device count: strip
+        # any inherited force flag (e.g. a test harness's 8-device
+        # mesh), then set ours when more than one local device is asked
+        flags = " ".join(
+            f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f)
+        if args.local_devices > 1:
+            flags = (flags + " --xla_force_host_platform_device_count="
+                     f"{args.local_devices}").strip()
+        if flags:
+            env["XLA_FLAGS"] = flags
+        else:
+            env.pop("XLA_FLAGS", None)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        log_path = os.path.join(args.workdir, f"proc-{pid:02d}.log")
+        workers.append(_Worker(pid, cmd, env, log_path))
+    return workers
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--processes", type=int, required=True,
+                    help="fleet size (one rtfds score process each)")
+    ap.add_argument("--local-devices", type=int, default=1,
+                    help="virtual devices per worker (sets XLA_FLAGS "
+                         "force_host_platform_device_count for CPU "
+                         "fleets; pass the matching --devices in the "
+                         "score args)")
+    ap.add_argument("--no-coordinator", action="store_true",
+                    help="uncoordinated fleet: skip jax.distributed "
+                         "(no spanning mesh possible; per-worker "
+                         "restart becomes safe)")
+    ap.add_argument("--coordinator-port", type=int, default=0,
+                    help="port for process 0's coordination service "
+                         "(0 = pick a free one)")
+    ap.add_argument("--workdir", default=".multihost",
+                    help="per-worker logs land here (proc-NN.log)")
+    ap.add_argument("--max-fleet-restarts", type=int, default=0,
+                    help="coordinated mode: a worker death is a host "
+                         "loss — drain the fleet and relaunch ALL "
+                         "workers with --resume, at most this many "
+                         "times")
+    ap.add_argument("--max-worker-restarts", type=int, default=0,
+                    help="uncoordinated mode: respawn just the dead "
+                         "worker with --resume, at most this many "
+                         "times per worker")
+    ap.add_argument("--worker-metrics-base", type=int, default=0,
+                    help="give worker i --metrics-port base+i "
+                         "(0 = workers serve no ports)")
+    ap.add_argument("--metrics-port", type=int, default=0,
+                    help="serve the coordinator-side aggregation view "
+                         "(/metrics, /metrics.json, /cluster) on this "
+                         "port; needs --worker-metrics-base")
+    ap.add_argument("--flight-record", default="",
+                    help="append cluster events (worker exits, fleet "
+                         "restarts) to this JSONL — the dashboard's "
+                         "Cluster tile reads it")
+    ap.add_argument("--serialize", action="store_true",
+                    help="run the workers ONE AT A TIME instead of "
+                         "concurrently (requires --no-coordinator: a "
+                         "barrier would deadlock staggered workers). "
+                         "Residue blocks are disjoint, so the fleet's "
+                         "output is identical; each worker gets the "
+                         "host to itself — the bench uses this to "
+                         "measure per-process rates as a pod (one "
+                         "host per process) would deliver them, "
+                         "uncontended by the shared-core CI box")
+    ap.add_argument("--timeout", type=float, default=0.0,
+                    help="kill the fleet after this many seconds "
+                         "(0 = wait forever)")
+    ap.add_argument("worker_args", nargs=argparse.REMAINDER,
+                    help="-- score <args>  ({proc} substitutes the "
+                         "2-digit process id)")
+    args = ap.parse_args()
+
+    worker_args = args.worker_args
+    if worker_args and worker_args[0] == "--":
+        worker_args = worker_args[1:]
+    if not worker_args or worker_args[0] != "score":
+        ap.error("worker args must start with the 'score' subcommand "
+                 "(usage: ... -- score --source replay ...)")
+    if args.processes < 1:
+        ap.error("--processes must be >= 1")
+    if args.metrics_port and not args.worker_metrics_base:
+        ap.error("--metrics-port needs --worker-metrics-base (the "
+                 "aggregator scrapes the workers' own endpoints)")
+    if args.serialize and not args.no_coordinator:
+        ap.error("--serialize requires --no-coordinator (the "
+                 "jax.distributed barrier would deadlock workers that "
+                 "are not all running)")
+
+    os.makedirs(args.workdir, exist_ok=True)
+    coordinator = ""
+    if not args.no_coordinator:
+        port = args.coordinator_port or _free_port()
+        coordinator = f"127.0.0.1:{port}"
+
+    recorder = None
+    if args.flight_record:
+        recorder = FlightRecorder(args.flight_record, manifest={
+            "multihost": {"processes": args.processes,
+                          "coordinated": bool(coordinator)}})
+
+    workers = build_workers(args, worker_args, coordinator)
+    fleet_restarts = 0
+    results: Dict[int, int] = {}
+
+    def cluster_state() -> dict:
+        return {
+            "processes": args.processes,
+            "coordinated": bool(coordinator),
+            "fleet_restarts": fleet_restarts,
+            "workers": [
+                {"process": w.process_id,
+                 "alive": w.poll() is None,
+                 "restarts": w.restarts,
+                 "rc": w.poll()}
+                for w in workers
+            ],
+        }
+
+    server = None
+    if args.metrics_port:
+        server = _ClusterMetricsServer(
+            args.metrics_port,
+            {w.process_id: args.worker_metrics_base + w.process_id
+             for w in workers},
+            cluster_state)
+        server.start()
+        print(f"# cluster metrics on :{server.port} "
+              "(/metrics /metrics.json /cluster)", file=sys.stderr,
+              flush=True)
+
+    has_ckpt = "--checkpoint-dir" in worker_args
+    resume_args = (["--resume"]
+                   if has_ckpt and "--resume" not in worker_args else [])
+
+    t0 = time.monotonic()
+    rc = 0
+    if args.serialize:
+        # One worker at a time (disjoint residue blocks: the fleet's
+        # output is identical to the concurrent run's) — each gets the
+        # host alone, so its stats measure per-process capacity, not
+        # shared-core time-slicing. Per-worker restart budget applies.
+        try:
+            for w in workers:
+                while True:
+                    w.spawn(resume_args if w.restarts else None)
+                    if recorder is not None:
+                        recorder.record_event("cluster_worker_start",
+                                              process=w.process_id,
+                                              attempt=w.restarts)
+                    while w.poll() is None:
+                        if args.timeout and \
+                                time.monotonic() - t0 > args.timeout:
+                            w.kill()
+                            break
+                        time.sleep(0.1)
+                    if w.poll() == 0 or \
+                            w.restarts >= args.max_worker_restarts:
+                        break
+                    w.restarts += 1
+                results[w.process_id] = w.poll()
+                if results[w.process_id] != 0:
+                    rc = 1
+        finally:
+            for w in workers:
+                w.kill()
+            if server is not None:
+                server.stop()
+        return _report(args, workers, results, fleet_restarts,
+                       coordinator, recorder, rc)
+
+    for w in workers:
+        w.spawn()
+        if recorder is not None:
+            recorder.record_event("cluster_worker_start",
+                                  process=w.process_id)
+    try:
+        while True:
+            states = {w.process_id: w.poll() for w in workers}
+            if all(s is not None for s in states.values()):
+                results = states
+                break
+            if args.timeout and time.monotonic() - t0 > args.timeout:
+                print("# fleet timeout — killing workers",
+                      file=sys.stderr, flush=True)
+                for w in workers:
+                    w.kill()
+                results = {w.process_id: (w.poll() if w.poll() is not None
+                                          else -9) for w in workers}
+                rc = 1
+                break
+            dead_bad = [w for w in workers
+                        if states[w.process_id] not in (None, 0)]
+            if dead_bad and coordinator:
+                # Host loss, pod semantics: the coordination service
+                # (process 0) or a heartbeat-fenced peer is gone — the
+                # fleet cannot continue half-alive. Drain and relaunch
+                # everyone with --resume: each worker's own
+                # checkpoint + sink truncate_after fencing (the PR 4/6
+                # supervisor plane) makes the restart exactly-once per
+                # residue block.
+                if fleet_restarts >= args.max_fleet_restarts:
+                    for w in workers:
+                        w.kill()
+                    # a worker that finished rc 0 before the fatal peer
+                    # death keeps its honest exit code in the report
+                    results = {w.process_id: (w.poll()
+                                              if w.poll() is not None
+                                              else 1)
+                               for w in workers}
+                    rc = 1
+                    break
+                fleet_restarts += 1
+                for w in workers:
+                    w.kill()
+                if recorder is not None:
+                    recorder.record_event(
+                        "fleet_restart", generation=fleet_restarts,
+                        died=[w.process_id for w in dead_bad])
+                port = _free_port()
+                coordinator = f"127.0.0.1:{port}"
+                workers = build_workers(args, worker_args, coordinator)
+                for w in workers:
+                    w.restarts = fleet_restarts
+                    w.spawn(resume_args)
+                time.sleep(0.5)
+                continue
+            if dead_bad:
+                # Uncoordinated fleet: a dead worker affects only its
+                # own residue block — respawn just it, resuming its own
+                # checkpoint lineage.
+                for w in dead_bad:
+                    if w.restarts >= args.max_worker_restarts:
+                        for v in workers:
+                            v.kill()
+                        results = {v.process_id: v.poll()
+                                   if v.poll() is not None else 1
+                                   for v in workers}
+                        rc = 1
+                        break
+                    w.restarts += 1
+                    if recorder is not None:
+                        recorder.record_event(
+                            "cluster_worker_restart",
+                            process=w.process_id, attempt=w.restarts)
+                    w.spawn(resume_args)
+                else:
+                    time.sleep(0.2)
+                    continue
+                break
+            time.sleep(0.2)
+    finally:
+        for w in workers:
+            w.kill()
+        if server is not None:
+            server.stop()
+
+    return _report(args, workers, results, fleet_restarts, coordinator,
+                   recorder, rc)
+
+
+def _report(args, workers, results, fleet_restarts, coordinator,
+            recorder, rc) -> int:
+    worker_rows = []
+    rows_total = 0
+    for w in workers:
+        st = w.stats() or {}
+        rows = int(st.get("rows", 0) or 0)
+        rows_total += rows
+        row = {
+            "process": w.process_id,
+            "rc": results.get(w.process_id, w.poll()),
+            "restarts": w.restarts,
+            "rows": rows,
+            "rows_per_s": round(float(st.get("rows_per_s", 0.0) or 0.0),
+                                1),
+            "cpu_s": round(float(st.get("cpu_s", 0.0) or 0.0), 3),
+            "batches": int(st.get("batches", 0) or 0),
+            "log": w.log_path,
+        }
+        worker_rows.append(row)
+        if recorder is not None:
+            recorder.record_event(
+                "cluster_worker", process=w.process_id, rc=row["rc"],
+                rows=rows, rows_per_s=row["rows_per_s"],
+                restarts=w.restarts)
+        if row["rc"] != 0:
+            rc = rc or 1
+    if recorder is not None:
+        recorder.close()
+    print(json.dumps({
+        "processes": args.processes,
+        "coordinated": bool(coordinator),
+        "serialized": bool(args.serialize),
+        "fleet_restarts": fleet_restarts,
+        "rows_total": rows_total,
+        "workers": worker_rows,
+    }), flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
