@@ -1431,7 +1431,9 @@ def _child_main(args) -> None:
                     arm["mfu_of_ceiling"] = round(arm_mfu / mfu_ceiling, 3)
 
     # ---- tiered feature-store scale curve (detail.state_scale) ----------
-    # ROADMAP item 2's proof shape: key universe 64k → 10M × Zipf skew
+    # ROADMAP item 2's proof shape, extended to the host cold tier: key
+    # universe 64k → 10M two-tier, then 100M with features.cold_store
+    # (demote-don't-discard + async promote) × Zipf skew
     # with a BOUNDED hot tier (key_mode="exact") — loop rows/s must stay
     # flat (the state never grows past the working set), per-tier state
     # bytes must hold under --state-hbm-budget-mb (validated at engine
@@ -1723,6 +1725,63 @@ def _state_scale_block(args, on_cpu: bool) -> dict:
                                       if recompiles is not None else 0.0),
         }
         last_engine = eng
+    # ---- 100M-key cold-tier cell ------------------------------------
+    # The third tier's proof: same bounded 2×32k-slot hot tier, 10× the
+    # 10M directory sweep — compaction DEMOTES evicted keys' exact rows
+    # to host segments (features.cold_store) instead of discarding them,
+    # and returning keys promote back asynchronously. rows/s must stay
+    # within 15% of the 64k baseline, HBM stays the same static
+    # state_bytes() (the cold tier is host memory/disk), and the
+    # demotion/promotion counters + exactness_degraded_keys scope the
+    # bit-identity claim honestly.
+    n_cold = 100_000_000
+    _progress(f"state scale universe {n_cold} (cold tier)")
+    with tempfile.TemporaryDirectory() as td_cold:
+        cold_fcfg = _dc.replace(fcfg, cold_store=td_cold,
+                                cold_demote_slots=1024,
+                                cold_promote_queue=256)
+        cold_cfg = cfg.replace(features=cold_fcfg)
+        sampler = ZipfKeySampler(n_cold, skew)
+        reg = MetricsRegistry()
+        eng = ScoringEngine(cold_cfg, kind="logreg",
+                            params=init_logreg(15), scaler=scaler,
+                            metrics=reg)
+        eng.run(_ZipfSource(2, rows, sampler, day_every=1, seed=7))
+        stats = eng.run(_ZipfSource(n_batches, rows, sampler,
+                                    day_every=max(n_batches // 6, 1)))
+        eng.drain_promotions()
+        dense = reg.get("rtfds_feature_tier_rows_total", tier="dense")
+        cms = reg.get("rtfds_feature_tier_rows_total", tier="cms")
+        d = dense.value if dense is not None else 0.0
+        c = cms.value if cms is not None else 0.0
+        recompiles = reg.get("rtfds_xla_recompiles_total")
+
+        def _mval(name):
+            m = reg.get(name)
+            return m.value if m is not None else 0.0
+
+        rate = stats["rows_per_s"]
+        out["universes"][str(n_cold)] = {
+            "rows_per_s": round(rate, 1),
+            "vs_64k": round(rate / base_rate, 3) if base_rate else None,
+            "dense_hit_rate": round(d / (d + c), 4) if d + c else 1.0,
+            "mid_stream_recompiles": (recompiles.value
+                                      if recompiles is not None else 0.0),
+            "exactness_degraded_keys": int(
+                stats.get("exactness_degraded_keys", 0)),
+            "cold": {
+                "keys": int(_mval("rtfds_feature_cold_keys")),
+                "bytes": int(_mval("rtfds_feature_cold_bytes")),
+                "demotions": int(
+                    _mval("rtfds_feature_cold_demotions_total")),
+                "promotions": int(
+                    _mval("rtfds_feature_cold_promotions_total")),
+                "promote_wait_s": round(_mval(
+                    "rtfds_feature_cold_promote_wait_seconds_total"), 3),
+            },
+        }
+        out["flat_100m_within_15pct"] = (
+            bool(rate >= 0.85 * base_rate) if base_rate else None)
     # delta-checkpoint cost of the bounded state vs the dense-at-10M
     # control (static accounting: direct mode needs capacity >= universe)
     dense_cap = 1 << 24  # next pow2 >= 10M

@@ -620,6 +620,9 @@ def cmd_score(args) -> int:
             key_mode=args.key_mode,
             compact_every=args.state_compact_every,
             state_hbm_budget_mb=args.state_hbm_budget_mb,
+            cold_store=args.cold_store,
+            cold_promote_queue=args.cold_promote_queue,
+            cold_segment_mb=args.cold_segment_mb,
         ))
     except ValueError as e:
         log.error("feature-plane config: %s", e)
@@ -657,6 +660,13 @@ def cmd_score(args) -> int:
             sb["directory"] / 2 ** 20, sb["cms"] / 2 ** 20,
             f" of {args.state_hbm_budget_mb:g} MB budget"
             if args.state_hbm_budget_mb > 0 else "")
+        if cfg.features.cold_store:
+            log.info(
+                "host cold tier: %s (segment %.1f MB, promote queue %d) "
+                "— evicted keys demote with exact rows and promote back "
+                "asynchronously on return",
+                cfg.features.cold_store, cfg.features.cold_segment_mb,
+                cfg.features.cold_promote_queue)
     cfg = cfg.replace(learn=_dc.replace(
         cfg.learn,
         registry_path=args.learn_registry,
@@ -2318,6 +2328,25 @@ def main(argv=None) -> int:
                         "engine build from the static state_bytes() "
                         "accounting — fail fast instead of OOMing "
                         "mid-stream. 0 = unchecked")
+    p.add_argument("--cold-store", default="",
+                   help="host cold tier for --key-mode exact: directory "
+                        "or s3:// url where compaction demotes evicted "
+                        "keys' exact window rows instead of discarding "
+                        "them; returning keys promote back "
+                        "asynchronously (README 'Feature-state playbook' "
+                        "§ Cold tier). Requires --state-compact-every. "
+                        "Empty = off (evictions degrade to the sketch)")
+    p.add_argument("--cold-promote-queue", type=int, default=64,
+                   help="bounded depth of the async promoter's request "
+                        "queue; a full queue drops the request and the "
+                        "key re-enqueues on its next touch "
+                        "(rtfds_feature_cold_promote_backlog vs the "
+                        "_queue_limit gauge is the overload ladder's "
+                        "cold_promote pressure input)")
+    p.add_argument("--cold-segment-mb", type=float, default=4.0,
+                   help="cold-store flush threshold: buffered demotions "
+                        "become one durable segment (blob + CRC'd "
+                        "manifest) once they exceed this many MB")
     p.add_argument("--alerts-only", action="store_true",
                    help="serve predictions only: the feature matrix "
                         "never leaves the device (the highest-throughput "

@@ -90,6 +90,29 @@ class FeatureConfig:
     # state_bytes() accounting — a config that cannot fit fails fast
     # instead of OOMing mid-stream. 0 = no budget check.
     state_hbm_budget_mb: float = 0.0
+    # Host cold tier for key_mode="exact": compaction DEMOTES pressure-
+    # evicted keys' exact window rows to an append+compact keyed store on
+    # the host (io/coldstore.py) instead of discarding them; a returning
+    # key is detected host-side against the cold index and its rows are
+    # PROMOTED back into the hot tier asynchronously between device steps
+    # (a ("promote",) dispatch signature — zero mid-stream recompiles).
+    # Empty string disables the tier (evictions discard, PR 13 behavior).
+    # Accepts a local directory or an s3:// URL (flaky-store retries and
+    # CRC verification inherited from the checkpoint backends).
+    cold_store: str = ""
+    # Bounded promoter request queue (keys awaiting a host cold-store
+    # read); a full queue drops the request and the key is re-enqueued
+    # on its next touch — backpressure, never unbounded growth.
+    cold_promote_queue: int = 64
+    # Cold segment flush threshold (MB of buffered demoted rows before a
+    # segment blob + manifest is written). Checkpoints always flush.
+    cold_segment_mb: float = 4.0
+    # Max keys demoted per table per compaction pass (the static top-k
+    # width of the eviction scan — one compiled shape).
+    cold_demote_slots: int = 1024
+    # Hot-tier occupancy target: compaction demotes oldest-first down to
+    # ceil(highwater * slot_capacity) occupied slots per table.
+    cold_highwater: float = 0.75
     # Count-min sketch for unbounded key cardinality (velocity features).
     cms_depth: int = 4
     cms_width: int = 1 << 15
@@ -147,6 +170,31 @@ class FeatureConfig:
             raise ValueError(
                 f"state_hbm_budget_mb must be >= 0 (0 = unchecked), "
                 f"got {self.state_hbm_budget_mb}")
+        if self.cold_promote_queue < 1:
+            raise ValueError(
+                f"cold_promote_queue must be >= 1 (the promoter queue is "
+                f"bounded), got {self.cold_promote_queue}")
+        if self.cold_segment_mb <= 0:
+            raise ValueError(
+                f"cold_segment_mb must be > 0, got {self.cold_segment_mb}")
+        if self.cold_demote_slots < 1:
+            raise ValueError(
+                f"cold_demote_slots must be >= 1, "
+                f"got {self.cold_demote_slots}")
+        if not 0 < self.cold_highwater <= 1:
+            raise ValueError(
+                f"cold_highwater must be in (0, 1], "
+                f"got {self.cold_highwater}")
+        if self.cold_store:
+            if self.key_mode != "exact":
+                raise ValueError(
+                    "cold_store requires key_mode='exact' (only the "
+                    "keyed hot tier has per-key rows to demote), got "
+                    f"key_mode={self.key_mode!r}")
+            if self.compact_every <= 0:
+                raise ValueError(
+                    "cold_store requires compact_every > 0 (demotion "
+                    "rides the compaction cadence)")
         if self.seq_attn not in ("naive", "blockwise", "auto"):
             raise ValueError(
                 f"seq_attn must be 'naive', 'blockwise' or 'auto', "

@@ -373,9 +373,16 @@ class ZipfKeySampler:
     stride coprime to any pow2-adjacent universe), so the hot set is
     scattered across the id space instead of sitting in the low ids a
     ``direct``-mode table would accidentally favor.
+
+    Universes past ``_EXACT_MAX`` (16.7M) keep the exact CDF for the
+    head ranks only (where essentially all per-rank mass sits) and draw
+    tail ranks from the continuous power-law inverse CDF — the 100M-key
+    cold-tier benchmark would otherwise pay an 800 MB float64 cumsum
+    for ranks whose individual probabilities are < 1e-9.
     """
 
     _STRIDE = 2654435761  # Knuth multiplicative-hash constant (odd)
+    _EXACT_MAX = 1 << 24
 
     def __init__(self, n_keys: int, skew: float = 1.1):
         if n_keys < 1:
@@ -384,15 +391,50 @@ class ZipfKeySampler:
             raise ValueError(f"skew must be >= 0, got {skew}")
         self.n_keys = int(n_keys)
         self.skew = float(skew)
-        w = 1.0 / np.power(np.arange(1, n_keys + 1, dtype=np.float64),
+        head = min(self.n_keys, self._EXACT_MAX)
+        self._head = head
+        w = 1.0 / np.power(np.arange(1, head + 1, dtype=np.float64),
                            skew)
         cdf = np.cumsum(w)
-        cdf /= cdf[-1]
+        if self.n_keys > head:
+            # tail mass via the continuous integral of x^-skew over
+            # (head+1/2, n_keys+1/2] — the midpoint-corrected analogue
+            # of the discrete sum
+            a, b = head + 0.5, self.n_keys + 0.5
+            if abs(skew - 1.0) < 1e-12:
+                tail = np.log(b) - np.log(a)
+            else:
+                e = 1.0 - skew
+                tail = (b ** e - a ** e) / e
+            total = cdf[-1] + tail
+            self._head_frac = cdf[-1] / total
+            cdf = cdf / total
+        else:
+            self._head_frac = 1.0
+            cdf = cdf / cdf[-1]
         self._cdf = cdf
+
+    def _tail_ranks(self, u: np.ndarray) -> np.ndarray:
+        """Continuous inverse CDF over the tail ranks: ``u`` uniform in
+        [0, 1) → 0-based ranks in [head, n_keys)."""
+        a, b = self._head + 0.5, self.n_keys + 0.5
+        if abs(self.skew - 1.0) < 1e-12:
+            x = a * np.power(b / a, u)
+        else:
+            e = 1.0 - self.skew
+            x = np.power(a ** e + u * (b ** e - a ** e), 1.0 / e)
+        return np.clip(x.astype(np.int64), self._head, self.n_keys - 1)
 
     def sample(self, rng: np.random.Generator, n: int) -> np.ndarray:
         """Draw ``n`` keys (int64 [n]) in ``[0, n_keys)``."""
-        ranks = np.searchsorted(self._cdf, rng.random(n), side="left")
+        u = rng.random(n)
+        ranks = np.searchsorted(self._cdf, u, side="left")
+        if self._head_frac < 1.0:
+            in_tail = u >= self._head_frac
+            if in_tail.any():
+                v = (u[in_tail] - self._head_frac) \
+                    / (1.0 - self._head_frac)
+                ranks[in_tail] = self._tail_ranks(v)
         return (ranks.astype(np.int64) * self._STRIDE) % self.n_keys
 
 

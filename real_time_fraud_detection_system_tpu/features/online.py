@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from typing import NamedTuple, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from real_time_fraud_detection_system_tpu.config import FeatureConfig
@@ -28,6 +29,7 @@ from real_time_fraud_detection_system_tpu.ops.cms import (
 )
 from real_time_fraud_detection_system_tpu.ops.hashing import slot_of
 from real_time_fraud_detection_system_tpu.ops.keydir import (
+    EMPTY_KEY,
     KeyDirectory,
     admit_slots,
     init_keydir,
@@ -476,7 +478,8 @@ def compact_feature_state(
     state: FeatureState,
     now_day: jnp.ndarray,  # int32 [] — newest day the stream has seen
     cfg: FeatureConfig,
-) -> Tuple[FeatureState, jnp.ndarray]:
+    demote_slots: int = 0,
+):
     """Recency compaction (``key_mode="exact"``): one full-table vector
     pass reclaiming hot-tier slots that hold only dead history.
 
@@ -488,11 +491,26 @@ def compact_feature_state(
     clean. Returns (new_state, reclaimed [2] int32 = [customer,
     terminal]). Fixed shapes throughout: this is a ``DispatchSignature``
     variant of the compiled step family, not a recompile.
+
+    ``demote_slots > 0`` adds the cold tier's PRESSURE eviction behind
+    the dead reclaim: when a table still sits above
+    ``cold_highwater * slot_capacity`` occupied slots, the oldest
+    (strictly pre-``now_day``) entries — up to ``demote_slots`` per
+    table, a static ``top_k`` width — are DEMOTED: their exact window
+    rows are gathered into a fixed-shape payload BEFORE the slots are
+    vacated, and the return becomes ``(state, reclaimed[2], payload)``
+    where ``payload[table] = (keys u32 [K], bucket_day i32 [K, NB],
+    count/amount/fraud f32 [K, NB])`` with unselected lanes masked to
+    ``EMPTY_KEY``/empty rows. The host appends the payload to
+    ``io/coldstore.py`` — demote, don't discard.
     """
     horizon = jnp.int32(cfg.delay_days + max(cfg.windows))
     cutoff = now_day.astype(jnp.int32) - horizon
+    now = now_day.astype(jnp.int32)
+    demote = int(demote_slots)
     out = {}
     counts = []
+    payload = {}
     for dir_name, ws_name in (("customer_dir", "customer"),
                               ("terminal_dir", "terminal")):
         kd = getattr(state, dir_name)
@@ -500,28 +518,198 @@ def compact_feature_state(
         if kd is None:
             out[dir_name], out[ws_name] = kd, ws
             counts.append(jnp.int32(0))
+            payload[ws_name] = None
             continue
         newest = jnp.max(ws.bucket_day, axis=1)  # [slot_cap]
         slot_idx = jnp.clip(kd.slots, 0, ws.capacity - 1)
-        dead_entry = (kd.slots >= 0) & (newest[slot_idx] < cutoff)
-        old_slots = kd.slots  # pre-clear slot ids (reclaim vacates them)
-        kd, dead, n = reclaim_entries(kd, dead_entry)
-        tgt = jnp.where(dead, old_slots, ws.capacity)
+        live = kd.slots >= 0
+        newest_e = newest[slot_idx]
+        dead_entry = live & (newest_e < cutoff)
+        if demote > 0:
+            # Pressure eviction EXTENDS the dead mask (payload gathered
+            # before any vacate), so the demote variant pays ONE
+            # combined reclaim + window-table sweep — not a second
+            # full-table pass on top of the dead reclaim.
+            kd, ws, n, pay = _demote_oldest(
+                kd, ws, dead_entry, newest_e, live, now,
+                int(cfg.delay_days + max(cfg.windows)), demote,
+                cfg.cold_highwater)
+            payload[ws_name] = pay
+        else:
+            old_slots = kd.slots  # pre-clear ids (reclaim vacates them)
+            kd, dead, n = reclaim_entries(kd, dead_entry)
+            tgt = jnp.where(dead, old_slots, ws.capacity)
+            ws = WindowState(
+                bucket_day=ws.bucket_day.at[tgt].set(-1, mode="drop"),
+                count=ws.count.at[tgt].set(0.0, mode="drop"),
+                amount=ws.amount.at[tgt].set(0.0, mode="drop"),
+                fraud=ws.fraud.at[tgt].set(0.0, mode="drop"),
+            )
+            payload[ws_name] = None
+        out[dir_name] = kd
+        out[ws_name] = ws
+        counts.append(n)
+    new_state = state._replace(
+        customer=out["customer"], terminal=out["terminal"],
+        customer_dir=out["customer_dir"],
+        terminal_dir=out["terminal_dir"],
+    )
+    if demote > 0:
+        return new_state, jnp.stack(counts), payload
+    return new_state, jnp.stack(counts)
+
+
+def _demote_oldest(
+    kd: KeyDirectory,
+    ws: WindowState,
+    dead_entry: jnp.ndarray,  # bool [dir_cap] — the dead-history mask
+    newest_e: jnp.ndarray,  # int32 [dir_cap] — newest bucket per entry
+    live: jnp.ndarray,  # bool [dir_cap]
+    now_day: jnp.ndarray,  # int32 []
+    horizon: int,  # days — dead-history cutoff distance (static)
+    demote_slots: int,
+    highwater: float,
+):
+    """Pressure eviction for one table, FUSED with the dead-history
+    reclaim: pick the ``demote_slots`` oldest live directory entries
+    (strictly pre-``now_day`` newest bucket; an entry touched today is
+    never evicted under the feet of the batch that just wrote it), but
+    only as many as POST-dead-reclaim occupancy sits above the
+    ``highwater`` target. The evicted rows are gathered into a
+    fixed-shape payload first, then the dead mask and the demote
+    selection vacate in ONE ``reclaim_entries`` + window sweep (the
+    fused pass costs one table rewrite, not two — the selection and the
+    resulting state are identical to running the passes sequentially;
+    only the internal free-stack push order differs, which no feature
+    value depends on).
+
+    Oldest-``n_evict`` selection runs WITHOUT a ``top_k`` sort:
+    eligible ages live in ``[1, horizon]`` (anything older is already
+    in the dead mask), so an age histogram + suffix sum finds the
+    threshold age and a cumsum rank breaks the tie at the threshold by
+    lowest index — the exact set ``lax.top_k`` would pick (its ties
+    also resolve to the lowest index), at O(n) scatter cost instead of
+    an O(n log k) sort over the whole directory. Returns
+    ``(kd, ws, n_reclaimed_total, (keys, bd, cnt, amt, frd))``.
+    """
+    slot_cap = int(ws.capacity)
+    dir_cap = int(kd.keys.shape[0])
+    k = min(int(demote_slots), dir_cap)
+    hzn = max(int(horizon), 1)
+    n_dead = jnp.sum((dead_entry & live).astype(jnp.int32))
+    occ = (jnp.int32(kd.free.shape[0]) - kd.free_top.astype(jnp.int32)
+           - n_dead)
+    target = jnp.int32(int(highwater * slot_cap))
+    n_evict = jnp.clip(occ - target, 0, k)
+    eligible = live & ~dead_entry & (newest_e < now_day)
+    # Age histogram over [1, hzn] (bucket 0 holds the ineligible mass
+    # and is never selectable; eligible entries have age >= 1 because
+    # newest_e < now_day, and age <= hzn because older is dead).
+    age = jnp.clip(jnp.where(eligible, now_day - newest_e, 0),
+                   0, hzn).astype(jnp.int32)
+    hist = jnp.zeros((hzn + 3,), jnp.int32).at[age].add(1)
+    incl = jnp.cumsum(hist[::-1])[::-1]  # incl[a] = #entries age >= a
+    # Threshold t* = max age with incl >= n_evict (monotone, so a count
+    # of satisfied ages IS the argmax); floor 1 covers the
+    # n_evict > #eligible case, where every eligible entry is taken.
+    thresh = jnp.maximum(
+        jnp.sum((incl >= n_evict)[1:hzn + 2].astype(jnp.int32)),
+        jnp.int32(1))
+    quota = n_evict - incl[thresh + 1]  # lanes left for age == t*
+    at_t = age == thresh
+    rank_t = jnp.cumsum(at_t.astype(jnp.int32)) - 1
+    sel = (age > thresh) | (at_t & (rank_t < quota))
+    # Pack selected entry indices into the fixed k payload lanes in
+    # index order (payload lane order is semantically irrelevant — the
+    # cold store treats rows independently).
+    lane = jnp.where(sel, jnp.cumsum(sel.astype(jnp.int32)) - 1, k)
+    eidx = jnp.full((k,), dir_cap, jnp.int32).at[lane].set(
+        jnp.arange(dir_cap, dtype=jnp.int32), mode="drop")
+    lane_live = (jnp.arange(k, dtype=jnp.int32)
+                 < jnp.sum(sel.astype(jnp.int32)))
+    eidx_c = jnp.clip(eidx, 0, dir_cap - 1)
+    # Gather the payload BEFORE vacating: keys + full window rows.
+    keys = jnp.where(lane_live, kd.keys[eidx_c], jnp.uint32(EMPTY_KEY))
+    slot_g = jnp.clip(kd.slots[eidx_c], 0, slot_cap - 1)
+    m = lane_live[:, None]
+    bd = jnp.where(m, ws.bucket_day[slot_g], jnp.int32(-1))
+    cnt = jnp.where(m, ws.count[slot_g], 0.0)
+    amt = jnp.where(m, ws.amount[slot_g], 0.0)
+    frd = jnp.where(m, ws.fraud[slot_g], 0.0)
+    # One combined vacate: dead history + demoted entries.
+    old_slots = kd.slots
+    kd, dead, n = reclaim_entries(kd, dead_entry | sel)
+    tgt = jnp.where(dead, old_slots, slot_cap)
+    ws = WindowState(
+        bucket_day=ws.bucket_day.at[tgt].set(-1, mode="drop"),
+        count=ws.count.at[tgt].set(0.0, mode="drop"),
+        amount=ws.amount.at[tgt].set(0.0, mode="drop"),
+        fraud=ws.fraud.at[tgt].set(0.0, mode="drop"),
+    )
+    return kd, ws, n, (keys, bd, cnt, amt, frd)
+
+
+def promote_rows(
+    state: FeatureState,
+    payload: dict,  # {"customer": (keys, bd, cnt, amt, frd)|None, ...}
+    cfg: FeatureConfig,
+) -> Tuple[FeatureState, jnp.ndarray]:
+    """Async promotion landing: merge cold-store rows back into the hot
+    tier between device steps.
+
+    Per table: ``admit_slots`` grants (or finds) a slot for every
+    non-``EMPTY_KEY`` payload lane, then a per-bucket DAY-DOMINANCE
+    merge takes the cold bucket only where its ``bucket_day`` is
+    strictly newer than the resident one — never a float add, so
+    promotion is IDEMPOTENT (re-promoting a resident key is a no-op)
+    and a key that accrued fresh hot rows while its promotion was in
+    flight converges to exactly the never-evicted state: eviction
+    required every cold bucket to be strictly pre-eviction-day, and
+    post-return writes land on days >= the return day, so cold and hot
+    buckets never contend for the same day. Returns ``(state,
+    stats [2, 2] int32)`` = per-table ``[admitted, dropped]`` (dropped:
+    the free list ran dry — the host re-enqueues on the key's next
+    touch). The caller guarantees unique keys per dispatch.
+    """
+    out = {}
+    stats = []
+    for dir_name, ws_name in (("customer_dir", "customer"),
+                              ("terminal_dir", "terminal")):
+        kd = getattr(state, dir_name)
+        ws = getattr(state, ws_name)
+        pay = payload.get(ws_name)
+        if kd is None or pay is None:
+            out[dir_name], out[ws_name] = kd, ws
+            stats.append(jnp.zeros((2,), jnp.int32))
+            continue
+        keys, bd, cnt, amt, frd = pay
+        valid = keys != jnp.uint32(EMPTY_KEY)
+        kd, slot, adm = admit_slots(kd, keys, valid,
+                                    n_probes=cfg.keydir_probes)
+        slot_c = jnp.clip(slot, 0, ws.capacity - 1)
+        take = adm[:, None] & (bd > ws.bucket_day[slot_c])
+        new_bd = jnp.where(take, bd, ws.bucket_day[slot_c])
+        new_cnt = jnp.where(take, cnt, ws.count[slot_c])
+        new_amt = jnp.where(take, amt, ws.amount[slot_c])
+        new_frd = jnp.where(take, frd, ws.fraud[slot_c])
+        tgt = jnp.where(adm, slot, ws.capacity)
         out[dir_name] = kd
         out[ws_name] = WindowState(
-            bucket_day=ws.bucket_day.at[tgt].set(-1, mode="drop"),
-            count=ws.count.at[tgt].set(0.0, mode="drop"),
-            amount=ws.amount.at[tgt].set(0.0, mode="drop"),
-            fraud=ws.fraud.at[tgt].set(0.0, mode="drop"),
+            bucket_day=ws.bucket_day.at[tgt].set(new_bd, mode="drop"),
+            count=ws.count.at[tgt].set(new_cnt, mode="drop"),
+            amount=ws.amount.at[tgt].set(new_amt, mode="drop"),
+            fraud=ws.fraud.at[tgt].set(new_frd, mode="drop"),
         )
-        counts.append(n)
+        adm_n = jnp.sum(adm.astype(jnp.int32))
+        drop_n = jnp.sum((valid & ~adm).astype(jnp.int32))
+        stats.append(jnp.stack([adm_n, drop_n]))
     return (
         state._replace(
             customer=out["customer"], terminal=out["terminal"],
             customer_dir=out["customer_dir"],
             terminal_dir=out["terminal_dir"],
         ),
-        jnp.stack(counts),
+        jnp.stack(stats),
     )
 
 

@@ -183,6 +183,15 @@ def _state_arrays(engine_state) -> Tuple[dict, dict]:
         # from the manifest alone (shapes are static per shard — only
         # the VALUES betray skew, and free_top is one int per shard)
         meta["feature_state_occupancy"] = occ
+    cl = getattr(engine_state, "cold_lineage", None)
+    if cl:
+        # cold-tier segment lineage (io/coldstore.py): which LIVE
+        # segments this checkpoint's hot state pairs with. Restore hands
+        # it to ColdStore.sync_to so post-checkpoint segments are pruned
+        # (replay regenerates them — exactly-once across the tier
+        # boundary) and `rtfds ckpt --inspect` surfaces the cold plane
+        # from the manifest alone.
+        meta["cold_lineage"] = cl
     return arrays, meta
 
 
@@ -252,6 +261,8 @@ def _apply_arrays(engine_state, meta: dict, arrays: dict):
     # pre-learning checkpoints carry no stamp: keep the template's value
     # (the version the fresh engine was built from), which makes a
     # champion-pointer mismatch err toward re-applying the champion
+    if meta.get("cold_lineage") is not None:
+        engine_state.cold_lineage = meta["cold_lineage"]
     return engine_state
 
 
@@ -1146,4 +1157,67 @@ def feature_state_report(man: dict) -> Optional[dict]:
         out["worst_shard"] = {
             t: {"shard": s, "occupied": occ[t][s]}
             for t, s in worst.items()}
+    cold = cold_tier_report(meta.get("cold_lineage"))
+    if cold is not None:
+        out["cold"] = cold
     return out
+
+
+def cold_tier_report(lineage: Optional[dict]) -> Optional[dict]:
+    """Cold-tier plane of ``rtfds ckpt --inspect``, from MANIFESTS alone
+    (no segment-blob reads): the lineage the checkpoint recorded, plus a
+    per-segment CRC VERDICT against the cold store's on-disk manifests —
+    ``ok`` (manifest present, crc matches the lineage), ``mismatch``
+    (the segment was rewritten/corrupted since the save), ``missing``
+    (segment gone — e.g. gc after a newer checkpoint; its keys degrade
+    to CMS on restore), ``unavailable`` (cold store unreachable)."""
+    if not lineage:
+        return None
+    segs = list(lineage.get("segments", []))
+    out = {
+        "cold_store": lineage.get("cold_store", ""),
+        "segments": len(segs),
+        "total_keys": int(lineage.get("total_keys", 0) or 0),
+        "total_bytes": int(lineage.get("total_bytes", 0) or 0),
+    }
+    rows = []
+    for s in segs:
+        seq = int(s["seq"])
+        row = {"seq": seq, "blob": s.get("blob"),
+               "bytes": int(s.get("bytes", 0) or 0),
+               "keys": s.get("keys", {})}
+        row["crc_verdict"] = _cold_seg_verdict(
+            lineage.get("cold_store", ""), seq, s.get("crc"))
+        rows.append(row)
+    out["segment_rows"] = rows
+    verdicts = {r["crc_verdict"] for r in rows}
+    out["crc_verdict"] = ("ok" if not verdicts or verdicts == {"ok"}
+                          else "mismatch" if "mismatch" in verdicts
+                          else "missing" if "missing" in verdicts
+                          else "unavailable")
+    return out
+
+
+def _cold_seg_verdict(cold_store: str, seq: int, crc) -> str:
+    """Best-effort on-disk manifest check for one lineage segment."""
+    if not cold_store:
+        return "unavailable"
+    name = f"seg-{seq:08d}.json"
+    try:
+        if cold_store.startswith("s3://"):
+            from real_time_fraud_detection_system_tpu.io.store import (
+                make_store,
+            )
+
+            data = _StoreBackend(make_store(cold_store),
+                                 prefix="").read(name)
+        else:
+            data = _LocalBackend(cold_store).read(name)
+        man = json.loads(data.decode("utf-8"))
+    except KeyError:
+        return "missing"
+    # rtfdslint: disable=broad-exception-catch (inspect is read-only forensics: ANY failure to reach/parse the cold store must degrade to a verdict, never kill the inspect)
+    except Exception:
+        return "unavailable"
+    return "ok" if crc is not None and int(man.get("crc", -1)) == \
+        int(crc) else "mismatch"

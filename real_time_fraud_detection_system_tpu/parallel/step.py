@@ -673,6 +673,7 @@ def make_sharded_compact(
     cfg: Config,
     mesh: Mesh,
     axis: "str | Tuple[str, ...]" = "data",
+    demote_slots: int = 0,
 ):
     """Per-shard recency compaction under ``shard_map`` — the sharded
     twin of the single-chip ``("compact",)`` dispatch variant.
@@ -684,6 +685,15 @@ def make_sharded_compact(
     the per-shard reclaim counts come back stacked so the engine can
     meter skew per shard. Fixed shapes throughout: one more
     ``DispatchSignature``, AOT-compiled at warmup, never a recompile.
+
+    With ``demote_slots`` > 0 (``features.cold_store`` configured) the
+    per-shard compaction also emits its demotion payload — each shard's
+    oldest live keys and their exact window rows, gathered BEFORE the
+    slots are vacated — stacked on a leading device axis
+    (``keys [n_dev, K]``, rows ``[n_dev, K, NB]``) so the engine can
+    append every shard's evictions to the host cold store. Routing is
+    free: a key demoted by shard *i* re-promotes to shard *i* because
+    owner-modulo placement is a pure function of the key.
     """
     from real_time_fraud_detection_system_tpu.features.online import (
         compact_feature_state,
@@ -698,6 +708,14 @@ def make_sharded_compact(
     def spec_like(tree, spec):
         return jax.tree.map(lambda _: spec, tree)
 
+    def _payload_spec():
+        # per-table (keys [n_dev, K], bd/cnt/amt/frd [n_dev, K, NB])
+        leaf = (P(axis, None),) + (P(axis, None, None),) * 4
+        return {
+            "customer": leaf if has_cdir else None,
+            "terminal": leaf,
+        }
+
     def outer(fstate: FeatureState, now_day: jnp.ndarray):
         def local(customer, terminal, c_kd, t_kd, day):
             st = FeatureState(
@@ -709,8 +727,13 @@ def make_sharded_compact(
                                           t_kd),
                 terminal_cms=None,
             )
-            new, reclaimed = compact_feature_state(st, day, fcfg)
-            return (
+            out = compact_feature_state(st, day, fcfg,
+                                        demote_slots=demote_slots)
+            if demote_slots > 0:
+                new, reclaimed, payload = out
+            else:
+                new, reclaimed = out
+            parts = (
                 new.customer,
                 new.terminal,
                 jax.tree.map(lambda x: x[None], new.customer_dir)
@@ -718,6 +741,9 @@ def make_sharded_compact(
                 jax.tree.map(lambda x: x[None], new.terminal_dir),
                 reclaimed[None],  # [1, 2] → [n_dev, 2]
             )
+            if demote_slots > 0:
+                parts += (jax.tree.map(lambda x: x[None], payload),)
+            return parts
 
         row = P(axis, None)
         dev = P(axis)
@@ -729,14 +755,103 @@ def make_sharded_compact(
             P(),
         )
         out_specs = in_specs[:4] + (row,)
+        if demote_slots > 0:
+            out_specs += (_payload_spec(),)
         fn = compat_shard_map(local, mesh, in_specs, out_specs)
-        customer, terminal, c_kd, t_kd, reclaimed = fn(
+        outs = fn(
             fstate.customer, fstate.terminal,
             fstate.customer_dir if has_cdir else None,
             fstate.terminal_dir, now_day)
+        customer, terminal, c_kd, t_kd, reclaimed = outs[:5]
+        new_state = fstate._replace(
+            customer=customer, terminal=terminal,
+            customer_dir=c_kd if has_cdir else fstate.customer_dir,
+            terminal_dir=t_kd)
+        if demote_slots > 0:
+            return new_state, reclaimed, outs[5]
+        return new_state, reclaimed
+
+    return jax.jit(outer, donate_argnums=(0,))
+
+
+def make_sharded_promote(
+    cfg: Config,
+    mesh: Mesh,
+    axis: "str | Tuple[str, ...]" = "data",
+):
+    """Per-shard cold-tier promotion under ``shard_map`` — the sharded
+    twin of the single-chip ``("promote",)`` dispatch variant.
+
+    ``promote(fstate, payload) -> (fstate', stats [n_dev, 2, 2])``: the
+    engine groups promoted keys host-side by owner shard (the same
+    ``key % n_shards`` modulo the ingest router uses) and pads each
+    shard's block to the fixed ``K`` with ``EMPTY_KEY``, so every device
+    runs :func:`~..features.online.promote_rows` over ITS block and ITS
+    directory — purely local, zero collectives, one fixed shape. Stats
+    come back stacked per shard ([admitted, dropped] per table) for the
+    promotion counters.
+    """
+    from real_time_fraud_detection_system_tpu.features.online import (
+        promote_rows,
+    )
+    from real_time_fraud_detection_system_tpu.parallel.mesh import (
+        compat_shard_map,
+    )
+
+    fcfg = cfg.features
+    has_cdir = fcfg.customer_source != "cms"
+
+    def spec_like(tree, spec):
+        return jax.tree.map(lambda _: spec, tree)
+
+    def _payload_spec():
+        leaf = (P(axis, None),) + (P(axis, None, None),) * 4
+        return {
+            "customer": leaf if has_cdir else None,
+            "terminal": leaf,
+        }
+
+    def outer(fstate: FeatureState, payload):
+        def local(customer, terminal, c_kd, t_kd, pay):
+            st = FeatureState(
+                customer=customer, terminal=terminal, cms=None,
+                customer_dir=jax.tree.map(lambda x: jnp.squeeze(x, 0),
+                                          c_kd)
+                if c_kd is not None else None,
+                terminal_dir=jax.tree.map(lambda x: jnp.squeeze(x, 0),
+                                          t_kd),
+                terminal_cms=None,
+            )
+            new, stats = promote_rows(
+                st, jax.tree.map(lambda x: jnp.squeeze(x, 0), pay),
+                fcfg)
+            return (
+                new.customer,
+                new.terminal,
+                jax.tree.map(lambda x: x[None], new.customer_dir)
+                if new.customer_dir is not None else None,
+                jax.tree.map(lambda x: x[None], new.terminal_dir),
+                stats[None],  # [1, 2, 2] → [n_dev, 2, 2]
+            )
+
+        row = P(axis, None)
+        dev = P(axis)
+        in_specs = (
+            spec_like(fstate.customer, row),
+            spec_like(fstate.terminal, row),
+            spec_like(fstate.customer_dir, dev) if has_cdir else None,
+            spec_like(fstate.terminal_dir, dev),
+            _payload_spec(),
+        )
+        out_specs = in_specs[:4] + (P(axis, None, None),)
+        fn = compat_shard_map(local, mesh, in_specs, out_specs)
+        customer, terminal, c_kd, t_kd, stats = fn(
+            fstate.customer, fstate.terminal,
+            fstate.customer_dir if has_cdir else None,
+            fstate.terminal_dir, payload)
         return fstate._replace(
             customer=customer, terminal=terminal,
             customer_dir=c_kd if has_cdir else fstate.customer_dir,
-            terminal_dir=t_kd), reclaimed
+            terminal_dir=t_kd), stats
 
     return jax.jit(outer, donate_argnums=(0,))

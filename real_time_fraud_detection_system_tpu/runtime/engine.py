@@ -339,6 +339,18 @@ class ScoringEngine:
         self._m_tier = None
         self._m_slots_occ = None
         self._m_slots_rec = None
+        # Host cold tier (features.cold_store, key_mode="exact"): armed
+        # by _init_cold below; the defaults keep every shared-path
+        # getattr/None-check cheap for sequence/direct/hash engines.
+        self._cold = None  # io.coldstore.ColdStore
+        self._promoter = None  # io.coldstore.ColdPromoter
+        self._promote = None  # jitted features.online.promote_rows
+        self._demote_slots = 0
+        self._cold_pending = set()  # (table, key) enqueued, not landed
+        self._degraded_keys = set()  # served from CMS while cold/in-flight
+        self._cold_index = {}  # table -> sorted uint32 key snapshot
+        self._cold_index_version = -1
+        self._cold_synced = False
         if cfg.runtime.emit_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"emit_dtype must be float32|bfloat16, "
@@ -580,10 +592,21 @@ class ScoringEngine:
             from real_time_fraud_detection_system_tpu.features.online \
                 import compact_feature_state
 
+            # Cold tier armed: compaction DEMOTES pressure-evicted keys'
+            # rows into a fixed-shape payload (K = cold_demote_slots per
+            # table) instead of discarding — one static return arity per
+            # engine config, same principle as the exact 5-tuple step.
+            demote = (int(fcfg.cold_demote_slots)
+                      if getattr(fcfg, "cold_store", "") else 0)
+            self._demote_slots = demote
+
             def compact(fstate: FeatureState, now_day):
-                return compact_feature_state(fstate, now_day, fcfg)
+                return compact_feature_state(fstate, now_day, fcfg,
+                                             demote_slots=demote)
 
             self._compact = jax.jit(compact, donate_argnums=self._donate)
+            if demote:
+                self._init_cold(fcfg)
 
     def _init_telemetry(self, metrics) -> None:
         """Resolve the registry series ONCE at build time: the hot loop
@@ -768,6 +791,250 @@ class ScoringEngine:
                 "(state_hbm_budget_mb; 0 = unchecked)").set(
                 float(fcfg.state_hbm_budget_mb * 2 ** 20))
 
+    # -- host cold tier (features.cold_store) ------------------------------
+
+    def _cold_tables(self) -> tuple:
+        """Tables with a key directory (demotable/promotable)."""
+        if self.cfg.features.customer_source == "cms":
+            return ("terminal",)
+        return ("customer", "terminal")
+
+    def _init_cold(self, fcfg) -> None:
+        """Arm the host cold tier: the keyed store, the async promoter
+        thread, the jitted promote-merge step and its telemetry."""
+        from real_time_fraud_detection_system_tpu.features.online import (
+            promote_rows,
+        )
+        from real_time_fraud_detection_system_tpu.io.coldstore import (
+            ColdPromoter,
+            ColdStore,
+        )
+
+        self._cold = ColdStore(fcfg.cold_store,
+                               segment_mb=fcfg.cold_segment_mb)
+        self._promoter = ColdPromoter(self._cold,
+                                      depth=fcfg.cold_promote_queue)
+
+        def promote(fstate, payload):
+            return promote_rows(fstate, payload, fcfg)
+
+        self._promote = jax.jit(promote, donate_argnums=self._donate)
+        reg = self.metrics
+        self._m_cold_keys = reg.gauge(
+            "rtfds_feature_cold_keys",
+            "keys resident in the host cold tier (demoted, not yet "
+            "promoted back)")
+        self._m_cold_bytes = reg.gauge(
+            "rtfds_feature_cold_bytes",
+            "host bytes of live cold-tier segments + flush buffer")
+        self._m_cold_prom = reg.counter(
+            "rtfds_feature_cold_promotions_total",
+            "cold-tier keys promoted back into the hot tier")
+        self._m_cold_dem = reg.counter(
+            "rtfds_feature_cold_demotions_total",
+            "hot-tier keys demoted to the cold tier by compaction "
+            "pressure eviction")
+        self._m_cold_wait = reg.counter(
+            "rtfds_feature_cold_promote_wait_seconds_total",
+            "seconds between a returning key's promotion request and "
+            "its rows landing in the hot tier")
+        self._m_cold_backlog = reg.gauge(
+            "rtfds_feature_cold_promote_backlog",
+            "promotion requests enqueued or resolved but not yet "
+            "landed on device (overload-ladder pressure input)")
+        reg.gauge(
+            "rtfds_feature_cold_promote_queue_limit",
+            "bounded capacity of the cold promoter request queue "
+            "(features.cold_promote_queue)").set(
+            float(fcfg.cold_promote_queue))
+
+    def _note_cold_touches(self, cols: dict) -> None:
+        """Host-side returning-key detection: the host WROTE the cold
+        store, so it knows exactly which keys are cold — intersect the
+        batch's folded keys with a cached sorted snapshot of the cold
+        index (rebuilt only when the index mutates) and enqueue hits to
+        the promoter. No extra device output, no step-arity change, no
+        stall: the rows are served from CMS this batch (counted in
+        ``exactness_degraded_keys``) and converge to exact state when
+        the promotion lands."""
+        if self._cold is None:
+            return
+        ver = self._cold.version()
+        if ver != self._cold_index_version:
+            self._cold_index = {
+                t: self._cold.index_snapshot(t)
+                for t in self._cold_tables()}
+            self._cold_index_version = ver
+        from real_time_fraud_detection_system_tpu.core.batch import (
+            fold_key,
+        )
+
+        for table, col in (("customer", "customer_id"),
+                           ("terminal", "terminal_id")):
+            snap = self._cold_index.get(table)
+            if snap is None or not snap.size:
+                continue
+            ids = cols.get(col)
+            if ids is None or not len(ids):
+                continue
+            keys = fold_key(np.asarray(ids))
+            # the directory canonicalizes EMPTY_KEY collisions the same
+            # way (ops/keydir._canon) — mirror it or miss those keys
+            keys = np.where(keys == np.uint32(0xFFFFFFFF),
+                            np.uint32(0xFFFFFFFE), keys)
+            for k in np.unique(keys[np.isin(keys, snap)]):
+                ki = int(k)
+                self._degraded_keys.add((table, ki))
+                if (table, ki) in self._cold_pending:
+                    continue  # already in flight
+                if self._promoter.request(table, ki):
+                    self._cold_pending.add((table, ki))
+                # full queue: dropped — the key re-enqueues on its
+                # next touch (bounded backpressure, never unbounded)
+        self._m_cold_backlog.set(float(self._promoter.backlog()))
+
+    def _append_demotions(self, payload: dict) -> None:
+        """Land one compaction pass's demotion payload in the cold
+        store. Normalizes the sharded stacked ``[n_dev, K, ...]`` leaves
+        to flat rows; ``EMPTY_KEY`` lanes are skipped by the store. A
+        demoted key with a promotion in flight has that promotion
+        CANCELLED (its resolved rows pre-date this demotion): the next
+        touch re-detects and promotes the fresh rows."""
+        if self._cold is None:
+            return
+        total = 0
+        for table in ("customer", "terminal"):
+            pay = payload.get(table)
+            if pay is None:
+                continue
+            keys, bd, cnt, amt, frd = (np.asarray(x) for x in pay)
+            if keys.ndim > 1:  # sharded stacked payload
+                keys = keys.reshape(-1)
+                bd = bd.reshape(-1, bd.shape[-1])
+                cnt = cnt.reshape(-1, cnt.shape[-1])
+                amt = amt.reshape(-1, amt.shape[-1])
+                frd = frd.reshape(-1, frd.shape[-1])
+            total += self._cold.append(table, keys, bd, cnt, amt, frd)
+            for k in keys[keys != np.uint32(0xFFFFFFFF)]:
+                self._cold_pending.discard((table, int(k)))
+        if total:
+            self._m_cold_dem.inc(total)
+        self._m_cold_keys.set(float(self._cold.keys_count))
+        self._m_cold_bytes.set(float(self._cold.bytes))
+
+    def _build_promote_payload(self, rows_by_table: dict) -> dict:
+        """Resolved cold rows → the ONE fixed-shape promote payload the
+        compiled ``("promote",)`` signature accepts (``EMPTY_KEY``-padded
+        ``[K, ...]`` per present table). The sharded engine overrides
+        with owner-modulo-grouped ``[n_dev, K, ...]`` leaves."""
+        k = self._demote_slots
+        nb = self.cfg.features.n_day_buckets
+        tables = self._cold_tables()
+        payload = {}
+        for table in ("customer", "terminal"):
+            if table not in tables:
+                payload[table] = None
+                continue
+            keys = np.full((k,), 0xFFFFFFFF, np.uint32)
+            bd = np.full((k, nb), -1, np.int32)
+            cnt = np.zeros((k, nb), np.float32)
+            amt = np.zeros((k, nb), np.float32)
+            frd = np.zeros((k, nb), np.float32)
+            for i, (key, r) in enumerate(
+                    (rows_by_table.get(table) or {}).items()):
+                keys[i] = key
+                bd[i], cnt[i], amt[i], frd[i] = r
+            payload[table] = (keys, bd, cnt, amt, frd)
+        return payload
+
+    def _maybe_promote(self) -> None:
+        """Land resolved promotions between device steps (called once
+        per finished batch right after ``_maybe_compact`` — the same
+        single-threaded contract). Drains the promoter's ready queue up
+        to the payload width, dispatches the compiled ``("promote",)``
+        signature, and retires landed keys from the cold index."""
+        if self._promoter is None:
+            return
+        k = self._demote_slots
+        ready = self._promoter.poll_ready(max_items=k)
+        self._m_cold_backlog.set(float(self._promoter.backlog()))
+        if not ready:
+            return
+        rows_by_table: dict = {"customer": {}, "terminal": {}}
+        wait = 0.0
+        now = time.perf_counter()
+        for table, key, rows, t_enq in ready:
+            if (table, key) not in self._cold_pending:
+                continue  # cancelled (re-demoted mid-flight) or fenced
+            self._cold_pending.discard((table, key))
+            wait += now - t_enq
+            if rows is None:
+                continue  # corrupt/missing segment: stays on CMS, counted
+            rows_by_table[table][key] = rows
+        if wait > 0.0:
+            self._m_cold_wait.inc(wait)
+        if not any(rows_by_table.values()):
+            return
+        payload = self._build_promote_payload(rows_by_table)
+        with self.tracer.span("state_promote"):
+            with self._recompile.step(step_signature(
+                    static=(self.kind, "promote"))):
+                fstate, stats = self._dispatch_step(
+                    ("promote",), self._promote,
+                    self.state.feature_state, payload)
+        self.state.feature_state = fstate
+        st = np.asarray(stats).reshape(-1, 2, 2).sum(axis=0)
+        self._m_cold_prom.inc(int(st[:, 0].sum()))
+        for i, table in enumerate(("customer", "terminal")):
+            landed = list(rows_by_table[table])
+            if not landed:
+                continue
+            if int(st[i, 1]) == 0:
+                # every lane admitted: retire the keys from the index
+                # (stops re-detection; segment bytes stay until gc)
+                self._cold.mark_promoted(table, landed)
+            # else: the free list ran dry for some lane — keys stay
+            # cold and re-promote on their next touch (the merge is
+            # idempotent, so the already-admitted ones are harmless)
+        self._m_cold_keys.set(float(self._cold.keys_count))
+        self._m_cold_bytes.set(float(self._cold.bytes))
+
+    def drain_promotions(self, timeout_s: float = 10.0) -> bool:
+        """Block until every pending cold promotion has landed (test &
+        shutdown helper — never called from the serving loop). Returns
+        True when pending drained within the timeout."""
+        if self._promoter is None:
+            return True
+        t0 = time.perf_counter()
+        while self._cold_pending:
+            self._maybe_promote()
+            if not self._cold_pending:
+                break
+            if time.perf_counter() - t0 > timeout_s:
+                return False
+            # rtfdslint: disable=blocking-call-on-loop-thread (drain helper blocks BY CONTRACT; tests/shutdown only, never reachable from the serving loop)
+            time.sleep(0.005)
+        return True
+
+    def _sync_cold_after_restore(self) -> None:
+        """Adopt a restored checkpoint's cold lineage exactly once:
+        prune post-checkpoint segments (replay regenerates them —
+        exactly-once across the tier boundary), fence the promoter
+        generation, and drop in-flight pending state."""
+        if self._cold is None or self._cold_synced:
+            return
+        lineage = getattr(self.state, "cold_lineage", None)
+        if lineage is None:
+            return
+        self._cold_synced = True
+        self._cold.sync_to(lineage)
+        self._promoter.reset()
+        self._cold_pending.clear()
+        self._cold_index_version = -1
+        self._m_cold_keys.set(float(self._cold.keys_count))
+        self._m_cold_bytes.set(float(self._cold.bytes))
+        self._m_cold_backlog.set(0.0)
+
     def _note_batch_days(self, cols: dict) -> None:
         """Track the newest day the stream has seen — compaction's
         recency cutoff input (one vectorized max per batch)."""
@@ -796,9 +1063,14 @@ class ScoringEngine:
         with self.tracer.span("state_compact", day=self._max_day):
             with self._recompile.step(step_signature(
                     day, static=(self.kind, "compact"))):
-                fstate, reclaimed = self._dispatch_step(
+                out = self._dispatch_step(
                     ("compact",), self._compact,
                     self.state.feature_state, day)
+        if self._demote_slots:
+            fstate, reclaimed, payload = out
+            self._append_demotions(payload)
+        else:
+            fstate, reclaimed = out
         self.state.feature_state = fstate
         self._record_compaction(fstate, reclaimed)
 
@@ -822,6 +1094,15 @@ class ScoringEngine:
             else active_recorder()
         if recorder is not None:
             tiers = {t: m.value for t, m in (self._m_tier or {}).items()}
+            extra = {}
+            if self._cold is not None:
+                # cold-tier depth + promotion backlog ride the same
+                # flight event the dashboard Feature-store tile reads
+                extra = {
+                    "cold_keys": int(self._cold.keys_count),
+                    "cold_bytes": int(self._cold.bytes),
+                    "promote_backlog": int(self._promoter.backlog()),
+                }
             recorder.record_event(
                 "feature_state", reclaimed=rec_now,
                 occupied=sum(occupied.values()),
@@ -830,7 +1111,7 @@ class ScoringEngine:
                     for t in occupied),
                 dense_rows=tiers.get("dense", 0.0),
                 cms_rows=tiers.get("cms", 0.0),
-                batch=self.state.batches_done)
+                batch=self.state.batches_done, **extra)
 
     # -- AOT bucket precompilation ----------------------------------------
 
@@ -905,7 +1186,42 @@ class ScoringEngine:
                 emit_dtype=self.cfg.runtime.emit_dtype,
                 use_pallas=False,
             ))
+        if self._demote_slots:
+            # Cold-tier promotion landing is a compiled family member
+            # too: ONE shape (the full state + the EMPTY_KEY-padded
+            # [K, NB] payload per table), so an async promotion can land
+            # mid-stream without a recompile or a device stall.
+            sigs.append(DispatchSignature(
+                key=("promote",),
+                variant="promote",
+                kind=self.kind,
+                z_mode=None,
+                bucket=0,
+                donate=tuple(self._donate),
+                selective=False,
+                emit_dtype=self.cfg.runtime.emit_dtype,
+                use_pallas=False,
+            ))
         return sigs
+
+    def _promote_payload_sds(self) -> dict:
+        """Shape-only template of the promote payload (the sharded
+        engine overrides with its stacked per-shard layout)."""
+        k = self._demote_slots
+        nb = self.cfg.features.n_day_buckets
+        tables = self._cold_tables()
+
+        def tbl():
+            return (
+                jax.ShapeDtypeStruct((k,), jnp.uint32),
+                jax.ShapeDtypeStruct((k, nb), jnp.int32),
+                jax.ShapeDtypeStruct((k, nb), jnp.float32),
+                jax.ShapeDtypeStruct((k, nb), jnp.float32),
+                jax.ShapeDtypeStruct((k, nb), jnp.float32),
+            )
+
+        return {t: (tbl() if t in tables else None)
+                for t in ("customer", "terminal")}
 
     def signature_templates(self, sig: DispatchSignature) -> tuple:
         """Shape-only argument templates for ``sig`` — what
@@ -919,6 +1235,11 @@ class ScoringEngine:
                 self._sds(self.state.feature_state),
                 jax.ShapeDtypeStruct((), jnp.int32),
             )
+        if sig.variant == "promote":
+            return (
+                self._sds(self.state.feature_state),
+                self._promote_payload_sds(),
+            )
         return (
             self._sds(self.state.feature_state),
             self._sds(self.state.params),
@@ -928,10 +1249,13 @@ class ScoringEngine:
 
     def signature_step(self, sig: DispatchSignature):
         """The jitted callable ``sig`` dispatches to (one shared step
-        for the single-chip engine plus the compaction variant; the
-        sharded engine overrides with its per-variant builds)."""
+        for the single-chip engine plus the compaction/promotion
+        variants; the sharded engine overrides with its per-variant
+        builds)."""
         if sig.variant == "compact":
             return self._compact
+        if sig.variant == "promote":
+            return self._promote
         return self._step
 
     def precompile(self) -> dict:
@@ -1291,6 +1615,7 @@ class ScoringEngine:
             self.state.feature_state = fstate
             self.state.params = params
             self._note_batch_days(cols)
+            self._note_cold_touches(cols)
             # Start the D2H copies NOW (they queue behind the step's
             # compute): by the time _finish_batch blocks, the transfer
             # has been running since compute finished.
@@ -1475,6 +1800,7 @@ class ScoringEngine:
         self._m_rows.inc(n)
         self._m_last.set(time.time())
         self._maybe_compact()
+        self._maybe_promote()
         # Device-memory gauges ride the batch cadence; on backends
         # without memory stats (CPU) this is a single boolean check.
         self._devmem.sample()
@@ -1698,6 +2024,10 @@ class ScoringEngine:
         Returns run stats (rows, batches, throughput, latency percentiles).
         """
         self._ensure_layout()  # cross-width checkpoint restores convert
+        # Restored state carries cold-tier segment lineage: reconcile the
+        # host store to it (prune post-checkpoint segments, fence the
+        # promoter) BEFORE any batch can touch a demoted key.
+        self._sync_cold_after_restore()
         if self.cfg.runtime.precompile and not self._aot:
             # AOT bucket precompilation: every bucket size compiles NOW,
             # before the first poll — no first-touch compile ever lands
@@ -1803,6 +2133,7 @@ class ScoringEngine:
         rows0 = self.state.rows_done  # report THIS run's throughput, not
         batches0 = self.state.batches_done  # lifetime totals (warmup runs)
         ovf0 = self.selective_overflows
+        degraded0 = len(self._degraded_keys)
         from collections import deque
 
         # rtfdslint: disable=unbounded-queue (loop-local in-flight handle FIFO, drained to below pipeline_depth on every dispatch (`while len(q) >= depth: _finish`) — bounded at `depth` by construction; a maxlen would silently drop dispatched device work)
@@ -1925,6 +2256,13 @@ class ScoringEngine:
                 drain = getattr(sink, "drain", None)
                 if drain is not None:
                     drain()
+                if self._cold is not None:
+                    # Buffered demotions become durable segments NOW so
+                    # the lineage the checkpoint records is on disk, and
+                    # restore can rebuild the exact cold index from
+                    # manifests alone.
+                    self._cold.flush()
+                    self.state.cold_lineage = self._cold.lineage()
                 checkpointer.save(self.state)
                 # Broker-side offsets (sources that have them, e.g. Kafka)
                 # are committed only AFTER the framework checkpoint lands:
@@ -1935,6 +2273,12 @@ class ScoringEngine:
                     commit()
                 if feedback is not None:
                     feedback.commit()
+                if self._cold is not None:
+                    # Only after the checkpoint (and its offset commits)
+                    # landed is it safe to delete fully-promoted
+                    # segments: a crash before this point restores a
+                    # lineage that still lists them.
+                    self._cold.gc()
             # NOTE: trigger pacing used to sleep HERE, once per finished
             # handle — so _drain() stacked one sleep per queued batch
             # before every checkpoint/idle flush. Pacing now happens once
@@ -2133,6 +2477,12 @@ class ScoringEngine:
         sink_drain = getattr(sink, "drain", None)
         if sink_drain is not None:
             sink_drain()
+        if self._cold is not None:
+            # Land in-flight promotions and persist buffered demotions so
+            # the caller's follow-up save records fresh segment lineage.
+            self.drain_promotions()
+            self._cold.flush()
+            self.state.cold_lineage = self._cold.lineage()
         wall = time.perf_counter() - t_start
         cpu_s = time.process_time() - t_cpu0
         # LatencyTracker-backed snapshots: exact percentiles over the
@@ -2167,4 +2517,11 @@ class ScoringEngine:
             # fetches (correct output, just slower; recalibrate
             # emit_threshold or raise emit_cap_fraction)
             stats["selective_overflows"] = self.selective_overflows - ovf0
+        if self._cold is not None:
+            # Keys scored from the CMS sketch while their promotion was
+            # still in flight — the honest scope of the bit-identity
+            # claim. 0 means every returning key converged before it was
+            # touched again (or was never demoted).
+            stats["exactness_degraded_keys"] = (
+                len(self._degraded_keys) - degraded0)
         return stats

@@ -348,10 +348,17 @@ class ShardedScoringEngine(ScoringEngine):
             # key, same donation, per-shard reclaim counts out)
             from real_time_fraud_detection_system_tpu.parallel.step import (
                 make_sharded_compact,
+                make_sharded_promote,
             )
 
-            self._compact = make_sharded_compact(cfg, self.mesh,
-                                                 axis=self.axis)
+            self._compact = make_sharded_compact(
+                cfg, self.mesh, axis=self.axis,
+                demote_slots=self._demote_slots)
+            if self._demote_slots:
+                # and the promote-merge's sharded twin: owner-grouped
+                # payload blocks, purely shard-local admission
+                self._promote = make_sharded_promote(cfg, self.mesh,
+                                                     axis=self.axis)
 
     # -- per-shard feature-state telemetry ---------------------------------
 
@@ -458,6 +465,15 @@ class ShardedScoringEngine(ScoringEngine):
             else active_recorder()
         if recorder is not None:
             tiers = {t: m.value for t, m in (self._m_tier or {}).items()}
+            extra = {}
+            if self._cold is not None:
+                # cold-tier depth + promotion backlog ride the same
+                # flight event the dashboard Feature-store tile reads
+                extra = {
+                    "cold_keys": int(self._cold.keys_count),
+                    "cold_bytes": int(self._cold.bytes),
+                    "promote_backlog": int(self._promoter.backlog()),
+                }
             recorder.record_event(
                 "feature_state", reclaimed=int(rec.sum()),
                 occupied=sum(occupied.values()),
@@ -465,7 +481,61 @@ class ShardedScoringEngine(ScoringEngine):
                 occupied_per_shard=occupied_per_shard,
                 dense_rows=tiers.get("dense", 0.0),
                 cms_rows=tiers.get("cms", 0.0),
-                batch=self.state.batches_done)
+                batch=self.state.batches_done, **extra)
+
+    # -- cold tier over the mesh -------------------------------------------
+
+    def _promote_payload_sds(self) -> dict:
+        """Stacked per-shard promote-payload template: ``[n_dev, K]``
+        keys / ``[n_dev, K, NB]`` rows per present table (the shard_map
+        splits the leading device axis)."""
+        k = self._demote_slots
+        nb = self.cfg.features.n_day_buckets
+        n = self.n_dev
+        tables = self._cold_tables()
+
+        def tbl():
+            return (
+                jax.ShapeDtypeStruct((n, k), jnp.uint32),
+                jax.ShapeDtypeStruct((n, k, nb), jnp.int32),
+                jax.ShapeDtypeStruct((n, k, nb), jnp.float32),
+                jax.ShapeDtypeStruct((n, k, nb), jnp.float32),
+                jax.ShapeDtypeStruct((n, k, nb), jnp.float32),
+            )
+
+        return {t: (tbl() if t in tables else None)
+                for t in ("customer", "terminal")}
+
+    def _build_promote_payload(self, rows_by_table: dict) -> dict:
+        """Owner-modulo-grouped promote payload: key ``k`` lands in
+        shard ``k % n_dev``'s lane block — the same stable modulo the
+        ingest partitioner and the owner exchange route by, so a key
+        demoted by shard *i* promotes back into shard *i*'s directory.
+        ``poll_ready(max_items=K)`` bounds total keys at the per-shard
+        lane width, so even a fully-skewed ready set fits one block."""
+        k = self._demote_slots
+        nb = self.cfg.features.n_day_buckets
+        n = self.n_dev
+        tables = self._cold_tables()
+        payload = {}
+        for table in ("customer", "terminal"):
+            if table not in tables:
+                payload[table] = None
+                continue
+            keys = np.full((n, k), 0xFFFFFFFF, np.uint32)
+            bd = np.full((n, k, nb), -1, np.int32)
+            cnt = np.zeros((n, k, nb), np.float32)
+            amt = np.zeros((n, k, nb), np.float32)
+            frd = np.zeros((n, k, nb), np.float32)
+            fill = [0] * n
+            for key, r in (rows_by_table.get(table) or {}).items():
+                s = int(key) % n
+                i = fill[s]
+                fill[s] = i + 1
+                keys[s, i] = key
+                bd[s, i], cnt[s, i], amt[s, i], frd[s, i] = r
+            payload[table] = (keys, bd, cnt, amt, frd)
+        return payload
 
     # -- sharding upkeep ---------------------------------------------------
 
@@ -639,6 +709,22 @@ class ShardedScoringEngine(ScoringEngine):
                 emit_dtype=self.cfg.runtime.emit_dtype,
                 use_pallas=False,
             ))
+        if self._demote_slots:
+            # Cold-tier promotion over the mesh: ONE fixed shape (the
+            # sharded state + the owner-grouped [n_dev, K, ...] payload
+            # blocks) — enumerated so warmup compiles it and a returning
+            # key can never pay a mid-stream compile.
+            sigs.append(DispatchSignature(
+                key=("promote",),
+                variant="promote",
+                kind=self.kind,
+                z_mode=None,
+                bucket=0,
+                donate=(0,),
+                selective=False,
+                emit_dtype=self.cfg.runtime.emit_dtype,
+                use_pallas=False,
+            ))
         return sigs
 
     def _ensure_step(self, routed: bool):
@@ -674,6 +760,8 @@ class ShardedScoringEngine(ScoringEngine):
         lower/trace of this callable IS the serving program."""
         if sig.variant == "compact":
             return self._compact
+        if sig.variant == "promote":
+            return self._promote
         return self._ensure_step(sig.variant == "sharded-routed")
 
     def precompile(self) -> dict:
@@ -874,6 +962,7 @@ class ShardedScoringEngine(ScoringEngine):
         # notify compaction's recency cutoff (the base engine does this
         # in its own _start_batch; the sharded path overrides it wholesale)
         self._note_batch_days(cols)
+        self._note_cold_touches(cols)
         return handle
 
     def _finish_batch(self, handle: dict) -> BatchResult:

@@ -803,6 +803,29 @@ class MetricsServer:
                     fstate["budget_bytes"] = budget.value
                     fstate["budget_used"] = round(
                         sb.value / budget.value, 4)
+            # Host cold tier (features.cold_store): depth, promotion
+            # traffic and the promoter backlog — present only once an
+            # engine armed the cold store, so two-tier runs keep the
+            # block absent rather than zero-filled.
+            ck = self.registry.get("rtfds_feature_cold_keys")
+            if ck is not None:
+                cold: Dict[str, float] = {"keys": ck.value}
+                for name, key in (
+                        ("rtfds_feature_cold_bytes", "bytes"),
+                        ("rtfds_feature_cold_promotions_total",
+                         "promotions"),
+                        ("rtfds_feature_cold_demotions_total",
+                         "demotions"),
+                        ("rtfds_feature_cold_promote_wait_seconds_total",
+                         "promote_wait_seconds"),
+                        ("rtfds_feature_cold_promote_backlog",
+                         "promote_backlog"),
+                        ("rtfds_feature_cold_promote_queue_limit",
+                         "promote_queue_limit")):
+                    m = self.registry.get(name)
+                    if m is not None:
+                        cold[key] = m.value
+                fstate["cold"] = cold
             extras["feature_state"] = fstate
         # Device plane: the z-contraction mode the serving step compiled
         # with and whether the fused Pallas path is on — present only

@@ -238,3 +238,139 @@ def test_state_smoke_sharded():
         str(s) for s in range(N_DEV)}
     assert fs["worst_shard"]["occupied"] == max(
         fs["slots_occupied_per_shard"].values())
+
+
+class _ScriptedSource:
+    """Deterministic pre-built batches (the cold cell needs exact
+    eviction → re-touch choreography, not a Zipf draw)."""
+
+    def __init__(self, batches):
+        self._batches = list(batches)
+        self._i = 0
+
+    def poll_batch(self):
+        if self._i >= len(self._batches):
+            return None
+        b = self._batches[self._i]
+        self._i += 1
+        return {k: v.copy() for k, v in b.items()}
+
+    @property
+    def offsets(self):
+        return [self._i]
+
+    def seek(self, offsets):
+        self._i = int(offsets[0])
+
+
+def _cold_cols(cust, term, day):
+    cust = np.asarray(cust, np.int64)
+    term = np.asarray(term, np.int64)
+    n = len(cust)
+    us = (day * 86400 + np.arange(n) % 86400).astype(np.int64) * 1_000_000
+    return {
+        "tx_id": np.arange(n, dtype=np.int64),
+        "tx_datetime_us": us,
+        "customer_id": cust,
+        "terminal_id": term,
+        "tx_amount_cents": np.full(n, 1234, np.int64),
+        "kafka_ts_ms": us // 1000,
+    }
+
+
+def test_state_smoke_cold(tmp_path):
+    """The cold-tier cell: an oversubscribed hot tier demotes under
+    pressure, evicted keys are forcibly re-touched (served degraded,
+    promoted async), and the promotion traffic is EXACT — counters
+    equal the host-computed cold∩ping intersection, with the
+    ``("promote",)`` signature in the precompiled inventory and zero
+    mid-stream recompiles."""
+    from real_time_fraud_detection_system_tpu.core.batch import fold_key
+
+    cfg = Config(
+        features=FeatureConfig(
+            key_mode="exact",
+            customer_capacity=128,
+            terminal_capacity=128,
+            cms_width=1 << 12,
+            compact_every=2,
+            cold_store=str(tmp_path / "cold"),
+            cold_demote_slots=16,
+            cold_highwater=0.25,
+            cold_promote_queue=64,
+        ),
+        runtime=RuntimeConfig(batch_buckets=(64,), max_batch_rows=64,
+                              precompile=True),
+    )
+    reg = MetricsRegistry()
+    eng = ScoringEngine(
+        cfg, kind="logreg", params=init_logreg(15),
+        scaler=Scaler(mean=np.zeros(15, np.float32),
+                      scale=np.ones(15, np.float32)),
+        metrics=reg)
+
+    # the promote variant joins compact in the precompiled inventory
+    keys = [s.key for s in eng.dispatch_inventory()]
+    assert ("compact",) in keys and ("promote",) in keys
+    a = np.arange(0, 48)
+    b = np.arange(1000, 1032)
+    demote_phase = [
+        _cold_cols(a, a + 10000, DAY0),
+        _cold_cols(a, a + 10000, DAY0),
+        _cold_cols(b, b + 10000, DAY0 + 2),
+        _cold_cols(b, b + 10000, DAY0 + 3),
+        _cold_cols(b, b + 10000, DAY0 + 4),
+    ]
+    sink = _LineageSink()
+    stats1 = eng.run(_ScriptedSource(demote_phase), sink=sink)
+    assert stats1["batches"] == len(demote_phase)
+    assert reg.get("rtfds_feature_cold_demotions_total").value > 0
+    assert reg.get("rtfds_feature_cold_keys").value > 0
+
+    # host-computed ground truth: which pinged keys are actually cold
+    expected = 0
+    ping_c, ping_t = a[:16], a[:16] + 10000
+    for table, ids in (("customer", ping_c), ("terminal", ping_t)):
+        snap = eng._cold.index_snapshot(table)
+        folded = fold_key(np.asarray(ids))
+        expected += int(np.isin(folded, snap).sum())
+    assert expected > 0, "the ping must hit demoted keys"
+
+    # ping: evicted keys return — run() drains promotions before exit
+    stats2 = eng.run(
+        _ScriptedSource([_cold_cols(ping_c, ping_t, DAY0 + 5)]),
+        sink=sink)
+    assert stats2["batches"] == 1
+
+    # promotion traffic is EXACT: every cold∩ping key was served
+    # degraded once, promoted exactly once, and landed
+    assert reg.get(
+        "rtfds_feature_cold_promotions_total").value == expected
+    assert stats2["exactness_degraded_keys"] == expected
+    assert reg.get(
+        "rtfds_feature_cold_promote_backlog").value == 0
+    wait = reg.get("rtfds_feature_cold_promote_wait_seconds_total")
+    assert wait is not None and wait.value >= 0.0
+
+    # zero mid-stream recompiles / AOT fallbacks across BOTH runs
+    rc = reg.get("rtfds_xla_recompiles_total")
+    assert rc is None or rc.value == 0, "mid-stream recompile"
+    assert reg.get("rtfds_aot_fallbacks_total").value == 0
+    assert reg.get("rtfds_precompiled_steps_total").value == len(keys)
+
+    # gap/dup-free sink lineage across the demote + ping runs
+    assert sink.indices == list(range(1, len(demote_phase) + 2))
+
+    # /healthz surfaces the cold block with these numbers
+    from real_time_fraud_detection_system_tpu.utils.metrics import (
+        MetricsServer,
+    )
+
+    _, body = MetricsServer(registry=reg).health()
+    cold = body["feature_state"]["cold"]
+    assert cold["keys"] == reg.get("rtfds_feature_cold_keys").value
+    assert cold["promotions"] == expected
+    assert cold["demotions"] == reg.get(
+        "rtfds_feature_cold_demotions_total").value
+    assert cold["promote_queue_limit"] == 64
+    assert cold["promote_backlog"] == 0

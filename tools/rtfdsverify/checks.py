@@ -90,6 +90,12 @@ class AotCoverageCheck:
             # single-chip AND sharded (the mesh engine swaps in the
             # shard_map'd per-shard pass under the same key)
             expected.add(("compact",))
+            if getattr(fcfg, "cold_store", ""):
+                # engine.py::_maybe_promote lands resolved cold-tier
+                # promotions under this key between device steps (same
+                # single-chip/sharded split as compact) — a returning
+                # key must never pay a mid-stream compile
+                expected.add(("promote",))
         for key in sorted(expected - set(keys), key=str):
             out.append(_f(
                 self.name, "P0", target,
