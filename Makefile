@@ -148,6 +148,17 @@ state-smoke:
 multihost-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_multihost_smoke.py -q
 
+# elastic-fleet gate: the autoscaler grows a live 1-process fleet to 2
+# under sustained rung-2 pressure (drain -> exact merge -> committed
+# topology -> relaunch) with the stream covered exactly once across
+# the resize, shrinks 2 -> 1 on sustained idle through the same seam,
+# rolls back to the pre-resize fleet under injected chaos (worker
+# SIGKILL mid-drain; crash-pre-relaunch and torn-manifest cells run
+# with -m slow), zero mid-stream recompiles in every generation, and
+# ownership floors provably drop already-scored rows on re-poll
+elastic-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_elastic_smoke.py -q
+
 # continuous-learning gate: champion serves, the streaming learner
 # trains a candidate on injected labeled feedback, the shadow's live
 # recall overtakes the champion's, promotion fires, an injected
@@ -197,4 +208,4 @@ install:
 clean:
 	rm -rf $(OUT)
 
-.PHONY: demo datagen train score run-all query dashboard connectors dryrun trace-demo bench perf-smoke chaos-smoke recovery-smoke overload-smoke state-smoke learn-smoke multihost-smoke lint-static verify-static test integration integration-up integration-down sqlcheck install clean
+.PHONY: demo datagen train score run-all query dashboard connectors dryrun trace-demo bench perf-smoke chaos-smoke recovery-smoke overload-smoke state-smoke learn-smoke multihost-smoke elastic-smoke lint-static verify-static test integration integration-up integration-down sqlcheck install clean

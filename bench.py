@@ -1485,6 +1485,25 @@ def _child_main(args) -> None:
         multihost_scaling = {
             "error": f"{type(e).__name__}: {str(e)[:160]}"}
 
+    # ---- elastic spike absorption (detail.elastic_absorb) --------------
+    # ROADMAP item 4's proof: one 10x replay backlog driven into an
+    # autoscaled fleet (--autoscale, real resize 1→2 mid-stream through
+    # drain → merge → commit → relaunch) vs the identical fixed
+    # 1-process control. Claims come from artifacts the fleets wrote:
+    # rtfds_fleet_resizes_total{outcome=completed}==1 from the
+    # launcher's registry snapshot, time-to-absorb from
+    # rtfds_spike_absorb_seconds, exactly-once in both arms.
+    _progress("elastic absorb (autoscaled vs fixed fleet)")
+    elastic_absorb = None
+    try:
+        elastic_absorb = _run_cpu_mesh_tool(
+            "elastic_absorb_bench.py",
+            ["--quick"] if (args.quick or on_cpu) else [],
+            timeout_s=1200.0, label="elastic absorb running")
+    except Exception as e:
+        elastic_absorb = {
+            "error": f"{type(e).__name__}: {str(e)[:160]}"}
+
     # ---- CPU sklearn baseline (the reference-equivalent predict_proba) --
     # Measured at the headline batch size, capped at 65,536 rows per call
     # to bound a single predict_proba's cost; sklearn RF throughput is
@@ -1561,6 +1580,8 @@ def _child_main(args) -> None:
         detail["sharded_state_scale"] = sharded_state_scale
     if multihost_scaling is not None:
         detail["multihost_scaling"] = multihost_scaling
+    if elastic_absorb is not None:
+        detail["elastic_absorb"] = elastic_absorb
 
     # Registry snapshot beside the headline (ROADMAP PR-1 note): the
     # engine loops above populated rtfds_phase_seconds / rtfds_batch_

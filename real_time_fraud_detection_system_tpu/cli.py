@@ -390,6 +390,162 @@ def _make_model_reloader(path: str, kind: str, every_batches: int, log,
     return poll
 
 
+def _resume_merge_adopt(make_engine, ckpt, cfg, topology, spec,
+                        cold_srcs, log):
+    """Adopt a drained old-generation fleet's final checkpoints into
+    THIS worker's own (empty) checkpoint lineage — the retopologize leg
+    of an elastic fleet resize.
+
+    ``spec`` is the parsed ``--resume-merge`` tuple ``(src_root, old_p,
+    old_l, reason)``. Every old process's final checkpoint restores
+    into a template state, the per-process feature states merge through
+    :func:`parallel.mesh.merge_process_states` (checkpointed terminal-
+    CMS partials are locals-only, so same-day shard sums stay exact),
+    old cold-store generations consolidate into this worker's cold dir,
+    and ONE single-chip global checkpoint lands in this worker's
+    lineage with the stream cursor rewound to the fleet-wide minimum
+    floor. Per-old-owner floors ride in a ``resize_epochs`` record so
+    re-polled rows another old process already sank are dropped at
+    ingest (:class:`runtime.OwnershipFloorSource`) — no row lost, none
+    double-scored. Idempotent: a worker relaunched after its merge
+    already landed re-reads the floors from its newest manifest instead
+    of re-merging.
+
+    Returns the per-old-owner floor list (possibly empty = no floor
+    filtering needed) or ``None`` on failure — the caller exits rc 2,
+    because serving without the merged state would break exactly-once.
+    """
+    import copy as _copy
+
+    from real_time_fraud_detection_system_tpu.io.checkpoint import (
+        make_checkpointer,
+    )
+    from real_time_fraud_detection_system_tpu.parallel.mesh import (
+        merge_process_states,
+    )
+
+    src_root, old_p, old_l, reason = spec
+    latest = ckpt.latest()
+    if latest is not None:
+        # Crash AFTER the merge committed: this worker's lineage already
+        # starts from the merged state — re-merging would clobber
+        # progress. The floors live in the stamped resize epoch.
+        try:
+            meta = (ckpt.manifest(latest) or {}).get("meta") or {}
+        # rtfdslint: disable=broad-exception-catch (an unreadable tip manifest here only degrades the floor filter; restore itself re-verifies and falls back down the lineage)
+        except Exception:
+            meta = {}
+        epochs = meta.get("resize_epochs") or []
+        if epochs:
+            rec = epochs[-1]
+            log.info("resume-merge: lineage already merged (epoch %s, "
+                     "%s->%s); resuming from it",
+                     len(epochs), rec.get("from_processes"),
+                     rec.get("to_processes"))
+            return [int(f) for f in rec.get("floors", [])]
+        log.warning("resume-merge: %s already has ordinary checkpoints; "
+                    "skipping the merge and resuming from them", latest)
+        return []
+    tmpl = make_engine()
+    eng_l = int(getattr(tmpl.state, "layout_devices", 1) or 1)
+    if old_l != eng_l:
+        log.error("--resume-merge: old fleet served %d device(s) per "
+                  "process but this worker serves %d — resize the "
+                  "process count at fixed width, then change width "
+                  "separately (the per-process reshard path)",
+                  old_l, eng_l)
+        return None
+    states, floors, rows_done = [], [], 0
+    prior_epochs: list = []
+    model_version = None
+    for pid in range(old_p):
+        src_dir = (os.path.join(src_root, f"proc-{pid:02d}")
+                   if old_p > 1 else src_root)
+        try:
+            src = make_checkpointer(
+                src_dir,
+                op_timeout_s=cfg.runtime.checkpoint_op_timeout_s,
+                op_attempts=cfg.runtime.checkpoint_op_attempts)
+        # rtfdslint: disable=broad-exception-catch (any backend open failure means the old generation's state is unreachable — report and refuse, whatever the type)
+        except Exception as e:
+            log.error("resume-merge: cannot open old checkpoints at "
+                      "%s: %s", src_dir, e)
+            return None
+        st = _copy.deepcopy(tmpl.state)
+        st.process_count, st.process_id = old_p, pid
+        restored = src.restore(st)
+        if restored is None:
+            log.error("resume-merge: old process %d has no restorable "
+                      "checkpoint under %s — a resize must drain to a "
+                      "final checkpoint first", pid, src_dir)
+            return None
+        if len(restored.offsets) > 1:
+            log.error("resume-merge: old process %d carries %d stream "
+                      "cursors; only single-cursor sources resize "
+                      "(broker fleets keep per-partition offsets)",
+                      pid, len(restored.offsets))
+            return None
+        # no cursor at all = the process drained before its first poll
+        # (a resize can land during warmup): its floor is stream start
+        floors.append(int(restored.offsets[0]) if restored.offsets
+                      else 0)
+        rows_done += int(restored.rows_done)
+        if model_version is None:
+            model_version = getattr(restored, "model_version", None)
+        if not prior_epochs:
+            prior_epochs = list(
+                getattr(restored, "resize_epochs", None) or [])
+        states.append(restored.feature_state)
+    try:
+        merged_fs = merge_process_states(states, cfg, [old_l] * old_p)
+    except ValueError as e:
+        log.error("resume-merge: %s", e)
+        return None
+    out = _copy.deepcopy(tmpl.state)
+    out.feature_state = merged_fs
+    out.offsets = [min(floors)]
+    out.batches_done = 0  # fresh per-generation sink lineage
+    out.rows_done = rows_done
+    out.layout_devices = 1
+    out.process_count = 1  # global state; restore re-slices per process
+    out.process_id = 0
+    out.model_version = model_version
+    new_p = topology.n_processes if topology is not None else 1
+    out.resize_epochs = prior_epochs + [{
+        "epoch": len(prior_epochs) + 1,
+        "from_processes": old_p,
+        "to_processes": new_p,
+        "old_local_devices": old_l,
+        "reason": reason,
+        "floors": floors,
+        "min_offset": min(floors),
+    }]
+    if cold_srcs:
+        from real_time_fraud_detection_system_tpu.io.coldstore import (
+            ColdStoreCorruptError,
+            consolidate_cold_stores,
+        )
+
+        try:
+            dest = consolidate_cold_stores(
+                cold_srcs, cfg.features.cold_store,
+                segment_mb=cfg.features.cold_segment_mb)
+        except (OSError, ValueError, ColdStoreCorruptError) as e:
+            log.error("resume-merge: cold-store consolidation failed: "
+                      "%s", e)
+            return None
+        out.cold_lineage = dest.lineage()
+        log.info("resume-merge: consolidated %d cold generation(s) "
+                 "into %s (%d keys)", len(cold_srcs),
+                 cfg.features.cold_store,
+                 int(out.cold_lineage.get("total_keys", 0)))
+    saved = ckpt.save(out)
+    log.info("resume-merge: adopted %d-process generation at %s -> %s "
+             "(floors %s, min offset %d, reason %r)",
+             old_p, src_root, saved, floors, min(floors), reason)
+    return floors
+
+
 def cmd_score(args) -> int:
     from real_time_fraud_detection_system_tpu.config import Config
     from real_time_fraud_detection_system_tpu.io import make_parquet_sink
@@ -885,6 +1041,109 @@ def cmd_score(args) -> int:
             dead_letter=dead_letter,
         )
 
+    ckpt_dir, out_path, raw_path = (args.checkpoint_dir, args.out,
+                                    args.raw_table)
+    if topology is not None:
+        # Shard-aware durable state: each process owns its residue
+        # block's lineage under proc-NN/ of the shared roots (same
+        # paths across restarts, so --resume finds the right block; a
+        # topology change is refused at restore with the merge path
+        # named). Sink parts split the same way — per-process
+        # batch_index lineages stay individually gap/dup-free — and so
+        # does the cold tier (two processes appending segments into one
+        # directory would collide on segment seq numbers).
+        sub = f"proc-{topology.process_id:02d}"
+        ckpt_dir = os.path.join(ckpt_dir, sub) if ckpt_dir else ckpt_dir
+        out_path = os.path.join(out_path, sub) if out_path else out_path
+        raw_path = os.path.join(raw_path, sub) if raw_path else raw_path
+        if cfg.features.cold_store:
+            cfg = cfg.replace(features=_dc.replace(
+                cfg.features,
+                cold_store=os.path.join(cfg.features.cold_store, sub)))
+    ckpt = make_checkpointer(
+        ckpt_dir,
+        full_every=cfg.runtime.checkpoint_full_every,
+        op_timeout_s=cfg.runtime.checkpoint_op_timeout_s,
+        op_attempts=cfg.runtime.checkpoint_op_attempts,
+    ) if ckpt_dir else None
+
+    # --- elastic-fleet seams (tools/multihost_launcher.py --autoscale) --
+    drain_ev = None
+    if args.drain_on_sigterm:
+        import signal as _signal
+        import threading as _threading
+
+        drain_ev = _threading.Event()
+        # idempotent: repeated SIGTERMs keep the same drain in flight;
+        # the engine breaks at the NEXT batch boundary (no batch is
+        # abandoned mid-flight, offsets stay behind durable output)
+        _signal.signal(_signal.SIGTERM,
+                       lambda _sig, _frm: drain_ev.set())
+        log.info("drain-on-sigterm armed: SIGTERM = coordinated drain "
+                 "to a final checkpoint, not a kill")
+    cms_exchange = None
+    if args.cms_exchange and topology is None:
+        # Not an error: an elastic fleet passes uniform worker args and
+        # legitimately shrinks to one process, where local terminal
+        # aggregates are already global.
+        log.info("--cms-exchange idle: single-process terminal "
+                 "aggregates are already global")
+    elif args.cms_exchange:
+        from real_time_fraud_detection_system_tpu.runtime import (
+            SketchExchange,
+        )
+
+        cms_exchange = SketchExchange(
+            args.cms_exchange, topology.process_id,
+            topology.n_processes)
+        log.info("terminal-sketch exchange: %s (fleet-wide merge at "
+                 "checkpoint boundaries, locals-only partials in "
+                 "checkpoints)", args.cms_exchange)
+    if drain_ev is not None or cms_exchange is not None:
+        _make_engine_plain = make_engine
+
+        def make_engine():
+            eng = _make_engine_plain()
+            eng.stop_event = drain_ev
+            eng.cms_exchange = cms_exchange
+            return eng
+
+    resume_floors = None
+    merge_old_p = merge_old_l = 0
+    if args.resume_merge:
+        try:
+            src_root, p_s, l_s, merge_reason = \
+                args.resume_merge.rsplit(":", 3)
+            merge_old_p, merge_old_l = int(p_s), int(l_s)
+            if not src_root or merge_old_p < 1 or merge_old_l < 1:
+                raise ValueError(args.resume_merge)
+        except ValueError:
+            log.error("--resume-merge wants OLD_CKPT_ROOT:P:L:REASON, "
+                      "got %r", args.resume_merge)
+            return 2
+        bad = None
+        if ckpt is None:
+            bad = "--resume-merge requires --checkpoint-dir"
+        elif not args.resume:
+            bad = ("--resume-merge requires --resume (the merged "
+                   "checkpoint is what this worker resumes from)")
+        elif args.source == "kafka":
+            bad = ("--resume-merge does not apply to --source kafka "
+                   "(broker fleets carry per-partition offsets through "
+                   "a resize; no single-cursor merge is needed)")
+        elif args.resume_merge_cold and not cfg.features.cold_store:
+            bad = "--resume-merge-cold requires --cold-store"
+        if bad:
+            log.error(bad)
+            return 2
+        resume_floors = _resume_merge_adopt(
+            make_engine, ckpt, cfg, topology,
+            (src_root, merge_old_p, merge_old_l, merge_reason),
+            [d for d in args.resume_merge_cold.split(",") if d],
+            log)
+        if resume_floors is None:
+            return 2
+
     source_factory = None
     if args.source == "kafka":
         from real_time_fraud_detection_system_tpu.runtime.sources import (
@@ -942,6 +1201,19 @@ def cmd_score(args) -> int:
             mode=args.mode,
             with_labels=args.online_lr > 0,
         )
+    if resume_floors and len(set(resume_floors)) > 1:
+        # Post-merge resume with DIVERGED old-process cursors: drop
+        # re-polled rows the further-ahead old owners already sank.
+        # Inside the affine wrap below — floors index the shared
+        # stream's positions, pre-slicing.
+        from real_time_fraud_detection_system_tpu.runtime import (
+            OwnershipFloorSource,
+        )
+
+        source = OwnershipFloorSource(source, resume_floors,
+                                      merge_old_p, merge_old_l)
+        log.info("per-owner resume floors active: %s (pure passthrough "
+                 "past position %d)", resume_floors, max(resume_floors))
     if topology is not None and args.source != "kafka":
         # Residue-sliced ingest for partition-less sources: this process
         # serves only its owned customer residues of the shared stream
@@ -976,25 +1248,6 @@ def cmd_score(args) -> int:
 
         source = PrefetchSource(source, max_batches=depth)
         log.info("source prefetch on (queue depth %d)", depth)
-    ckpt_dir, out_path, raw_path = (args.checkpoint_dir, args.out,
-                                    args.raw_table)
-    if topology is not None:
-        # Shard-aware durable state: each process owns its residue
-        # block's lineage under proc-NN/ of the shared roots (same
-        # paths across restarts, so --resume finds the right block; a
-        # topology change is refused at restore with the merge path
-        # named). Sink parts split the same way — per-process
-        # batch_index lineages stay individually gap/dup-free.
-        sub = f"proc-{topology.process_id:02d}"
-        ckpt_dir = os.path.join(ckpt_dir, sub) if ckpt_dir else ckpt_dir
-        out_path = os.path.join(out_path, sub) if out_path else out_path
-        raw_path = os.path.join(raw_path, sub) if raw_path else raw_path
-    ckpt = make_checkpointer(
-        ckpt_dir,
-        full_every=cfg.runtime.checkpoint_full_every,
-        op_timeout_s=cfg.runtime.checkpoint_op_timeout_s,
-        op_attempts=cfg.runtime.checkpoint_op_attempts,
-    ) if ckpt_dir else None
     sink = make_parquet_sink(out_path) if out_path else None
     raw_table = None
     if args.raw_table:
@@ -1135,6 +1388,23 @@ def cmd_score(args) -> int:
                     model_reload=make_reloader() if make_reloader else None,
                     learning=learning,
                 )
+                if drain_ev is not None and ckpt is not None:
+                    # Drain-armed worker: run() ended (SIGTERM break OR
+                    # natural stream end) at a batch boundary with the
+                    # sink drained and cold lineage refreshed — pin the
+                    # FINAL checkpoint to that exact frontier so a
+                    # resize merge resumes gap/dup-free (deferred/shed
+                    # rows sit behind these offsets by the overload
+                    # defer contract and re-poll under the new fleet; a
+                    # stale cadence checkpoint would replay rows the
+                    # sink already holds).
+                    ckpt.save(engine.checkpoint_state())
+                    if drain_ev.is_set():
+                        stats["drained_at_batch"] = \
+                            engine.state.batches_done
+                        log.info("coordinated drain complete: final "
+                                 "checkpoint at batch %d",
+                                 engine.state.batches_done)
     finally:
         close = getattr(source, "close", None)
         if close is not None:
@@ -1416,6 +1686,11 @@ def cmd_ckpt(args) -> int:
             "layout_devices": ld,
             "fleet_shards_total": pc * ld,
         }}
+        if meta.get("resize_epochs"):
+            # Elastic-resize lineage from the manifest alone: every
+            # fleet P→P′ this state lived through, with the per-old-
+            # owner resume floors that made the transition exact.
+            man = {**man, "resize_epochs": meta["resize_epochs"]}
         print(_json_line({"path": args.inspect, **man}))
         return 0
     # listing stays cheap (one read per entry); only --verify pays for
@@ -2447,6 +2722,43 @@ def main(argv=None) -> int:
                         "(original-typed error propagation after "
                         "exhaustion; 1 = no retry)")
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--drain-on-sigterm", action="store_true",
+                   help="SIGTERM stops the stream at the next batch "
+                        "boundary instead of killing the process: "
+                        "in-flight batches finish, the sink drains, "
+                        "and a final checkpoint lands at that exact "
+                        "frontier — the coordinated-drain leg of an "
+                        "elastic fleet resize (deferred/shed rows stay "
+                        "behind the committed offsets for the next "
+                        "topology to re-poll)")
+    p.add_argument("--resume-merge", default="",
+                   help="OLD_CKPT_ROOT:P:L:REASON — adopt a drained "
+                        "P-process fleet's final checkpoints (under "
+                        "proc-NN/ of the root, or the root itself when "
+                        "P=1, each written at L devices/process) into "
+                        "this worker's --checkpoint-dir before "
+                        "serving: states merge to one global "
+                        "checkpoint, the stream cursor rewinds to the "
+                        "fleet-wide minimum with per-old-owner resume "
+                        "floors (no row lost, none double-scored), and "
+                        "a resize epoch is stamped into the lineage "
+                        "(`rtfds ckpt --inspect` surfaces it). "
+                        "Idempotent: skipped when this worker's "
+                        "lineage already has a checkpoint. Requires "
+                        "--resume; not for --source kafka")
+    p.add_argument("--resume-merge-cold", default="",
+                   help="comma-separated old-generation cold-store "
+                        "directories to consolidate into --cold-store "
+                        "during --resume-merge (restore then re-homes "
+                        "ownership to the new topology)")
+    p.add_argument("--cms-exchange", default="",
+                   help="shared directory for cross-process terminal-"
+                        "sketch exchange at checkpoint boundaries: "
+                        "terminal risk aggregates (NOT co-partitioned "
+                        "by the customer-residue ingest split) merge "
+                        "fleet-wide under the newest-day rule, while "
+                        "checkpoints keep locals-only partials so "
+                        "resize merges stay exact (multi-host only)")
     p.add_argument("--max-batches", type=int, default=0)
     p.add_argument("--online-lr", type=float, default=0.0)
     p.add_argument("--max-restarts", type=int, default=0,

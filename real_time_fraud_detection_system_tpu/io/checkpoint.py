@@ -192,6 +192,15 @@ def _state_arrays(engine_state) -> Tuple[dict, dict]:
         # boundary) and `rtfds ckpt --inspect` surfaces the cold plane
         # from the manifest alone.
         meta["cold_lineage"] = cl
+    re = getattr(engine_state, "resize_epochs", None)
+    if re:
+        # Elastic-fleet lineage: one record per fleet resize this state
+        # has lived through (generation, from/to process counts, reason,
+        # per-old-owner resume floors). `rtfds ckpt --inspect` surfaces
+        # the resize history from the manifest alone, and a restored
+        # worker re-derives its OwnershipFloorSource floors from the
+        # newest record.
+        meta["resize_epochs"] = re
     return arrays, meta
 
 
@@ -263,6 +272,8 @@ def _apply_arrays(engine_state, meta: dict, arrays: dict):
     # champion-pointer mismatch err toward re-applying the champion
     if meta.get("cold_lineage") is not None:
         engine_state.cold_lineage = meta["cold_lineage"]
+    if meta.get("resize_epochs") is not None:
+        engine_state.resize_epochs = meta["resize_epochs"]
     return engine_state
 
 

@@ -551,6 +551,43 @@ def _cluster_tile(events: List[dict], man: dict):
     return ("Cluster", f"{n_proc} proc", " · ".join(sub_bits))
 
 
+def _elasticity_tile(events: List[dict], man: dict):
+    """Elasticity tile (autoscaled fleets): every resize the launcher
+    walked — completed, rolled back (and at which phase), the last
+    topology change and how long it took. None unless the record
+    carries resize events or the manifest says the run was autoscaled,
+    so fixed fleets keep a clean tile row."""
+    begins = [e for e in events if e.get("event") == "resize_begin"]
+    completes = [e for e in events
+                 if e.get("event") == "resize_complete"]
+    rollbacks = [e for e in events
+                 if e.get("event") == "resize_rollback"]
+    autoscaled = bool((man.get("multihost") or {}).get("autoscale"))
+    if not (begins or completes or rollbacks or autoscaled):
+        return None
+    sub_bits = []
+    if rollbacks:
+        stages = sorted({str(e.get("stage", "?")) for e in rollbacks})
+        sub_bits.append(f"{len(rollbacks)} rolled back "
+                        f"at {'/'.join(stages)}")
+    if completes:
+        last = completes[-1]
+        sub_bits.append(
+            f"last {last.get('direction', '?')} -> "
+            f"{last.get('processes', '?')} proc in "
+            f"{float(last.get('seconds', 0.0) or 0.0):.1f}s "
+            f"(gen {last.get('generation', '?')})")
+    elif begins:
+        last = begins[-1]
+        sub_bits.append(f"last attempt {last.get('current', '?')} -> "
+                        f"{last.get('target', '?')}")
+    if not sub_bits:
+        sub_bits.append("no resizes: pressure never held a dwell")
+    value = (f"{len(completes)} resize(s)" if not rollbacks
+             else f"{len(completes)} ok / {len(rollbacks)} back")
+    return ("Elasticity", value, " · ".join(sub_bits))
+
+
 def render_ops_html(
     manifest: Optional[dict],
     records: List[dict],
@@ -589,15 +626,20 @@ def render_ops_html(
         # explain the death) — render them even with no batch records.
         # A launcher flight record is batch-less by construction: its
         # Cluster tile still renders.
-        cluster = _cluster_tile(events, man)
-        if cluster is not None:
-            label, value, sub = cluster
-            subdiv = f"<div class='sub'>{_esc(sub)}</div>" if sub else ""
-            parts.append(
-                "<div class='tiles'><div class='tile'>"
-                f"<div class='lbl'>{_esc(label)}</div>"
-                f"<div class='num'>{_esc(value)}</div>{subdiv}"
-                "</div></div>")
+        lead_tiles = [t for t in (_cluster_tile(events, man),
+                                  _elasticity_tile(events, man))
+                      if t is not None]
+        if lead_tiles:
+            cells = []
+            for label, value, sub in lead_tiles:
+                subdiv = (f"<div class='sub'>{_esc(sub)}</div>"
+                          if sub else "")
+                cells.append(
+                    "<div class='tile'>"
+                    f"<div class='lbl'>{_esc(label)}</div>"
+                    f"<div class='num'>{_esc(value)}</div>{subdiv}"
+                    "</div>")
+            parts.append(f"<div class='tiles'>{''.join(cells)}</div>")
         parts.append("<p class='empty'>no batch records</p>")
         if events:
             t0 = float(events[0].get("t", 0.0))
@@ -781,6 +823,9 @@ def render_ops_html(
     cluster = _cluster_tile(events, man)
     if cluster is not None:
         tiles.append(cluster)
+    elasticity = _elasticity_tile(events, man)
+    if elasticity is not None:
+        tiles.append(elasticity)
     tile_html = []
     for label, value, sub in tiles:
         subdiv = f"<div class='sub'>{_esc(sub)}</div>" if sub else ""

@@ -1,11 +1,23 @@
 from real_time_fraud_detection_system_tpu.runtime.sources import (  # noqa: F401
     InProcBroker,
     KafkaSource,
+    OwnershipFloorSource,
     PartitionAffineSource,
     RawTableSource,
     ReplaySource,
     SyntheticSource,
     make_kafka_source,
+)
+from real_time_fraud_detection_system_tpu.runtime.elastic import (  # noqa: F401
+    ClusterSignals,
+    ElasticConfig,
+    ElasticPolicy,
+    ResizeFsm,
+    fleet_metrics,
+    signals_from_snapshots,
+)
+from real_time_fraud_detection_system_tpu.runtime.cms_exchange import (  # noqa: F401
+    SketchExchange,
 )
 from real_time_fraud_detection_system_tpu.runtime.distributed import (  # noqa: F401
     ProcessTopology,

@@ -1,0 +1,298 @@
+"""Host-side cross-process exchange of terminal CMS aggregates.
+
+The fleet partitions the stream by ``customer_id`` residue, so customer
+state is co-partitioned — every key's full history lives on exactly one
+process, and the P→1 checkpoint merge sums disjoint contributions
+exactly. Terminal ids are NOT co-partitioned: one terminal's traffic
+spreads across the whole fleet, so each process's serving
+``terminal_cms`` holds only a PARTIAL view of any terminal's counts.
+This module closes that gap at checkpoint/resize boundaries without a
+network dependency: each process publishes its cumulative LOCAL
+contributions as an atomically-renamed npz partial next to the shared
+checkpoint root, adopts whatever peer partials are present under the
+same newest-day rule as :func:`~..parallel.mesh._merge_sketch`, and —
+critically — checkpoints ALWAYS store the partial (locals-only) form, so
+``merge_process_states``'s same-day SUM over per-process sketches stays
+exact no matter how stale any exchange round was. Resize exactness is
+therefore independent of exchange timing; the exchange only improves
+SERVING freshness between resizes.
+
+The accounting invariant that makes this safe is an overlay ``O`` of
+adopted peer content per process:
+
+- serving logical sketch  ``S = locals ⊕ O`` (newest-day semantics)
+- published partial       ``P_self = S ⊖ O``  (locals only, cumulative)
+- after a merge M of all partials: install ``M`` into the serving
+  sketch and set ``O' = M ⊖ P_self`` — published partials stay
+  locals-only forever, so any process may merge any vintage of any
+  peer's file at any time (a stale file just means slightly stale peer
+  counts until the next round).
+
+``⊖`` is day-guarded subtraction: counts subtract only where slice days
+match; a newer-day slice is taken whole (the older content was — or
+would have been — zeroed by the ring). On a stacked multi-shard sketch
+the peer content is installed into SHARD 0 only: the logical merge over
+shards sums same-day shards, so replicating peer content across shards
+would multiply it (the warm-start inflation ``_merge_sketch``
+documents). Single-local-device fleets — the elastic smoke topology —
+serve the full merged view; with more local devices, shards 1+ keep
+serving locals-only partials for sketch-tier reads, exactly the
+pre-exchange behavior.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from real_time_fraud_detection_system_tpu.utils.metrics import get_registry
+
+
+class _Logical(NamedTuple):
+    """Single-layout host-side sketch view: days [ND], tables
+    [ND, depth, width] (fraud optional)."""
+
+    days: np.ndarray
+    count: np.ndarray
+    amount: np.ndarray
+    fraud: Optional[np.ndarray]
+
+
+def _logical_of(cms) -> _Logical:
+    """Newest-day merge of a (possibly [n_shards]-stacked) sketch into
+    one single-layout numpy view — the host mirror of
+    :func:`~..parallel.mesh._merge_sketch`."""
+    days = np.asarray(cms.slice_day)
+    count = np.asarray(cms.count)
+    amount = np.asarray(cms.amount)
+    fraud = None if cms.fraud is None else np.asarray(cms.fraud)
+    if days.ndim == 1:
+        return _Logical(days.copy(), count.copy(), amount.copy(),
+                        None if fraud is None else fraud.copy())
+    max_day = days.max(axis=0)
+    fresh = (days == max_day[None]).astype(count.dtype)[..., None, None]
+    return _Logical(
+        max_day,
+        (count * fresh).sum(axis=0),
+        (amount * fresh).sum(axis=0),
+        None if fraud is None else (fraud * fresh).sum(axis=0))
+
+
+def _subtract(a: _Logical, b: _Logical) -> _Logical:
+    """Day-guarded ``a ⊖ b``: subtract counts where slice days match,
+    keep ``a`` whole where its day is newer (``b``'s older content was
+    retired by the ring). ``b`` newer than ``a`` cannot arise from this
+    module's invariants (``a`` is always a superset merge) and reads as
+    no-subtraction."""
+    sub = (a.days == b.days)[..., None, None].astype(a.count.dtype)
+
+    def tbl(x, y):
+        if x is None:
+            return None
+        return x - (y * sub if y is not None else 0.0)
+
+    return _Logical(a.days.copy(), tbl(a.count, b.count),
+                    tbl(a.amount, b.amount), tbl(a.fraud, b.fraud))
+
+
+def _merge(parts) -> _Logical:
+    """Newest-day merge over logical sketches: per slice, take the
+    newest day stamp and SUM the holders (disjoint locals-only partials
+    make same-day sums exact)."""
+    days = np.stack([p.days for p in parts])
+    max_day = days.max(axis=0)
+    fresh = (days == max_day[None]).astype(parts[0].count.dtype)
+
+    def tbl(name):
+        first = getattr(parts[0], name)
+        if first is None:
+            return None
+        return sum(getattr(p, name) * fresh[i][..., None, None]
+                   for i, p in enumerate(parts))
+
+    return _Logical(max_day, tbl("count"), tbl("amount"), tbl("fraud"))
+
+
+def _is_zero(lg: Optional[_Logical]) -> bool:
+    return lg is None or bool((lg.days < 0).all())
+
+
+class SketchExchange:
+    """One process's half of the file-based terminal-CMS exchange.
+
+    ``root`` is a directory shared by the fleet (next to the checkpoint
+    root). :meth:`exchange` publishes this process's partial and merges
+    peers'; :meth:`checkpoint_cms` strips adopted peer content back out
+    so the checkpointed sketch is locals-only. ``timeout_s`` bounds how
+    long a round waits for missing peer files — rounds that merge a
+    subset count as ``outcome="partial"`` and the next round catches
+    up (published partials are cumulative)."""
+
+    def __init__(self, root: str, process_id: int, n_processes: int,
+                 timeout_s: float = 2.0):
+        self.root = root
+        self.process_id = int(process_id)
+        self.n_processes = int(n_processes)
+        self.timeout_s = float(timeout_s)
+        os.makedirs(root, exist_ok=True)
+        self._seq = 0
+        self._overlay: Optional[_Logical] = None
+        reg = get_registry()
+        self._m_rounds = {
+            o: reg.counter(
+                "rtfds_cms_exchange_rounds_total",
+                "terminal-sketch exchange rounds (merged = every peer "
+                "partial present; partial = some peers missing within "
+                "the timeout — cumulative partials make the next round "
+                "catch up)", outcome=o)
+            for o in ("merged", "partial")}
+
+    # -- wire format -------------------------------------------------------
+
+    def _path(self, pid: int) -> str:
+        return os.path.join(self.root, f"cms-p{pid:02d}.npz")
+
+    def _publish(self, part: _Logical) -> None:
+        tmp = self._path(self.process_id) + ".tmp"
+        payload = {"seq": np.int64(self._seq), "days": part.days,
+                   "count": part.count, "amount": part.amount}
+        if part.fraud is not None:
+            payload["fraud"] = part.fraud
+        with open(tmp, "wb") as f:
+            np.savez(f, **payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._path(self.process_id))
+
+    def _load_peer(self, pid: int) -> Optional[_Logical]:
+        try:
+            with np.load(self._path(pid)) as z:
+                return _Logical(z["days"], z["count"], z["amount"],
+                                z["fraud"] if "fraud" in z.files else None)
+        except (OSError, ValueError, KeyError):
+            return None
+
+    # -- rounds ------------------------------------------------------------
+
+    def exchange(self, cms) -> Optional[_Logical]:
+        """Run one exchange round against the serving sketch ``cms``
+        (the engine's ``terminal_cms`` pytree). Returns the merged
+        logical view to install (via :func:`install_logical`), or None
+        when there is nothing to adopt (single process, or no peer
+        content yet)."""
+        self._seq += 1
+        local = _logical_of(cms)
+        p_self = local if self._overlay is None \
+            else _subtract(local, self._overlay)
+        self._publish(p_self)
+        peers = [p for p in range(self.n_processes)
+                 if p != self.process_id]
+        parts = {self.process_id: p_self}
+        deadline = time.monotonic() + self.timeout_s
+        while True:
+            for p in peers:
+                if p not in parts:
+                    got = self._load_peer(p)
+                    if got is not None:
+                        parts[p] = got
+            if len(parts) > self.n_processes - 1 or \
+                    time.monotonic() >= deadline:
+                break
+            time.sleep(0.02)
+        complete = len(parts) == self.n_processes
+        self._m_rounds["merged" if complete else "partial"].inc()
+        if len(parts) == 1:
+            # nothing from any peer: serving state is already exact
+            # locals (overlay unchanged — prior adoptions still stand)
+            return None
+        merged = _merge(list(parts.values()))
+        self._overlay = _subtract(merged, p_self)
+        return merged
+
+    def checkpoint_cms(self, cms):
+        """The locals-only form of the serving sketch for a checkpoint:
+        adopted peer content (the overlay) subtracted back out of shard
+        0 — the shard it was installed into. Returns None when no peer
+        content was ever adopted (checkpoint the state as-is)."""
+        if _is_zero(self._overlay):
+            return None
+        days = np.asarray(cms.slice_day)
+        if days.ndim == 1:
+            part = _subtract(_logical_of(cms), self._overlay)
+            return cms._replace(
+                slice_day=part.days.astype(days.dtype),
+                count=part.count, amount=part.amount, fraud=part.fraud)
+        shard0 = _Logical(
+            days[0], np.asarray(cms.count)[0], np.asarray(cms.amount)[0],
+            None if cms.fraud is None else np.asarray(cms.fraud)[0])
+        part = _subtract(shard0, self._overlay)
+
+        def put0(stack, new):
+            if stack is None:
+                return None
+            out = np.asarray(stack).copy()
+            out[0] = new
+            return out
+
+        return cms._replace(
+            slice_day=put0(days, part.days.astype(days.dtype)),
+            count=put0(cms.count, part.count),
+            amount=put0(cms.amount, part.amount),
+            fraud=None if cms.fraud is None else put0(cms.fraud,
+                                                      part.fraud))
+
+
+def install_logical(cms, merged: _Logical):
+    """Install a merged logical view into the serving sketch layout.
+
+    Unstacked sketches adopt the merged view wholesale. Stacked
+    ([n_shards]-leading) sketches put the whole merged view in SHARD 0
+    and retire other shards' stale slices (day < merged day → zeroed at
+    the merged day, mirroring what the ring would have done had that
+    day's traffic reached the shard); same-day content on shards 1+ is
+    already counted inside ``merged``, so shard 0 holds ``merged`` MINUS
+    those shards' same-day contributions to keep the cross-shard sum
+    exact. Returns numpy leaves; the caller re-places them on device."""
+    days = np.asarray(cms.slice_day)
+    if days.ndim == 1:
+        return cms._replace(
+            slice_day=merged.days.astype(days.dtype),
+            count=merged.count.astype(np.asarray(cms.count).dtype),
+            amount=merged.amount.astype(np.asarray(cms.amount).dtype),
+            fraud=None if cms.fraud is None else merged.fraud)
+
+    n = days.shape[0]
+    new_days = days.copy()
+    count = np.asarray(cms.count).copy()
+    amount = np.asarray(cms.amount).copy()
+    fraud = None if cms.fraud is None else np.asarray(cms.fraud).copy()
+    stale = days < merged.days[None]  # [n, ND]
+    for d in range(1, n):
+        idx = np.where(stale[d])[0]
+        if idx.size:
+            new_days[d, idx] = merged.days[idx]
+            count[d, idx] = 0.0
+            amount[d, idx] = 0.0
+            if fraud is not None:
+                fraud[d, idx] = 0.0
+    # shard 0 := merged ⊖ (same-day content living on shards 1+), so the
+    # cross-shard same-day SUM reproduces exactly ``merged``
+    same = (new_days[1:] == merged.days[None]).astype(
+        merged.count.dtype)[..., None, None]
+    rest = _Logical(
+        merged.days,
+        (count[1:] * same).sum(axis=0),
+        (amount[1:] * same).sum(axis=0),
+        None if fraud is None else (fraud[1:] * same).sum(axis=0))
+    shard0 = _subtract(merged, _Logical(merged.days, rest.count,
+                                        rest.amount, rest.fraud))
+    new_days[0] = merged.days
+    count[0] = shard0.count
+    amount[0] = shard0.amount
+    if fraud is not None:
+        fraud[0] = shard0.fraud
+    return cms._replace(slice_day=new_days.astype(days.dtype),
+                        count=count, amount=amount, fraud=fraud)

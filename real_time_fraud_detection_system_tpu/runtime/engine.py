@@ -15,6 +15,7 @@ and baseline measurement.
 
 from __future__ import annotations
 
+import copy
 import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
@@ -351,6 +352,14 @@ class ScoringEngine:
         self._cold_index = {}  # table -> sorted uint32 key snapshot
         self._cold_index_version = -1
         self._cold_synced = False
+        # Elastic-fleet seams (armed by the CLI, None everywhere else):
+        # a threading.Event the launcher's coordinated drain sets via
+        # SIGTERM — run() breaks at the next batch boundary with offsets
+        # resumable — and the cross-process terminal-sketch exchange
+        # (runtime.cms_exchange.SketchExchange) run at checkpoint
+        # cadence.
+        self.stop_event = None
+        self.cms_exchange = None
         if cfg.runtime.emit_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"emit_dtype must be float32|bfloat16, "
@@ -1028,12 +1037,84 @@ class ScoringEngine:
             return
         self._cold_synced = True
         self._cold.sync_to(lineage)
+        topo = getattr(self, "topology", None)
+        if topo is not None and topo.n_processes > 1:
+            # Fleet resize seam: the adopted lineage may carry keys the
+            # NEW topology homes elsewhere (a consolidated shrink-merge
+            # store fanned back out, or a grown fleet adopting a
+            # 1-process store). Cold keys are hot-tier directory keys —
+            # already residue-foldable — so prune to this process's
+            # residue block; the owning peer promotes the rest from ITS
+            # copy of the store.
+            dropped = self._cold.rehome(lambda _t, ks: topo.owns(ks))
+            if dropped:
+                from real_time_fraud_detection_system_tpu.utils import (
+                    get_logger,
+                )
+
+                get_logger("engine").info(
+                    "cold tier re-homed for process %d/%d: dropped %d "
+                    "foreign key(s)", topo.process_id,
+                    topo.n_processes, dropped)
         self._promoter.reset()
         self._cold_pending.clear()
         self._cold_index_version = -1
         self._m_cold_keys.set(float(self._cold.keys_count))
         self._m_cold_bytes.set(float(self._cold.bytes))
         self._m_cold_backlog.set(0.0)
+
+    def checkpoint_state(self) -> EngineState:
+        """The state a checkpoint save should persist. With a terminal-
+        sketch exchange armed this strips adopted PEER content back out
+        of ``terminal_cms`` (checkpoints always store the locals-only
+        partial form, so the P→1 resize merge's same-day sketch SUM
+        stays exact regardless of exchange timing); otherwise it is
+        ``self.state`` itself. Dynamic lineage attrs (cold_lineage,
+        resize_epochs) ride along on the shallow copy."""
+        xch = self.cms_exchange
+        fs = self.state.feature_state
+        if xch is None or fs is None or fs.terminal_cms is None:
+            return self.state
+        partial = xch.checkpoint_cms(fs.terminal_cms)
+        if partial is None:
+            return self.state
+        view = copy.copy(self.state)
+        view.feature_state = fs._replace(terminal_cms=partial)
+        return view
+
+    def _maybe_exchange_cms(self) -> None:
+        """Run one terminal-sketch exchange round (checkpoint cadence,
+        between device steps): publish this process's cumulative local
+        contributions, adopt whatever peer partials are present, and
+        install the merged view back into the serving state with each
+        leaf re-placed under its original sharding."""
+        xch = self.cms_exchange
+        fs = self.state.feature_state
+        if xch is None or fs is None or fs.terminal_cms is None:
+            return
+        from real_time_fraud_detection_system_tpu.runtime.cms_exchange \
+            import install_logical
+
+        merged = xch.exchange(fs.terminal_cms)
+        if merged is None:
+            return
+        new_cms = install_logical(fs.terminal_cms, merged)
+
+        def _place(old, new):
+            if new is None or old is None:
+                return None
+            arr = jnp.asarray(np.asarray(new), dtype=old.dtype)
+            sharding = getattr(old, "sharding", None)
+            return jax.device_put(arr, sharding) if sharding is not None \
+                else arr
+
+        self.state.feature_state = fs._replace(
+            terminal_cms=new_cms._replace(
+                slice_day=_place(fs.terminal_cms.slice_day,
+                                 new_cms.slice_day),
+                count=_place(fs.terminal_cms.count, new_cms.count),
+                amount=_place(fs.terminal_cms.amount, new_cms.amount),
+                fraud=_place(fs.terminal_cms.fraud, new_cms.fraud)))
 
     def _note_batch_days(self, cols: dict) -> None:
         """Track the newest day the stream has seen — compaction's
@@ -2263,7 +2344,8 @@ class ScoringEngine:
                     # manifests alone.
                     self._cold.flush()
                     self.state.cold_lineage = self._cold.lineage()
-                checkpointer.save(self.state)
+                self._maybe_exchange_cms()
+                checkpointer.save(self.checkpoint_state())
                 # Broker-side offsets (sources that have them, e.g. Kafka)
                 # are committed only AFTER the framework checkpoint lands:
                 # they trail it, never lead, so a crash replays — never
@@ -2350,6 +2432,15 @@ class ScoringEngine:
                 heartbeat.beat()
             started = self.state.batches_done + len(q)
             if max_batches and started >= max_batches:
+                capped = True
+                break
+            if self.stop_event is not None and self.stop_event.is_set():
+                # Coordinated drain (fleet resize / graceful SIGTERM):
+                # stop at a batch boundary with the capped-run tail —
+                # deferred/shed batches stay behind the checkpointed
+                # offsets by the defer() contract, so the caller's final
+                # checkpoint resumes them exactly-once under the next
+                # topology instead of force-draining them here.
                 capped = True
                 break
             if trigger > 0 and t_last_start is not None:

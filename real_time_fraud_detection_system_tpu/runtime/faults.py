@@ -843,7 +843,7 @@ def _run_poison_isolation(engine, source, sink, checkpointer, dead_letter,
     drain = getattr(sink, "drain", None) if sink is not None else None
     if drain is not None:
         drain()
-    checkpointer.save(engine.state)
+    checkpointer.save(engine.checkpoint_state())
     commit = getattr(source, "commit", None)
     if commit is not None:
         commit()
@@ -1067,8 +1067,11 @@ def run_with_recovery(
                     max_batches=max_batches, feedback=feedback,
                     model_reload=model_reload, learning=learning,
                 )
-            # Final checkpoint so a clean exit never replays.
-            checkpointer.save(engine.state)
+            # Final checkpoint so a clean exit never replays. The
+            # checkpoint VIEW (not raw state): with a terminal-sketch
+            # exchange armed it strips adopted peer content so resize
+            # merges sum disjoint per-process partials exactly.
+            checkpointer.save(engine.checkpoint_state())
             commit = getattr(source, "commit", None)
             if commit is not None:
                 commit()

@@ -189,6 +189,15 @@ class OverloadController:
             "rtfds_source_lag_trend_rows_per_s",
             "EMA slope of rtfds_source_lag_rows (negative = the backlog "
             "is draining)")
+        # Raw normalized pressure (the max over components the ladder
+        # judges), exported for the elastic autoscaler: the launcher's
+        # policy watches the worst-process value alongside the rung —
+        # the rung says what the ladder DID, the pressure says how far
+        # past (or under) the thresholds the process is running.
+        self._m_pressure = reg.gauge(
+            "rtfds_overload_pressure",
+            "normalized overload pressure (max component; >= "
+            "climb threshold sustains a rung climb, autoscaler input)")
 
     # -- signals -----------------------------------------------------------
 
@@ -249,6 +258,7 @@ class OverloadController:
 
     def _evaluate(self, include_latency: bool) -> None:
         pressure, comps = self._pressure(include_latency)
+        self._m_pressure.set(pressure)
         if pressure >= self.ocfg.climb_pressure:
             self._descend_streak = 0
             self._climb_streak += 1

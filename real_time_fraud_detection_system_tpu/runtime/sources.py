@@ -501,6 +501,94 @@ class PartitionAffineSource(_SourceTelemetry):
             close()
 
 
+class OwnershipFloorSource(_SourceTelemetry):
+    """Per-old-owner resume floors after a fleet SHRINK merge.
+
+    When P processes merge into P′ < P, each old process p had its own
+    stream cursor; the merged checkpoint can only carry ONE offset, the
+    MINIMUM of the per-process floors (anything earlier is scored by
+    everyone). Rows between that minimum and old-owner p's floor were
+    already scored and sunk by p — re-scoring them would duplicate
+    ``tx_id``s in the global sink. This wrapper re-derives each polled
+    row's OLD owner (the pre-resize residue block over ``customer_id``)
+    and drops the row iff its global stream position is still below that
+    owner's floor; once the cursor passes ``max(floors)`` it is pure
+    passthrough. Sits INSIDE any :class:`PartitionAffineSource` (floors
+    are positions in the shared stream, so they must be applied before
+    the new topology's residue filter re-indexes nothing — the affine
+    wrapper drops rows without advancing positions).
+
+    Single-cursor sources only (columnar replay / synthetic / raw-table:
+    ``offsets == [pos]``); a broker-partitioned fleet carries per-
+    partition committed offsets through the resize instead and never
+    needs this wrapper.
+    """
+
+    def __init__(self, inner, floors: Sequence[int], old_processes: int,
+                 old_local_devices: int):
+        from real_time_fraud_detection_system_tpu.runtime.distributed import (
+            _fold_u32,
+        )
+
+        if len(inner.offsets) != 1:
+            raise ValueError(
+                "OwnershipFloorSource requires a single-cursor inner "
+                f"source, got {len(inner.offsets)} offsets")
+        if len(floors) != old_processes:
+            raise ValueError(
+                f"{len(floors)} floors for {old_processes} old processes")
+        self.inner = inner
+        self.floors = np.asarray([int(f) for f in floors], dtype=np.int64)
+        self._hi = int(self.floors.max())
+        self._fold = _fold_u32
+        self._n_total = old_processes * old_local_devices
+        self._l = old_local_devices
+        self._init_source_metrics("floor")
+        self._m_floor_skipped = get_registry().counter(
+            "rtfds_resume_floor_skipped_rows_total",
+            "rows dropped on resume because the pre-resize owner "
+            "process had already scored them (per-owner resume floors "
+            "after a fleet shrink merge)")
+
+    def poll_batch(self) -> Optional[dict]:
+        t0 = time.perf_counter()
+        pos = int(self.inner.offsets[0])  # global position of next row
+        cols = self.inner.poll_batch()
+        n = 0 if cols is None else len(next(iter(cols.values()), ()))
+        if n and pos < self._hi:
+            owner = (self._fold(np.asarray(
+                cols["customer_id"], dtype=np.uint32))
+                % np.uint32(self._n_total)).astype(np.int64) // self._l
+            keep = (pos + np.arange(n, dtype=np.int64)) >= self.floors[owner]
+            n_skip = int((~keep).sum())
+            if n_skip:
+                self._m_floor_skipped.inc(n_skip)
+                cols = {k: v[keep] for k, v in cols.items()}
+        self._observe_poll(t0, cols)
+        return cols
+
+    @property
+    def offsets(self) -> List[int]:
+        return list(self.inner.offsets)
+
+    def seek(self, offsets: Sequence[int]) -> None:
+        self._m_seeks.inc()
+        self.inner.seek(offsets)
+
+    def commit(self, offsets: Optional[Sequence[int]] = None) -> None:
+        commit = getattr(self.inner, "commit", None)
+        if commit is not None:
+            if offsets is None:
+                commit()
+            else:
+                commit(offsets=offsets)
+
+    def close(self) -> None:
+        close = getattr(self.inner, "close", None)
+        if close is not None:
+            close()
+
+
 def raise_for_kafka_error(ck, err) -> bool:
     """Shared poll-error policy for all Kafka consumers in this runtime.
 

@@ -1,0 +1,222 @@
+"""Cross-process terminal-sketch exchange: fleet serving sketches and
+checkpointed partials must both reconcile EXACTLY with a single-process
+control. Terminal ids are not co-partitioned (one terminal's traffic
+spreads across the fleet), so these tests drive two processes' sketches
+with disjoint row subsets of one stream, exchange through the real
+file protocol, and compare bit-for-bit against a control sketch that
+saw every row — including through the repo's own ``_merge_sketch``
+newest-day rule, the exact function a resize merge applies."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from real_time_fraud_detection_system_tpu.ops.cms import (
+    CountMinSketch,
+    cms_init,
+    cms_update,
+)
+from real_time_fraud_detection_system_tpu.parallel.mesh import _merge_sketch
+from real_time_fraud_detection_system_tpu.runtime.cms_exchange import (
+    SketchExchange,
+    _logical_of,
+    install_logical,
+)
+
+DEPTH, WIDTH, ND = 2, 64, 8
+
+
+def _stream(seed: int, n: int, n_days: int = 3):
+    """Whole-cent amounts and small day range: every float sum below is
+    integer-exact, so equality assertions are bit-level, not approx."""
+    r = np.random.default_rng(seed)
+    return {
+        "term": r.integers(0, 50, n).astype(np.uint32),
+        "amount": (r.integers(1, 500, n) * 1.0).astype(np.float32),
+        "day": r.integers(0, n_days, n).astype(np.int32),
+        "fraud": (r.random(n) < 0.1).astype(np.float32),
+    }
+
+
+def _apply(sk, rows, sel):
+    return cms_update(
+        sk, jnp.asarray(rows["term"][sel]),
+        jnp.asarray(rows["amount"][sel]), jnp.asarray(rows["day"][sel]),
+        jnp.ones(int(sel.sum()) if sel.dtype == bool else len(sel),
+                 dtype=bool),
+        fraud=jnp.asarray(rows["fraud"][sel]))
+
+
+def _assert_sketch_equal(a, b, what=""):
+    np.testing.assert_array_equal(np.asarray(a.slice_day),
+                                  np.asarray(b.slice_day), err_msg=what)
+    for f in ("count", "amount", "fraud"):
+        x, y = getattr(a, f), getattr(b, f)
+        assert (x is None) == (y is None)
+        if x is not None:
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                          err_msg=f"{what}:{f}")
+
+
+def _host(sk):
+    return CountMinSketch(*[None if a is None else np.asarray(a)
+                            for a in sk])
+
+
+def test_exchange_converges_to_control_and_partials_merge_exact(tmp_path):
+    rows = _stream(0, 600)
+    sel_a = np.arange(600) % 2 == 0
+    sel_b = ~sel_a
+    init = lambda: cms_init(DEPTH, WIDTH, n_days=ND, track_fraud=True)  # noqa: E731
+    control = _apply(init(), rows, np.ones(600, dtype=bool))
+    sk_a = _apply(init(), rows, sel_a)
+    sk_b = _apply(init(), rows, sel_b)
+
+    root = str(tmp_path / "xch")
+    xa = SketchExchange(root, 0, 2, timeout_s=0.0)
+    xb = SketchExchange(root, 1, 2, timeout_s=0.0)
+
+    # A publishes first: nothing to adopt yet (its serving state is
+    # already exact locals) — but its partial is now on disk for B.
+    assert xa.exchange(sk_a) is None
+    merged_b = xb.exchange(sk_b)
+    assert merged_b is not None
+    sk_b = _host(install_logical(sk_b, merged_b))
+    _assert_sketch_equal(sk_b, _host(control), "B after first adoption")
+    # second A round picks up B's partial: A converges too.
+    merged_a = xa.exchange(sk_a)
+    sk_a = _host(install_logical(sk_a, merged_a))
+    _assert_sketch_equal(sk_a, _host(control), "A after adoption")
+
+    # Checkpoints store locals-only partials: stacking both processes'
+    # checkpoint sketches through the REAL resize-merge rule
+    # (_merge_sketch's newest-day same-day-SUM) reproduces the control
+    # bit-for-bit — the satellite's fleet ≡ control pin.
+    part_a = xa.checkpoint_cms(sk_a)
+    part_b = xb.checkpoint_cms(sk_b)
+    assert part_a is not None and part_b is not None
+    stacked = CountMinSketch(
+        np.stack([np.asarray(part_a.slice_day),
+                  np.asarray(part_b.slice_day)]),
+        np.stack([np.asarray(part_a.count), np.asarray(part_b.count)]),
+        np.stack([np.asarray(part_a.amount), np.asarray(part_b.amount)]),
+        np.stack([np.asarray(part_a.fraud), np.asarray(part_b.fraud)]))
+    _assert_sketch_equal(_merge_sketch(stacked, 2), _host(control),
+                         "merged checkpoint partials")
+
+
+def test_exchange_stays_exact_across_rounds_with_new_traffic(tmp_path):
+    """Adopted peer content must never leak back into published
+    partials: after more local traffic and a second exchange round,
+    both processes still reconcile exactly with a control that saw
+    everything — including newer days that retire ring slices."""
+    rows1 = _stream(1, 400, n_days=2)
+    rows2 = _stream(2, 400, n_days=4)  # newer days: slices advance
+    sel_a1 = np.arange(400) % 2 == 0
+    sel_a2 = np.arange(400) % 3 == 0
+    init = lambda: cms_init(DEPTH, WIDTH, n_days=ND, track_fraud=True)  # noqa: E731
+
+    control = _apply(_apply(init(), rows1, np.ones(400, dtype=bool)),
+                     rows2, np.ones(400, dtype=bool))
+    sk_a = _apply(init(), rows1, sel_a1)
+    sk_b = _apply(init(), rows1, ~sel_a1)
+
+    root = str(tmp_path / "xch")
+    xa = SketchExchange(root, 0, 2, timeout_s=0.0)
+    xb = SketchExchange(root, 1, 2, timeout_s=0.0)
+    xa.exchange(sk_a)
+    sk_b = _host(install_logical(sk_b, xb.exchange(sk_b)))
+    sk_a = _host(install_logical(sk_a, xa.exchange(sk_a)))
+
+    # round 2: fresh disjoint traffic lands on top of adopted state
+    sk_a = _apply(CountMinSketch(*[jnp.asarray(x) if x is not None
+                                   else None for x in sk_a]),
+                  rows2, sel_a2)
+    sk_b = _apply(CountMinSketch(*[jnp.asarray(x) if x is not None
+                                   else None for x in sk_b]),
+                  rows2, ~sel_a2)
+    xa.exchange(sk_a)
+    sk_b = _host(install_logical(sk_b, xb.exchange(sk_b)))
+    sk_a = _host(install_logical(sk_a, xa.exchange(sk_a)))
+    _assert_sketch_equal(sk_a, _host(control), "A round 2")
+    _assert_sketch_equal(sk_b, _host(control), "B round 2")
+
+    # and the checkpoint partials still merge to control exactly
+    part_a, part_b = xa.checkpoint_cms(sk_a), xb.checkpoint_cms(sk_b)
+    stacked = CountMinSketch(*[
+        np.stack([np.asarray(getattr(part_a, f)),
+                  np.asarray(getattr(part_b, f))])
+        for f in ("slice_day", "count", "amount", "fraud")])
+    _assert_sketch_equal(_merge_sketch(stacked, 2), _host(control),
+                         "round-2 merged partials")
+
+
+def test_single_process_exchange_is_identity(tmp_path):
+    rows = _stream(3, 100)
+    sk = _apply(cms_init(DEPTH, WIDTH, n_days=ND, track_fraud=True),
+                rows, np.ones(100, dtype=bool))
+    x = SketchExchange(str(tmp_path / "xch"), 0, 1, timeout_s=0.0)
+    assert x.exchange(sk) is None
+    assert x.checkpoint_cms(sk) is None  # nothing adopted, state as-is
+
+
+def test_missing_peer_counts_partial_round(tmp_path):
+    from real_time_fraud_detection_system_tpu.utils.metrics import (
+        get_registry,
+    )
+
+    rows = _stream(4, 100)
+    sk = _apply(cms_init(DEPTH, WIDTH, n_days=ND, track_fraud=True),
+                rows, np.ones(100, dtype=bool))
+    x = SketchExchange(str(tmp_path / "xch"), 0, 3, timeout_s=0.0)
+    before = get_registry().counter(
+        "rtfds_cms_exchange_rounds_total", "", outcome="partial").value
+    assert x.exchange(sk) is None  # no peers present within timeout
+    after = get_registry().counter(
+        "rtfds_cms_exchange_rounds_total", "", outcome="partial").value
+    assert after == before + 1
+
+
+def test_stacked_shard_install_and_checkpoint_subtract(tmp_path):
+    """Sharded serving layout: peer content lands in shard 0 only, the
+    cross-shard logical view equals the control, and the checkpoint
+    subtract returns exactly the pre-adoption logical locals."""
+    rows = _stream(5, 300)
+    sel = np.arange(300) % 2 == 0
+    init = lambda: cms_init(DEPTH, WIDTH, n_days=ND, track_fraud=True)  # noqa: E731
+    control = _apply(init(), rows, np.ones(300, dtype=bool))
+    # local state: two shards fed with disjoint halves of THIS
+    # process's rows (stacked layout)
+    sh0 = _apply(init(), rows, sel & (np.arange(300) % 4 == 0))
+    sh1 = _apply(init(), rows, sel & (np.arange(300) % 4 != 0))
+    stacked = CountMinSketch(*[
+        np.stack([np.asarray(getattr(sh0, f)),
+                  np.asarray(getattr(sh1, f))])
+        for f in ("slice_day", "count", "amount", "fraud")])
+    local_logical = _logical_of(stacked)
+
+    # peer = the other half of the stream
+    peer = _apply(init(), rows, ~sel)
+    root = str(tmp_path / "xch")
+    xp = SketchExchange(root, 1, 2, timeout_s=0.0)
+    xp.exchange(peer)  # publishes the peer partial
+    xs = SketchExchange(root, 0, 2, timeout_s=0.0)
+    merged = xs.exchange(stacked)
+    assert merged is not None
+    adopted = install_logical(stacked, merged)
+    got = _logical_of(adopted)
+    want = _logical_of(control)
+    np.testing.assert_array_equal(got.days, want.days)
+    np.testing.assert_array_equal(got.count, want.count)
+    np.testing.assert_array_equal(got.amount, want.amount)
+    np.testing.assert_array_equal(got.fraud, want.fraud)
+
+    # checkpoint form: subtracting the overlay from shard 0 restores
+    # the locals-only logical view exactly
+    part = xs.checkpoint_cms(adopted)
+    back = _logical_of(part)
+    np.testing.assert_array_equal(back.days, local_logical.days)
+    np.testing.assert_array_equal(back.count, local_logical.count)
+    np.testing.assert_array_equal(back.amount, local_logical.amount)
+    np.testing.assert_array_equal(back.fraud, local_logical.fraud)

@@ -37,6 +37,7 @@ from real_time_fraud_detection_system_tpu.io.coldstore import (
     ColdPromoter,
     ColdStore,
     ColdStoreCorruptError,
+    consolidate_cold_stores,
 )
 from real_time_fraud_detection_system_tpu.models.logreg import init_logreg
 from real_time_fraud_detection_system_tpu.models.scaler import Scaler
@@ -94,6 +95,76 @@ def test_store_append_flush_reopen(tmp_path):
     assert lin["total_keys"] == 5
     assert [s["seq"] for s in lin["segments"]] == [0, 1]
     assert all(s["bytes"] > 0 for s in lin["segments"])
+
+
+def test_rehome_drops_foreign_keys_only(tmp_path):
+    """Fleet-resize re-homing: keys the new topology homes elsewhere
+    are unindexed (buffered AND committed), owned keys keep serving
+    bit-identical rows, and gc can then reclaim all-foreign segments."""
+    d = str(tmp_path / "cold")
+    cs = ColdStore(d)
+    bd, cnt, amt, frd = _rows(10, 4)
+    cs.append("customer", [10, 11, 12, 13], bd, cnt, amt, frd)
+    cs.flush()
+    tb = _rows(11, 2)
+    cs.append("terminal", [20, 21], *tb)  # stays buffered
+    # new topology: this process owns even keys only
+    dropped = cs.rehome(lambda _t, ks: ks % 2 == 0)
+    assert dropped == 3  # 11, 13, 21
+    assert cs.contains("customer", 10) and cs.contains("customer", 12)
+    assert not cs.contains("customer", 11)
+    assert cs.contains("terminal", 20) and not cs.contains("terminal", 21)
+    np.testing.assert_array_equal(
+        cs.get_rows("customer", [12])[12][0], bd[2])
+    np.testing.assert_array_equal(
+        cs.get_rows("terminal", [20])[20][1], tb[1][0])
+    # Segment manifests are immutable, so a reopen resurrects foreign
+    # index entries — which is why the engine re-applies rehome after
+    # EVERY restore (_sync_cold_after_restore): re-pruning converges to
+    # the same surviving view with owned rows bit-identical.
+    cs.flush()
+    cs.gc()
+    cs2 = ColdStore(d)
+    assert cs2.rehome(lambda _t, ks: ks % 2 == 0) == 2  # 11, 13 again
+    assert cs2.keys_count == 3
+    np.testing.assert_array_equal(
+        cs2.get_rows("customer", [10])[10][0], bd[0])
+
+
+def test_consolidate_then_rehome_bit_identity(tmp_path):
+    """The shrink-merge cold path end to end: two per-process stores
+    consolidate into one (demote→resize), then a later grow re-homes the
+    consolidated store back into residue slices (resize→promote) — every
+    surviving key's rows stay BIT-identical to what was demoted."""
+    a = ColdStore(str(tmp_path / "p0"))
+    b = ColdStore(str(tmp_path / "p1"))
+    rows_a = _rows(20, 3)
+    rows_b = _rows(21, 2)
+    a.append("customer", [2, 4, 6], *rows_a)
+    a.flush()
+    b.append("customer", [1, 3], *rows_b)
+    b.append("terminal", [7], *_rows(22, 1))
+    b.flush()
+    merged = consolidate_cold_stores(
+        [str(tmp_path / "p0"), str(tmp_path / "p1")],
+        str(tmp_path / "merged"))
+    assert merged.keys_count == 6
+    want = {2: rows_a, 4: rows_a, 6: rows_a, 1: rows_b, 3: rows_b}
+    src_row = {2: 0, 4: 1, 6: 2, 1: 0, 3: 1}
+    for k, rows in want.items():
+        got = merged.get_rows("customer", [k])[k]
+        for col in range(4):
+            np.testing.assert_array_equal(got[col],
+                                          rows[col][src_row[k]])
+    # destination must be a fresh directory, never also a source
+    with pytest.raises(ValueError):
+        consolidate_cold_stores([str(tmp_path / "merged")],
+                                str(tmp_path / "merged"))
+    # grow back out: process 1 of 2 adopts only odd keys
+    merged.rehome(lambda _t, ks: ks % 2 == 1)
+    assert sorted(k for (_t, k) in merged._index) == [1, 3, 7]
+    np.testing.assert_array_equal(
+        merged.get_rows("customer", [3])[3][2], rows_b[2][1])
 
 
 def test_store_mark_promoted_then_gc(tmp_path):

@@ -1,0 +1,277 @@
+"""Elastic-fleet policy plane: hysteresis/dwell/cooldown decisions, the
+resize state machine's validated transitions, torn topology-manifest
+quarantine, and fleet-signal extraction from worker snapshots — all
+process-free (the launcher integration is tests/test_elastic_smoke.py)."""
+
+import json
+import os
+
+import pytest
+
+from real_time_fraud_detection_system_tpu.runtime.elastic import (
+    COMMITTING,
+    DRAINING,
+    RELAUNCHING,
+    RETOPOLOGIZING,
+    ROLLING_BACK,
+    STEADY,
+    ClusterSignals,
+    ElasticConfig,
+    ElasticPolicy,
+    ResizeFsm,
+    ResizeFsmError,
+    fleet_metrics,
+    load_topology,
+    signals_from_snapshots,
+    store_topology,
+)
+from real_time_fraud_detection_system_tpu.utils.metrics import (
+    MetricsRegistry,
+)
+
+
+def _cfg(**kw):
+    base = dict(min_processes=1, max_processes=4, grow_rung=2,
+                grow_dwell_s=2.0, shrink_dwell_s=5.0, cooldown_s=3.0)
+    base.update(kw)
+    return ElasticConfig(**base)
+
+
+def _sig(rung=0, trend=0.0, shed=0.0, alive=8):
+    # alive defaults to "every process scraped" — the shrink condition
+    # requires full-fleet visibility, and most cells test other axes
+    return ClusterSignals(worst_rung=rung, lag_trend_rows_per_s=trend,
+                          shed_pending_rows=shed, alive=alive)
+
+
+# ---------------------------------------------------------------------------
+# policy: dwell, flap-proofing, cooldown, clamps
+# ---------------------------------------------------------------------------
+
+def test_grow_requires_sustained_dwell():
+    pol = ElasticPolicy(_cfg())
+    assert pol.observe(_sig(rung=2), 1, now=0.0) is None
+    assert pol.observe(_sig(rung=3), 1, now=1.0) is None
+    dec = pol.observe(_sig(rung=2), 1, now=2.0)
+    assert dec is not None and dec.direction == "grow" and dec.target == 2
+    assert "rung" in dec.reason
+
+
+def test_grow_streak_resets_on_any_dip():
+    pol = ElasticPolicy(_cfg())
+    assert pol.observe(_sig(rung=2), 1, now=0.0) is None
+    assert pol.observe(_sig(rung=1), 1, now=1.5) is None  # dip resets
+    assert pol.observe(_sig(rung=2), 1, now=2.5) is None  # streak restarts
+    assert pol.observe(_sig(rung=2), 1, now=4.0) is None
+    assert pol.observe(_sig(rung=2), 1, now=4.6) is not None
+
+
+def test_shrink_requires_full_idle_and_dwell():
+    pol = ElasticPolicy(_cfg())
+    # Rung 0 but a positive lag trend (backlog still growing) never arms
+    # the shrink streak.
+    for t in range(8):
+        assert pol.observe(_sig(trend=10.0), 2, now=float(t)) is None
+    # Rung 0 with shed rows still owed never arms it either.
+    pol2 = ElasticPolicy(_cfg())
+    for t in range(8):
+        assert pol2.observe(_sig(shed=5.0), 2, now=float(t)) is None
+    # Fully idle arms it, and the dwell must elapse.
+    pol3 = ElasticPolicy(_cfg())
+    assert pol3.observe(_sig(), 2, now=0.0) is None
+    assert pol3.observe(_sig(), 2, now=4.9) is None
+    dec = pol3.observe(_sig(), 2, now=5.0)
+    assert dec is not None and dec.direction == "shrink" and dec.target == 1
+
+
+def test_blind_fleet_never_shrinks():
+    """Zero (or partial) registry visibility is warmup or a scrape
+    outage, not idleness — a worker that cannot be seen is not provably
+    idle, so the shrink streak must never arm on blindness."""
+    pol = ElasticPolicy(_cfg())
+    for t in range(20):
+        assert pol.observe(_sig(alive=0), 2, now=float(t)) is None
+    pol2 = ElasticPolicy(_cfg())
+    for t in range(20):
+        assert pol2.observe(_sig(alive=1), 2, now=float(t)) is None
+
+
+def test_dead_band_rung_one_arms_neither():
+    pol = ElasticPolicy(_cfg())
+    for t in range(20):
+        assert pol.observe(_sig(rung=1), 2, now=float(t)) is None
+
+
+def test_cooldown_blocks_both_directions():
+    pol = ElasticPolicy(_cfg())
+    pol.observe(_sig(rung=2), 1, now=0.0)
+    assert pol.observe(_sig(rung=2), 1, now=2.0) is not None
+    pol.note_resized(now=2.0)
+    # Sustained pressure inside the cooldown window yields nothing, and
+    # the dwell only starts counting once the cooldown expires.
+    assert pol.observe(_sig(rung=3), 2, now=3.0) is None
+    assert pol.observe(_sig(rung=3), 2, now=4.9) is None
+    assert pol.observe(_sig(rung=3), 2, now=5.0) is None
+    assert pol.observe(_sig(rung=3), 2, now=7.0) is not None
+
+
+def test_targets_clamp_to_bounds():
+    pol = ElasticPolicy(_cfg(max_processes=3))
+    pol.observe(_sig(rung=2), 2, now=0.0)
+    dec = pol.observe(_sig(rung=2), 2, now=2.0)
+    assert dec.target == 3  # 2*2 clamped to max
+    # At the max, sustained pressure produces no decision at all.
+    pol2 = ElasticPolicy(_cfg(max_processes=2))
+    for t in range(10):
+        assert pol2.observe(_sig(rung=3), 2, now=float(t)) is None
+    # At the min, sustained idle produces no decision.
+    pol3 = ElasticPolicy(_cfg())
+    for t in range(10):
+        assert pol3.observe(_sig(), 1, now=float(t)) is None
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        _cfg(min_processes=0)
+    with pytest.raises(ValueError):
+        _cfg(max_processes=1, min_processes=2)
+    with pytest.raises(ValueError):
+        _cfg(grow_rung=4)
+    with pytest.raises(ValueError):
+        _cfg(cooldown_s=-1.0)
+
+
+# ---------------------------------------------------------------------------
+# resize state machine
+# ---------------------------------------------------------------------------
+
+def test_fsm_happy_path_journals_every_phase():
+    seen = []
+    fsm = ResizeFsm(journal=seen.append)
+    assert fsm.phase == STEADY and not fsm.mid_resize
+    fsm.to(DRAINING, target=2)
+    assert fsm.mid_resize
+    fsm.to(RETOPOLOGIZING)
+    fsm.to(COMMITTING)
+    fsm.to(RELAUNCHING)
+    fsm.to(STEADY)
+    assert [r["phase"] for r in seen] == [
+        DRAINING, RETOPOLOGIZING, COMMITTING, RELAUNCHING, STEADY]
+    assert seen[0]["target"] == 2
+
+
+def test_fsm_rejects_illegal_edges():
+    fsm = ResizeFsm()
+    with pytest.raises(ResizeFsmError):
+        fsm.to(COMMITTING)  # cannot skip drain
+    fsm.to(DRAINING)
+    with pytest.raises(ResizeFsmError):
+        fsm.to(RELAUNCHING)  # cannot skip retopologize/commit
+    with pytest.raises(ResizeFsmError):
+        fsm.to(STEADY)  # mid-resize only exits via completion path
+
+
+@pytest.mark.parametrize("upto", [
+    [DRAINING],
+    [DRAINING, RETOPOLOGIZING],
+    [DRAINING, RETOPOLOGIZING, COMMITTING],
+    [DRAINING, RETOPOLOGIZING, COMMITTING, RELAUNCHING],
+])
+def test_fsm_rollback_from_every_mid_phase(upto):
+    fsm = ResizeFsm()
+    for ph in upto:
+        fsm.to(ph)
+    fsm.rollback(fault="injected")
+    assert fsm.phase == ROLLING_BACK
+    fsm.to(STEADY)
+    assert not fsm.mid_resize
+
+
+def test_fsm_rollback_from_steady_is_an_error():
+    fsm = ResizeFsm()
+    with pytest.raises(ResizeFsmError):
+        fsm.rollback()
+
+
+# ---------------------------------------------------------------------------
+# topology manifest: atomic commit + torn-file quarantine
+# ---------------------------------------------------------------------------
+
+def test_topology_roundtrip_and_overwrite(tmp_path):
+    p = str(tmp_path / "topology.json")
+    assert load_topology(p) is None  # absent reads as None, no quarantine
+    man1 = {"processes": 1, "generation": 0, "local_devices": 1}
+    store_topology(p, man1)
+    assert load_topology(p) == man1
+    man2 = {"processes": 2, "generation": 1, "local_devices": 1}
+    store_topology(p, man2)
+    assert load_topology(p) == man2
+    assert not os.path.exists(p + ".tmp")
+
+
+def test_torn_topology_quarantines_and_reads_none(tmp_path):
+    p = str(tmp_path / "topology.json")
+    store_topology(p, {"processes": 2})
+    with open(p, "wb") as f:
+        f.write(b'{"processes": 2, "gener')  # torn mid-write
+    assert load_topology(p) is None
+    assert not os.path.exists(p)  # quarantined aside, not left to re-read
+    torn = [n for n in os.listdir(tmp_path) if ".torn-" in n]
+    assert len(torn) == 1
+    # A non-object payload is equally quarantined.
+    with open(p, "w") as f:
+        json.dump([1, 2], f)
+    assert load_topology(p) is None
+
+
+# ---------------------------------------------------------------------------
+# fleet signal extraction + metrics registration
+# ---------------------------------------------------------------------------
+
+def _snap_with(rung=0, pressure=0.0, trend=0.0, shed=0.0):
+    reg = MetricsRegistry()
+    reg.gauge("rtfds_overload_rung", "h").set(rung)
+    reg.gauge("rtfds_overload_pressure", "h").set(pressure)
+    reg.gauge("rtfds_source_lag_trend_rows_per_s", "h").set(trend)
+    reg.gauge("rtfds_shed_pending_rows", "h").set(shed)
+    return reg.snapshot()
+
+
+def test_signals_from_snapshots_worst_and_sum_semantics():
+    snaps = {
+        "00": _snap_with(rung=1, pressure=0.4, trend=-5.0, shed=3.0),
+        "01": _snap_with(rung=3, pressure=1.7, trend=120.0, shed=4.0),
+    }
+    sig = signals_from_snapshots(snaps)
+    assert sig.worst_rung == 3
+    assert sig.worst_pressure == pytest.approx(1.7)
+    assert sig.lag_trend_rows_per_s == pytest.approx(120.0)
+    assert sig.shed_pending_rows == pytest.approx(7.0)
+    assert sig.alive == 2
+
+
+def test_signals_tolerate_missing_series():
+    sig = signals_from_snapshots({"00": {}})
+    assert sig.worst_rung == 0 and sig.shed_pending_rows == 0.0
+    assert sig.alive == 1
+
+
+def test_fleet_metrics_register_all_names():
+    reg = MetricsRegistry()
+    m = fleet_metrics(reg)
+    m.fleet_size.set(2)
+    m.resize_pending.set(1)
+    m.resize_seconds.observe(3.5)
+    m.spike_absorb.set(7.0)
+    m.resizes_total("grow", "completed").inc()
+    m.resizes_total("grow", "rolled_back").inc()
+    snap = reg.snapshot()
+    for name in ("rtfds_fleet_size", "rtfds_fleet_resizes_total",
+                 "rtfds_resize_seconds", "rtfds_resize_pending",
+                 "rtfds_spike_absorb_seconds"):
+        assert name in snap, name
+    series = snap["rtfds_fleet_resizes_total"]["series"]
+    outcomes = {(s["labels"]["direction"], s["labels"]["outcome"]):
+                s["value"] for s in series}
+    assert outcomes[("grow", "completed")] == 1.0
+    assert outcomes[("grow", "rolled_back")] == 1.0

@@ -1,0 +1,512 @@
+"""Tier-1 elastic-fleet smoke: pressure-driven autoscaling with live
+exact resharding, exactly-once across every resize.
+
+The ROADMAP item-4 gate, as scripted end-to-end drives of the whole
+elastic stack: ``tools/multihost_launcher.py --autoscale`` watches real
+worker registries (worst overload rung, lag trend, shed backlog) and
+walks real resizes through the chaos-survivable window — coordinated
+drain to final checkpoints, worker-side checkpoint merge
+(``--resume-merge``), atomic topology commit, relaunch under the new
+process count. Asserted, all from artifacts the fleet itself wrote
+(report JSON, parquet parts, registry dumps, the launcher's own metric
+snapshot, the flight record — no prints):
+
+- GROW 1 -> 2 under a 10x ingest spike (replay lag >> the overload
+  ladder's high-water mark) completes mid-stream with EXACT coverage:
+  every tx_id scored once across both generations, per-(generation,
+  process) sink ``batch_index`` lineage gap/dup-free, zero mid-stream
+  recompiles in every worker, ``rtfds_fleet_resizes_total{direction=
+  grow,outcome=completed} == 1``, finite spike-absorb time;
+- SHRINK 2 -> 1 on sustained idle merges both processes' exact state
+  (the real ``merge_process_states`` path) and still covers the stream
+  exactly;
+- a SIGKILLed worker mid-drain lands the resize in
+  ``outcome=rolled_back`` with the PRE-resize fleet serving to exact
+  completion (the torn-manifest and crash-pre-relaunch faults ride the
+  slow lane);
+- resume floors: a shrink whose old processes drained at DIFFERENT
+  stream positions must not re-score the faster process's rows — the
+  per-owner ownership floors recorded in the merged checkpoint's
+  ``resize_epochs`` drive ``OwnershipFloorSource``, provable
+  deterministically without the launcher.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+N_GROW = 60000
+N_SHRINK = 200000
+BATCH = 128
+
+
+def _spawn_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _port_base() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def elastic_env():
+    """Skip only where the environment genuinely cannot run the smoke
+    (no subprocess spawn / no loopback port); everything else asserts."""
+    try:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+    except OSError as e:
+        pytest.skip(f"cannot bind a loopback port: {e}")
+    try:
+        p = subprocess.run([sys.executable, "-c", "print('spawn-ok')"],
+                           capture_output=True, text=True, timeout=60)
+        assert "spawn-ok" in p.stdout
+    except Exception as e:  # noqa: BLE001 — any spawn failure is a skip
+        pytest.skip(f"cannot spawn worker subprocesses: {e}")
+    return True
+
+
+def _make_dataset(path: str, n: int) -> None:
+    """Co-partitioned whole-dollar stream (terminal residues track
+    customer residues for fleets up to 2), as pinned since PR 14."""
+    from real_time_fraud_detection_system_tpu.data.generator import (
+        Transactions,
+    )
+    from real_time_fraud_detection_system_tpu.io.artifacts import (
+        save_transactions,
+    )
+
+    rng = np.random.default_rng(11)
+    cust = rng.integers(0, 256, n).astype(np.int64)
+    term = (rng.integers(0, 128, n) * 2 + (cust % 2)).astype(np.int64)
+    t_s = np.sort(rng.integers(0, 20 * 86400, n)).astype(np.int64)
+    save_transactions(path, Transactions(
+        tx_id=np.arange(n, dtype=np.int64),
+        tx_time_seconds=t_s,
+        tx_time_days=(t_s // 86400).astype(np.int32),
+        customer_id=cust,
+        terminal_id=term,
+        amount_cents=(rng.integers(1, 300, n) * 100).astype(np.int64),
+        tx_fraud=(rng.random(n) < 0.05).astype(np.int8),
+        tx_fraud_scenario=np.zeros(n, np.int8)))
+
+
+def _make_model(path: str) -> None:
+    from real_time_fraud_detection_system_tpu.io.artifacts import (
+        save_model,
+    )
+    from real_time_fraud_detection_system_tpu.models.logreg import (
+        init_logreg,
+    )
+    from real_time_fraud_detection_system_tpu.models.scaler import Scaler
+    from real_time_fraud_detection_system_tpu.models.train import (
+        TrainedModel,
+    )
+
+    save_model(path, TrainedModel(
+        kind="logreg",
+        scaler=Scaler(mean=np.zeros(15, np.float32),
+                      scale=np.ones(15, np.float32)),
+        params=init_logreg(15)))
+
+
+@pytest.fixture(scope="module")
+def workspace(tmp_path_factory, elastic_env):
+    root = tmp_path_factory.mktemp("elastic")
+    _make_dataset(str(root / "txs-grow.npz"), N_GROW)
+    _make_dataset(str(root / "txs-shrink.npz"), N_SHRINK)
+    _make_model(str(root / "model.npz"))
+    return root
+
+
+def _autoscale(root, name: str, data: str, *, processes: int,
+               launcher_extra: list, score_extra: list) -> dict:
+    """One launcher --autoscale drive; returns every artifact path plus
+    the parsed report line."""
+    cell = root / name
+    dumps = cell / "dumps"
+    dumps.mkdir(parents=True)
+    cmd = [sys.executable,
+           os.path.join(REPO, "tools", "multihost_launcher.py"),
+           "--processes", str(processes), "--no-coordinator",
+           "--autoscale", "--autoscale-min", "1", "--autoscale-max", "2",
+           "--autoscale-interval", "0.2", "--max-resizes", "1",
+           "--worker-metrics-base", str(_port_base()),
+           "--workdir", str(cell / "wd"), "--timeout", "220",
+           "--flight-record", str(cell / "cluster.jsonl"),
+           ] + launcher_extra + [
+           "--", "score", "--source", "replay", "--data", data,
+           "--model-file", str(root / "model.npz"),
+           "--scorer", "tpu", "--precompile", "--devices", "1",
+           "--batch-rows", str(BATCH), "--max-batch-rows", str(BATCH),
+           "--out", str(cell / "out" / "{gen}"),
+           "--checkpoint-dir", str(cell / "ckpt" / "{gen}"),
+           "--cms-exchange", str(cell / "xch" / "{gen}"),
+           "--metrics-dump", str(dumps / "{gen}-{proc}.json"),
+           ] + score_extra
+    p = subprocess.run(cmd, env=_spawn_env(), capture_output=True,
+                       text=True, timeout=260)
+    lines = [ln for ln in p.stdout.splitlines() if ln.startswith("{")]
+    assert p.returncode == 0 and lines, (
+        f"{name} rc={p.returncode}\nstdout:{p.stdout[-3000:]}\n"
+        f"stderr:{p.stderr[-3000:]}")
+    return {
+        "cell": cell,
+        "report": json.loads(lines[-1]),
+        "out": cell / "out",
+        "ckpt": cell / "ckpt",
+        "dumps": dumps,
+        "launcher_metrics": json.loads(
+            (cell / "wd" / "launcher-metrics.json").read_text()),
+        "flight": cell / "cluster.jsonl",
+        "topology": cell / "wd" / "topology.json",
+    }
+
+
+_OVERLOAD = ["--overload", "--overload-lag-high", "512",
+             "--overload-climb-dwell", "1"]
+
+
+@pytest.fixture(scope="module")
+def grow_run(workspace):
+    """10x-spike grow: replay lag (the full table) is ~100x the ladder's
+    high-water mark, so the worst process climbs to the grow rung within
+    a few batches and holds it — the launcher must resize 1 -> 2
+    mid-stream."""
+    return _autoscale(
+        workspace, "grow", str(workspace / "txs-grow.npz"), processes=1,
+        launcher_extra=["--autoscale-grow-rung", "2",
+                        "--autoscale-grow-dwell", "1.0",
+                        "--autoscale-shrink-dwell", "300",
+                        "--autoscale-cooldown", "3"],
+        score_extra=_OVERLOAD + [
+            "--overload-spill",
+            str(workspace / "grow" / "spill" / "{gen}-{proc}")])
+
+
+@pytest.fixture(scope="module")
+def shrink_run(workspace):
+    """Sustained-idle shrink: no overload ladder (rung 0 everywhere, lag
+    only drains), so once every worker is scrapeable the idle dwell
+    completes and the launcher resizes 2 -> 1 through the REAL
+    two-process checkpoint merge."""
+    return _autoscale(
+        workspace, "shrink", str(workspace / "txs-shrink.npz"),
+        processes=2,
+        launcher_extra=["--autoscale-grow-dwell", "300",
+                        "--autoscale-shrink-dwell", "1.5",
+                        "--autoscale-cooldown", "2"],
+        score_extra=[])
+
+
+@pytest.fixture(scope="module")
+def chaos_run(workspace):
+    """SIGKILL a worker mid-drain: the harshest resize-window fault (no
+    final checkpoint lands) must divert to rollback, relaunch the
+    pre-resize fleet, and still cover the stream exactly."""
+    return _autoscale(
+        workspace, "chaos", str(workspace / "txs-grow.npz"), processes=1,
+        launcher_extra=["--autoscale-grow-rung", "2",
+                        "--autoscale-grow-dwell", "1.0",
+                        "--autoscale-shrink-dwell", "300",
+                        "--autoscale-cooldown", "3",
+                        "--chaos-resize", "kill-mid-drain"],
+        score_extra=_OVERLOAD + [
+            "--overload-spill",
+            str(workspace / "chaos" / "spill" / "{gen}-{proc}")])
+
+
+def _tx_ids(pattern: str) -> np.ndarray:
+    import pyarrow.parquet as pq
+
+    parts = sorted(glob.glob(pattern, recursive=True))
+    assert parts, f"no parquet parts under {pattern}"
+    return np.concatenate([
+        np.asarray(pq.read_table(p, columns=["tx_id"])["tx_id"])
+        for p in parts])
+
+
+def _assert_exact_coverage(out_root, n: int) -> None:
+    ids = _tx_ids(str(out_root / "**" / "part-*.parquet"))
+    assert len(ids) == n, f"scored {len(ids)} rows, stream has {n}"
+    assert np.array_equal(np.sort(ids), np.arange(n)), (
+        "coverage is not exact: lost or duplicated tx_ids")
+
+
+def _assert_lineages_contiguous(out_root) -> None:
+    dirs = {os.path.dirname(p) for p in glob.glob(
+        str(out_root / "**" / "part-*.parquet"), recursive=True)}
+    assert dirs
+    for d in sorted(dirs):
+        idxs = sorted(
+            int(re.search(r"part-(\d+)", os.path.basename(p)).group(1))
+            for p in glob.glob(os.path.join(d, "part-*.parquet")))
+        assert idxs == list(range(1, len(idxs) + 1)), (
+            f"{d}: batch_index lineage has gaps/dups: {idxs}")
+
+
+def _series_total(snap: dict, name: str, **labels) -> float:
+    total = 0.0
+    for row in (snap.get(name) or {}).get("series", []):
+        row_labels = row.get("labels") or {}
+        if all(row_labels.get(k) == v for k, v in labels.items()):
+            total += float(row.get("value", 0.0) or 0.0)
+    return total
+
+
+# ---------------------------------------------------------------------------
+# grow 1 -> 2 under the spike
+# ---------------------------------------------------------------------------
+
+def test_grow_resize_completes_exactly_once(grow_run):
+    auto = grow_run["report"]["autoscale"]
+    assert auto["completed"] == 1 and auto["rolled_back"] == 0
+    assert auto["current"] == 2 and auto["generations"] == 2
+    assert auto["last_resize"]["direction"] == "grow"
+    assert grow_run["report"]["rows_total"] == N_GROW
+    _assert_exact_coverage(grow_run["out"], N_GROW)
+    _assert_lineages_contiguous(grow_run["out"])
+
+
+def test_grow_fleet_counters_and_spike_absorb(grow_run):
+    lm = grow_run["launcher_metrics"]
+    assert _series_total(lm, "rtfds_fleet_resizes_total",
+                         direction="grow", outcome="completed") == 1
+    assert _series_total(lm, "rtfds_fleet_resizes_total",
+                         outcome="rolled_back") == 0
+    assert _series_total(lm, "rtfds_fleet_size") == 2
+    absorb = grow_run["report"]["autoscale"]["spike_absorb_s"]
+    assert absorb is not None and 0 < absorb < 220, (
+        f"spike never absorbed: {absorb}")
+
+
+def test_grow_zero_midstream_recompiles_every_worker(grow_run):
+    dumps = sorted(glob.glob(str(grow_run["dumps"] / "*.json")))
+    assert len(dumps) == 3  # gen-000 x1 + gen-001 x2
+    for path in dumps:
+        snap = json.loads(open(path, encoding="utf-8").read())
+        assert _series_total(snap, "rtfds_xla_recompiles_total") == 0, (
+            f"{path}: recompiled mid-stream")
+        assert _series_total(snap, "rtfds_precompiled_steps_total") > 0, (
+            f"{path}: no precompiled steps — zero-recompile is vacuous")
+
+
+def test_grow_flight_record_and_elasticity_tile(grow_run):
+    from real_time_fraud_detection_system_tpu.io.dashboard import (
+        render_ops_html,
+    )
+    from real_time_fraud_detection_system_tpu.utils.metrics import (
+        FlightRecorder,
+    )
+
+    manifest, records = FlightRecorder.read(str(grow_run["flight"]))
+    assert (manifest or {}).get("multihost", {}).get("autoscale") is True
+    events = {r.get("event") for r in records}
+    assert {"resize_begin", "resize_phase", "resize_complete"} <= events
+    phases = [r.get("phase") for r in records
+              if r.get("event") == "resize_phase"]
+    for ph in ("draining", "retopologizing", "committing",
+               "relaunching", "steady"):
+        assert ph in phases, f"phase {ph} never journaled: {phases}"
+    html = render_ops_html(manifest, records)
+    assert "Elasticity" in html and "1 resize(s)" in html
+
+
+def test_grow_resize_epochs_inspectable(grow_run):
+    """``rtfds ckpt --inspect`` on the merged checkpoint surfaces the
+    resize lineage (the satellite): who merged into whom, and at what
+    ownership floors."""
+    gen1 = grow_run["ckpt"] / "gen-001" / "proc-00"
+    # the merged checkpoint is named by its adopted offset (the merge
+    # floor), so pick the earliest one in the new generation's lineage
+    names = sorted(p.name for p in gen1.glob("ckpt-*.npz"))
+    assert names, f"no checkpoints under {gen1}"
+    p = subprocess.run(
+        [sys.executable, "-m", "real_time_fraud_detection_system_tpu.cli",
+         "ckpt", "--path", str(gen1), "--inspect", names[0]],
+        env=_spawn_env(), capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0, p.stdout + p.stderr
+    man = json.loads(
+        [ln for ln in p.stdout.splitlines() if ln.startswith("{")][-1])
+    epochs = man.get("resize_epochs")
+    assert epochs, f"no resize_epochs in inspect output: {sorted(man)}"
+    assert epochs[-1]["from_processes"] == 1
+    assert epochs[-1]["to_processes"] == 2
+    assert len(epochs[-1]["floors"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# shrink 2 -> 1 on sustained idle
+# ---------------------------------------------------------------------------
+
+def test_shrink_merges_exactly_once(shrink_run):
+    auto = shrink_run["report"]["autoscale"]
+    assert auto["completed"] == 1 and auto["rolled_back"] == 0
+    assert auto["current"] == 1
+    assert auto["last_resize"]["direction"] == "shrink"
+    assert shrink_run["report"]["rows_total"] == N_SHRINK
+    _assert_exact_coverage(shrink_run["out"], N_SHRINK)
+    _assert_lineages_contiguous(shrink_run["out"])
+    lm = shrink_run["launcher_metrics"]
+    assert _series_total(lm, "rtfds_fleet_resizes_total",
+                         direction="shrink", outcome="completed") == 1
+    assert _series_total(lm, "rtfds_fleet_size") == 1
+
+
+def test_shrink_committed_topology(shrink_run):
+    topo = json.loads(shrink_run["topology"].read_text())
+    assert topo["processes"] == 1 and topo["generation"] == 1
+    assert topo["direction"] == "shrink"
+
+
+# ---------------------------------------------------------------------------
+# chaos: resize-window faults land in rollback, exactly-once intact
+# ---------------------------------------------------------------------------
+
+def test_chaos_kill_mid_drain_rolls_back_exactly_once(chaos_run):
+    auto = chaos_run["report"]["autoscale"]
+    assert auto["rolled_back"] == 1 and auto["completed"] == 0
+    assert auto["current"] == 1 and auto["generations"] == 1
+    assert auto["last_resize"]["outcome"] == "rolled_back"
+    assert auto["last_resize"]["stage"] == "drain"
+    assert chaos_run["report"]["rows_total"] == N_GROW
+    _assert_exact_coverage(chaos_run["out"], N_GROW)
+    _assert_lineages_contiguous(chaos_run["out"])
+    lm = chaos_run["launcher_metrics"]
+    assert _series_total(lm, "rtfds_fleet_resizes_total",
+                         outcome="rolled_back") == 1
+    assert _series_total(lm, "rtfds_fleet_resizes_total",
+                         outcome="completed") == 0
+    # the committed topology never moved off the pre-resize fleet
+    topo = json.loads(chaos_run["topology"].read_text())
+    assert topo["processes"] == 1 and topo["generation"] == 0
+
+
+def test_chaos_rollback_journaled(chaos_run):
+    from real_time_fraud_detection_system_tpu.utils.metrics import (
+        FlightRecorder,
+    )
+
+    _, records = FlightRecorder.read(str(chaos_run["flight"]))
+    rb = [r for r in records if r.get("event") == "resize_rollback"]
+    assert len(rb) == 1 and rb[0]["stage"] == "drain"
+    phases = [r.get("phase") for r in records
+              if r.get("event") == "resize_phase"]
+    assert "rolling_back" in phases and phases[-1] == "steady"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode, stage", [
+    ("crash-pre-relaunch", "retopologize"),
+    ("torn-manifest", "commit"),
+])
+def test_chaos_other_faults_roll_back(workspace, mode, stage):
+    run = _autoscale(
+        workspace, f"chaos-{mode}", str(workspace / "txs-grow.npz"),
+        processes=1,
+        launcher_extra=["--autoscale-grow-rung", "2",
+                        "--autoscale-grow-dwell", "1.0",
+                        "--autoscale-shrink-dwell", "300",
+                        "--autoscale-cooldown", "3",
+                        "--chaos-resize", mode],
+        score_extra=_OVERLOAD + [
+            "--overload-spill",
+            str(workspace / f"chaos-{mode}" / "spill" / "{gen}-{proc}")])
+    auto = run["report"]["autoscale"]
+    assert auto["rolled_back"] == 1 and auto["completed"] == 0
+    assert auto["last_resize"]["stage"] == stage
+    _assert_exact_coverage(run["out"], N_GROW)
+    topo = json.loads(run["topology"].read_text())
+    assert topo["processes"] == 1 and topo["generation"] == 0
+    if mode == "torn-manifest":
+        # the tear was quarantined as evidence, like a corrupt checkpoint
+        assert glob.glob(str(run["cell"] / "wd" / "topology.json.torn-*"))
+
+
+# ---------------------------------------------------------------------------
+# resume floors: deterministic, launcher-free
+# ---------------------------------------------------------------------------
+
+def _score_cli(extra: list) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "real_time_fraud_detection_system_tpu.cli",
+         "score", "--source", "replay", "--scorer", "tpu",
+         "--precompile", "--devices", "1",
+         "--batch-rows", str(BATCH), "--max-batch-rows", str(BATCH),
+         "--drain-on-sigterm"] + extra,
+        env=_spawn_env(), capture_output=True, text=True, timeout=260)
+
+
+@pytest.fixture(scope="module")
+def floors_run(workspace):
+    """Two old processes drain at DIFFERENT stream positions (process 0
+    finishes, process 1 stops after 3 batches), then one new process
+    adopts the merge. Without per-owner floors the new process would
+    re-score process 0's rows between the two cursors; with them the
+    union must be exact."""
+    root = workspace / "floors"
+    data = str(workspace / "txs-grow.npz")
+    model = str(workspace / "model.npz")
+    old_ck, old_out = str(root / "ck-old"), root / "out-old"
+    for pid, extra in ((0, []), (1, ["--max-batches", "3"])):
+        p = _score_cli(["--data", data, "--model-file", model,
+                        "--num-processes", "2", "--process-id", str(pid),
+                        "--checkpoint-dir", old_ck,
+                        "--out", str(old_out)] + extra)
+        assert p.returncode == 0, f"old proc {pid}: {p.stdout[-2000:]}"
+    p = _score_cli(["--data", data, "--model-file", model,
+                    "--resume", "--resume-merge",
+                    f"{old_ck}:2:1:floors-cell",
+                    "--checkpoint-dir", str(root / "ck-new"),
+                    "--out", str(root / "out-new"),
+                    "--metrics-dump", str(root / "merged.json")])
+    assert p.returncode == 0, f"merged proc: {p.stdout[-2000:]}"
+    return root
+
+
+def test_floors_union_is_exact(floors_run):
+    ids = np.concatenate([
+        _tx_ids(str(floors_run / "out-old" / "**" / "part-*.parquet")),
+        _tx_ids(str(floors_run / "out-new" / "part-*.parquet")),
+    ])
+    assert len(ids) == N_GROW
+    assert np.array_equal(np.sort(ids), np.arange(N_GROW)), (
+        "floors failed: rows lost or re-scored across the shrink")
+
+
+def test_floors_drop_already_scored_rows(floors_run):
+    snap = json.loads((floors_run / "merged.json").read_text())
+    assert _series_total(
+        snap, "rtfds_resume_floor_skipped_rows_total") > 0, (
+        "the floor source never dropped a row — the two old cursors "
+        "should differ by construction")
+
+
+def test_floors_recorded_in_resize_epochs(floors_run):
+    from real_time_fraud_detection_system_tpu.io.checkpoint import (
+        make_checkpointer,
+    )
+
+    ck = make_checkpointer(str(floors_run / "ck-new"))
+    man = ck.manifest(os.path.basename(ck.latest()))
+    epochs = man["meta"]["resize_epochs"]
+    floors = epochs[-1]["floors"]
+    assert len(floors) == 2 and floors[0] != floors[1], floors
+    assert epochs[-1]["from_processes"] == 2
+    assert epochs[-1]["min_offset"] == min(floors)
